@@ -1,0 +1,22 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+A from-scratch re-design of the capabilities of NVIDIA Dynamo
+(reference: /root/reference, snapshot v0.1.0) for TPU hardware:
+
+- ``dynamo_tpu.runtime``   — distributed runtime: control-plane service
+  (discovery/leases/watches + request plane + event plane + work queues),
+  TCP streaming response plane, Component/Endpoint addressing, AsyncEngine.
+  (reference: lib/runtime/src/)
+- ``dynamo_tpu.llm``       — OpenAI protocol + HTTP frontend, preprocessor,
+  detokenizing backend, model cards, KV-aware router, disagg router.
+  (reference: lib/llm/src/)
+- ``dynamo_tpu.engine``    — the JAX serving engine: paged KV cache,
+  continuous batching scheduler, prefill/decode programs. (replaces the
+  reference's patched-vLLM worker data plane)
+- ``dynamo_tpu.models``    — JAX model implementations (Llama, Mixtral, ...).
+- ``dynamo_tpu.ops``       — Pallas/XLA kernels (paged attention, block copy).
+- ``dynamo_tpu.parallel``  — mesh construction, shardings, ring attention.
+- ``dynamo_tpu.sdk``       — ``@service`` graph SDK + CLI (dynamo serve/run).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,26 @@
+"""Distributed runtime: control plane, component model, streaming plane.
+
+Reference: lib/runtime/src/ (the dynamo-runtime crate).
+"""
+
+from .codec import TwoPartMessage, decode_buffer, encode
+from .component import (AsyncResponseStream, Client, Component, Endpoint,
+                        EndpointAddress, EndpointInstance, Namespace)
+from .config import RuntimeConfig
+from .dcp_client import (DcpClient, DcpError, KvItem, Message,
+                         NoRespondersError, PrefixWatch, WatchEvent, pack,
+                         unpack)
+from .dcp_server import DcpServer
+from .engine import Annotated, AsyncEngine, Context
+from .runtime import (DistributedRuntime, Runtime, Worker, dynamo_worker)
+from .tcp import TcpCallHome, TcpConnectionInfo, TcpStreamServer
+
+__all__ = [
+    "Annotated", "AsyncEngine", "AsyncResponseStream", "Client", "Component",
+    "Context", "DcpClient", "DcpError", "DcpServer", "DistributedRuntime",
+    "Endpoint", "EndpointAddress", "EndpointInstance", "KvItem", "Message",
+    "Namespace", "NoRespondersError", "PrefixWatch", "Runtime",
+    "RuntimeConfig", "TcpCallHome", "TcpConnectionInfo", "TcpStreamServer",
+    "TwoPartMessage", "WatchEvent", "Worker", "decode_buffer", "dynamo_worker",
+    "encode", "pack", "unpack",
+]

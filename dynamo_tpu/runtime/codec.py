@@ -1,0 +1,76 @@
+"""Two-part frame codec for the streaming response plane.
+
+Frame layout (reference lib/runtime/src/pipeline/network/codec/two_part.rs:30-70):
+a fixed 24-byte prelude — ``header_len`` (u64 LE), ``body_len`` (u64 LE),
+``xxh3_64(header || body)`` (u64 LE) — followed by the header bytes (msgpack
+control map) and the body bytes (opaque payload). The checksum guards the
+response plane against corruption/desync on long-lived raw TCP streams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+
+import msgpack
+import xxhash
+
+PRELUDE = struct.Struct("<QQQ")
+PRELUDE_SIZE = PRELUDE.size  # 24
+MAX_MESSAGE = 256 * 1024 * 1024
+
+
+class CodecError(RuntimeError):
+    pass
+
+
+@dataclass
+class TwoPartMessage:
+    header: dict = field(default_factory=dict)
+    body: bytes = b""
+
+
+def encode(msg: TwoPartMessage) -> bytes:
+    header = msgpack.packb(msg.header, use_bin_type=True)
+    body = msg.body or b""
+    h = xxhash.xxh3_64()
+    h.update(header)
+    h.update(body)
+    return PRELUDE.pack(len(header), len(body), h.intdigest()) + header + body
+
+
+async def decode(reader: asyncio.StreamReader) -> TwoPartMessage:
+    prelude = await reader.readexactly(PRELUDE_SIZE)
+    header_len, body_len, checksum = PRELUDE.unpack(prelude)
+    if header_len + body_len > MAX_MESSAGE:
+        raise CodecError(f"message too large: {header_len + body_len}")
+    header = await reader.readexactly(header_len)
+    body = await reader.readexactly(body_len)
+    h = xxhash.xxh3_64()
+    h.update(header)
+    h.update(body)
+    if h.intdigest() != checksum:
+        raise CodecError("two-part frame checksum mismatch")
+    return TwoPartMessage(msgpack.unpackb(header, raw=False), body)
+
+
+def decode_buffer(buf: bytes) -> tuple[Optional[TwoPartMessage], bytes]:
+    """Non-async incremental decode: returns (message | None, remaining)."""
+    if len(buf) < PRELUDE_SIZE:
+        return None, buf
+    header_len, body_len, checksum = PRELUDE.unpack(buf[:PRELUDE_SIZE])
+    if header_len + body_len > MAX_MESSAGE:
+        raise CodecError(f"message too large: {header_len + body_len}")
+    total = PRELUDE_SIZE + header_len + body_len
+    if len(buf) < total:
+        return None, buf
+    header = buf[PRELUDE_SIZE:PRELUDE_SIZE + header_len]
+    body = buf[PRELUDE_SIZE + header_len:total]
+    h = xxhash.xxh3_64()
+    h.update(header)
+    h.update(body)
+    if h.intdigest() != checksum:
+        raise CodecError("two-part frame checksum mismatch")
+    return TwoPartMessage(msgpack.unpackb(header, raw=False), body), buf[total:]

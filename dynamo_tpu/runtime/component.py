@@ -1,0 +1,466 @@
+"""Component model: Namespace → Component → Endpoint addressing + serving.
+
+Reference lib/runtime/src/component.rs: discovery path
+``<ns>/components/<comp>/<ep>:<lease_hex>`` in the KV store (under the
+worker's primary lease) and request-plane subject
+``<ns>.<comp>.<ep>-<lease_hex>``; serving an endpoint (reference
+component/endpoint.rs:55-142) registers the subject consumer and writes the
+discoverable instance record; a Client (reference component/client.rs)
+watches the prefix and routes round_robin / random / direct.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
+
+from .codec import TwoPartMessage
+from .dcp_client import DcpClient, Message, NoRespondersError, pack, unpack
+from .engine import Annotated, Context
+from .tcp import (STREAM_COMPLETE, StreamError, TcpCallHome, TcpConnectionInfo,
+                  TcpStreamServer)
+
+log = logging.getLogger("dynamo_tpu.component")
+
+INSTANCE_ROOT = "instances/"  # KV prefix for endpoint instance records
+
+
+def instance_key(namespace: str, component: str, endpoint: str, lease: int) -> str:
+    return f"{INSTANCE_ROOT}{namespace}/components/{component}/{endpoint}:{lease:x}"
+
+
+def instance_prefix(namespace: str, component: str, endpoint: str) -> str:
+    return f"{INSTANCE_ROOT}{namespace}/components/{component}/{endpoint}:"
+
+
+def instance_subject(namespace: str, component: str, endpoint: str,
+                     lease: int) -> str:
+    return f"{namespace}.{component}.{endpoint}-{lease:x}"
+
+
+def shared_subject(namespace: str, component: str, endpoint: str) -> str:
+    return f"{namespace}.{component}.{endpoint}"
+
+
+@dataclass(frozen=True)
+class EndpointAddress:
+    """Parsed ``dyn://namespace.component.endpoint`` address (reference
+    lib/runtime/src/protocols.rs Endpoint path parsing)."""
+
+    namespace: str
+    component: str
+    endpoint: str
+
+    @classmethod
+    def parse(cls, path: str) -> "EndpointAddress":
+        p = path[len("dyn://"):] if path.startswith("dyn://") else path
+        parts = p.split(".")
+        if len(parts) == 2:
+            parts = [parts[0], parts[1], "generate"]
+        if len(parts) != 3:
+            raise ValueError(
+                f"endpoint path must be namespace.component[.endpoint]: {path!r}")
+        return cls(*parts)
+
+    def __str__(self) -> str:
+        return f"dyn://{self.namespace}.{self.component}.{self.endpoint}"
+
+
+@dataclass
+class EndpointInstance:
+    """A live, discoverable endpoint instance."""
+
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int  # == serving worker's lease id
+    subject: str
+    transport: str = "dcp+tcp"
+
+    def to_dict(self) -> dict:
+        return {
+            "namespace": self.namespace, "component": self.component,
+            "endpoint": self.endpoint, "instance_id": self.instance_id,
+            "subject": self.subject, "transport": self.transport,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EndpointInstance":
+        return cls(
+            namespace=d["namespace"], component=d["component"],
+            endpoint=d["endpoint"], instance_id=d["instance_id"],
+            subject=d["subject"], transport=d.get("transport", "dcp+tcp"))
+
+
+class Namespace:
+    def __init__(self, drt: "DistributedRuntime", name: str):  # noqa: F821
+        self.drt = drt
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self.drt, self.name, name)
+
+
+class Component:
+    def __init__(self, drt, namespace: str, name: str):
+        self.drt = drt
+        self.namespace = namespace
+        self.name = name
+        self._service_created = False
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self.drt, self.namespace, self.name, name)
+
+    async def create_service(self) -> None:
+        """Registers the component's service record (stats root)."""
+        self._service_created = True
+        await self.drt.dcp.kv_create(
+            f"services/{self.namespace}/{self.name}",
+            pack({"namespace": self.namespace, "component": self.name}),
+            lease=self.drt.primary_lease,
+        )
+
+    @property
+    def service_subject(self) -> str:
+        return f"{self.namespace}.{self.name}"
+
+
+Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+class Endpoint:
+    def __init__(self, drt, namespace: str, component: str, name: str):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.name = name
+
+    @property
+    def address(self) -> EndpointAddress:
+        return EndpointAddress(self.namespace, self.component, self.name)
+
+    @property
+    def path(self) -> str:
+        return str(self.address)
+
+    def subject_for(self, lease: int) -> str:
+        return instance_subject(self.namespace, self.component, self.name, lease)
+
+    async def serve(
+        self,
+        handler: Handler,
+        *,
+        stats_handler: Optional[Callable[[], dict]] = None,
+        metrics_labels: Optional[dict] = None,
+    ) -> "ServeHandle":
+        """Serve this endpoint with ``handler(request, context) -> aiter``.
+
+        Registers the request-plane consumer (both the per-instance subject
+        and the shared queue-group subject), publishes the discoverable
+        instance record under the worker's primary lease, and answers stats
+        queries (reference component/endpoint.rs:55-142 + service stats).
+        """
+        drt = self.drt
+        lease = drt.primary_lease
+        inst = EndpointInstance(
+            namespace=self.namespace, component=self.component,
+            endpoint=self.name, instance_id=lease,
+            subject=self.subject_for(lease))
+        serve_handle = ServeHandle(self, inst, handler, stats_handler)
+        await serve_handle._start()
+        return serve_handle
+
+    async def client(self) -> "Client":
+        c = Client(self.drt, self.address)
+        await c._start()
+        return c
+
+
+class ServeHandle:
+    """A served endpoint instance; ``stop()`` to withdraw from discovery."""
+
+    def __init__(self, endpoint: Endpoint, instance: EndpointInstance,
+                 handler: Handler, stats_handler):
+        self.endpoint = endpoint
+        self.instance = instance
+        self.handler = handler
+        self.stats_handler = stats_handler
+        self._sids: List[int] = []
+        self._inflight: Dict[str, Context] = {}
+        self._stopped = asyncio.Event()
+
+    async def _start(self) -> None:
+        drt = self.endpoint.drt
+        on_req = self._on_request
+        # per-instance subject (direct routing)
+        self._sids.append(await drt.dcp.subscribe(
+            self.instance.subject, on_req, group="workers"))
+        # shared subject (server-side balanced routing)
+        self._sids.append(await drt.dcp.subscribe(
+            shared_subject(self.instance.namespace, self.instance.component,
+                           self.instance.endpoint),
+            on_req, group="workers"))
+        # stats subject
+        self._sids.append(await drt.dcp.subscribe(
+            f"stats.{self.instance.subject}", self._on_stats, group="stats"))
+        # discoverable instance record, attached to our lease
+        key = instance_key(self.instance.namespace, self.instance.component,
+                           self.instance.endpoint, self.instance.instance_id)
+        await drt.dcp.kv_put(key, pack(self.instance.to_dict()),
+                             lease=self.instance.instance_id)
+        log.info("serving %s as instance %x",
+                 self.endpoint.path, self.instance.instance_id)
+
+    async def stop(self) -> None:
+        drt = self.endpoint.drt
+        self._stopped.set()
+        for sid in self._sids:
+            try:
+                await drt.dcp.unsubscribe(sid)
+            except Exception:
+                pass
+        key = instance_key(self.instance.namespace, self.instance.component,
+                           self.instance.endpoint, self.instance.instance_id)
+        try:
+            await drt.dcp.kv_delete(key)
+        except Exception:
+            pass
+        for ctx in self._inflight.values():
+            ctx.kill()
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    async def _on_stats(self, msg: Message) -> None:
+        data = self.stats_handler() if self.stats_handler else {}
+        await msg.respond(pack({
+            "instance_id": self.instance.instance_id,
+            "subject": self.instance.subject,
+            "inflight": len(self._inflight),
+            "data": data,
+        }))
+
+    async def _on_request(self, msg: Message) -> None:
+        """Request-plane delivery: ack over the request plane, then stream
+        responses over the TCP call-home connection (reference
+        ingress/push_handler.rs:20-113)."""
+        try:
+            envelope = unpack(msg.payload)
+            req_id = envelope["req_id"]
+            conn_info = TcpConnectionInfo.from_dict(envelope["conn"])
+            request = unpack(envelope["payload"])
+        except Exception as e:  # noqa: BLE001
+            if msg.needs_reply:
+                await msg.respond_error(f"bad request envelope: {e!r}")
+            return
+        if msg.needs_reply:
+            await msg.respond(pack({"accepted": True,
+                                    "instance_id": self.instance.instance_id}))
+        asyncio.ensure_future(self._run_request(req_id, conn_info, request))
+
+    async def _run_request(self, req_id: str, conn_info: TcpConnectionInfo,
+                           request: Any) -> None:
+        ctx = Context(req_id)
+        self._inflight[req_id] = ctx
+
+        def on_ctrl(kind: str) -> None:
+            if kind == "stop":
+                ctx.stop_generating()
+            else:  # kill / disconnect
+                ctx.kill()
+
+        callhome: Optional[TcpCallHome] = None
+        try:
+            callhome = await TcpCallHome.connect(conn_info, on_ctrl)
+            agen = self.handler(request, ctx)
+            async for item in agen:
+                if ctx.killed:
+                    break
+                env = item if isinstance(item, Annotated) else Annotated(data=item)
+                if env.id is None:
+                    env.id = req_id
+                await callhome.send_data(pack(env.to_dict()))
+            await callhome.complete()
+        except asyncio.CancelledError:
+            if callhome:
+                await callhome.error("worker cancelled")
+        except Exception as e:  # noqa: BLE001
+            log.exception("handler failed for %s", req_id)
+            if callhome:
+                try:
+                    await callhome.error(repr(e))
+                except Exception:
+                    pass
+        finally:
+            self._inflight.pop(req_id, None)
+            if callhome:
+                await callhome.close()
+
+
+class AsyncResponseStream:
+    """Caller-side response stream: async-iterates Annotated envelopes."""
+
+    def __init__(self, pending, context: Context):
+        self._pending = pending
+        self.context = context
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> Annotated:
+        item = await self._pending.queue.get()
+        if item is STREAM_COMPLETE:
+            self._pending.close()
+            raise StopAsyncIteration
+        if isinstance(item, StreamError):
+            self._pending.close()
+            raise RuntimeError(f"stream error: {item.message}")
+        return Annotated.from_dict(unpack(item))
+
+    async def stop_generating(self) -> None:
+        self.context.stop_generating()
+        await self._pending.send_ctrl("stop")
+
+    async def kill(self) -> None:
+        self.context.kill()
+        await self._pending.send_ctrl("kill")
+
+    def close(self) -> None:
+        self._pending.close()
+
+
+class Client:
+    """Endpoint client with discovery + routing (reference
+    component/client.rs:64-244): watches the instance prefix, maintains the
+    live instance list, and routes ``random`` / ``round_robin`` / ``direct``.
+    """
+
+    def __init__(self, drt, address: EndpointAddress):
+        self.drt = drt
+        self.address = address
+        self.instances: Dict[int, EndpointInstance] = {}
+        self._watch = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._rr = 0
+        self._instances_event = asyncio.Event()
+
+    async def _start(self) -> None:
+        prefix = instance_prefix(self.address.namespace, self.address.component,
+                                 self.address.endpoint)
+        items, watch = await self.drt.dcp.kv_watch_prefix(prefix)
+        for item in items:
+            inst = EndpointInstance.from_dict(unpack(item.value))
+            self.instances[inst.instance_id] = inst
+        if self.instances:
+            self._instances_event.set()
+        self._watch = watch
+        self._watch_task = asyncio.create_task(self._watch_loop())
+
+    async def _watch_loop(self) -> None:
+        async for ev in self._watch:
+            if ev.event == "put":
+                inst = EndpointInstance.from_dict(unpack(ev.value))
+                self.instances[inst.instance_id] = inst
+                self._instances_event.set()
+            elif ev.event == "delete":
+                lease_hex = ev.key.rsplit(":", 1)[-1]
+                try:
+                    self.instances.pop(int(lease_hex, 16), None)
+                except ValueError:
+                    pass
+                if not self.instances:
+                    self._instances_event.clear()
+
+    async def close(self) -> None:
+        if self._watch:
+            await self._watch.stop()
+        if self._watch_task:
+            self._watch_task.cancel()
+
+    def instance_ids(self) -> List[int]:
+        return sorted(self.instances)
+
+    async def wait_for_instances(self, timeout: float = 30.0) -> List[int]:
+        await asyncio.wait_for(self._instances_event.wait(), timeout)
+        return self.instance_ids()
+
+    # ------------------------------------------------------------- routing
+
+    def _pick(self, mode: str, instance_id: Optional[int]) -> Optional[str]:
+        """Returns the request-plane subject for the chosen route."""
+        ids = self.instance_ids()
+        if mode == "direct":
+            if instance_id not in self.instances:
+                raise RuntimeError(
+                    f"instance {instance_id:x} of {self.address} not found"
+                    if instance_id is not None else "direct() needs instance_id")
+            return self.instances[instance_id].subject
+        if not ids:
+            raise NoRespondersError(f"no live instances of {self.address}")
+        if mode == "random":
+            return self.instances[random.choice(ids)].subject
+        if mode == "round_robin":
+            subject = self.instances[ids[self._rr % len(ids)]].subject
+            self._rr += 1
+            return subject
+        raise ValueError(f"unknown routing mode {mode}")
+
+    async def generate(self, request: Any, *, mode: str = "round_robin",
+                       instance_id: Optional[int] = None,
+                       context: Optional[Context] = None,
+                       timeout: float = 60.0) -> AsyncResponseStream:
+        """Issue a request; returns the streaming response.
+
+        Reference egress/push.rs:83-181 — registers the local response
+        stream, sends the request (with call-home connection info) over the
+        request plane, awaits the worker's ack.
+        """
+        subject = self._pick(mode, instance_id)
+        ctx = context or Context()
+        server: TcpStreamServer = await self.drt.tcp_server()
+        pending = server.register()
+        envelope = pack({
+            "req_id": ctx.id,
+            "conn": TcpConnectionInfo(server.address, pending.subject).to_dict(),
+            "payload": pack(request),
+        })
+        try:
+            ack = unpack(await self.drt.dcp.request(subject, envelope,
+                                                    timeout=timeout))
+            if not ack.get("accepted"):
+                raise RuntimeError(f"request rejected: {ack}")
+        except Exception:
+            pending.close()
+            raise
+        return AsyncResponseStream(pending, ctx)
+
+    async def round_robin(self, request: Any, **kw) -> AsyncResponseStream:
+        return await self.generate(request, mode="round_robin", **kw)
+
+    async def random(self, request: Any, **kw) -> AsyncResponseStream:
+        return await self.generate(request, mode="random", **kw)
+
+    async def direct(self, request: Any, instance_id: int, **kw) -> AsyncResponseStream:
+        return await self.generate(request, mode="direct", instance_id=instance_id, **kw)
+
+    # ------------------------------------------------------------- stats
+
+    async def collect_stats(self, timeout: float = 2.0) -> Dict[int, dict]:
+        """Scrape per-instance stats over the request plane (reference
+        service.rs collect_services / $SRV.STATS)."""
+        out: Dict[int, dict] = {}
+
+        async def _one(inst: EndpointInstance):
+            try:
+                resp = unpack(await self.drt.dcp.request(
+                    f"stats.{inst.subject}", b"", timeout=timeout))
+                out[inst.instance_id] = resp
+            except Exception:
+                pass
+
+        await asyncio.gather(*(_one(i) for i in list(self.instances.values())))
+        return out

@@ -1,0 +1,49 @@
+"""Layered runtime configuration.
+
+Reference lib/runtime/src/config.rs: figment-layered settings from env
+(``DYN_WORKER_*`` / ``DYN_RUNTIME_*``) + optional TOML. Here: env
+(``DYN_*``) + optional YAML/JSON file named by ``DYN_CONFIG_PATH``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+
+@dataclass
+class RuntimeConfig:
+    dcp_address: Optional[str] = None       # DYN_DCP_ADDRESS; None → embedded
+    lease_ttl: float = 10.0                 # DYN_LEASE_TTL
+    request_timeout: float = 60.0           # DYN_REQUEST_TIMEOUT
+    log_level: str = "INFO"                 # DYN_LOG
+    log_jsonl: bool = False                 # DYN_LOGGING_JSONL
+
+    @classmethod
+    def from_settings(cls) -> "RuntimeConfig":
+        cfg = cls()
+        path = os.environ.get("DYN_CONFIG_PATH")
+        if path and os.path.exists(path):
+            with open(path) as f:
+                if path.endswith((".yaml", ".yml")):
+                    import yaml
+
+                    data = yaml.safe_load(f) or {}
+                else:
+                    data = json.load(f)
+            for f_ in fields(cls):
+                if f_.name in data:
+                    setattr(cfg, f_.name, data[f_.name])
+        env_map = {
+            "DYN_DCP_ADDRESS": ("dcp_address", str),
+            "DYN_LEASE_TTL": ("lease_ttl", float),
+            "DYN_REQUEST_TIMEOUT": ("request_timeout", float),
+            "DYN_LOG": ("log_level", str),
+            "DYN_LOGGING_JSONL": ("log_jsonl", lambda v: v.lower() in ("1", "true")),
+        }
+        for env, (name, conv) in env_map.items():
+            if env in os.environ:
+                setattr(cfg, name, conv(os.environ[env]))
+        return cfg

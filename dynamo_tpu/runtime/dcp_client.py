@@ -1,0 +1,350 @@
+"""Asyncio client for the control-plane service (DCP).
+
+Plays the role of both the etcd client (reference
+lib/runtime/src/transports/etcd.rs: ``kv_create``/``kv_put``/
+``kv_get_prefix``/``kv_get_and_watch_prefix``, primary lease w/ keep-alive
+tied to cancellation) and the NATS client (reference transports/nats.rs:
+pub/sub, request/reply, JetStream queues) over the unified DCP wire protocol.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from dataclasses import dataclass
+from typing import AsyncIterator, Awaitable, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+from .dcp_server import pack_frame, read_frame
+
+log = logging.getLogger("dynamo_tpu.dcp.client")
+
+
+@dataclass
+class KvItem:
+    key: str
+    value: bytes
+    lease: int = 0
+
+
+@dataclass
+class WatchEvent:
+    """Put/Delete event from a prefix watch (reference etcd.rs WatchEvent)."""
+
+    event: str  # "put" | "delete"
+    key: str
+    value: Optional[bytes]
+
+
+class DcpError(RuntimeError):
+    pass
+
+
+class NoRespondersError(DcpError):
+    pass
+
+
+class DcpClient:
+    """One connection to the DCP server, usable concurrently from many tasks."""
+
+    def __init__(self) -> None:
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._seq = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watch_ids = itertools.count(1)
+        self._watch_queues: Dict[int, asyncio.Queue] = {}
+        self._sub_handlers: Dict[int, Callable[[dict], Awaitable[None]]] = {}
+        self._rx_task: Optional[asyncio.Task] = None
+        self._wlock = asyncio.Lock()
+        self._closed = False
+        self.address = ""
+
+    # ------------------------------------------------------------- lifecycle
+
+    @classmethod
+    async def connect(cls, address: str) -> "DcpClient":
+        self = cls()
+        host, _, port = address.rpartition(":")
+        self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        self._rx_task = asyncio.create_task(self._rx_loop())
+        self.address = address
+        return self
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._rx_task:
+            self._rx_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(DcpError("connection closed"))
+        self._pending.clear()
+
+    @property
+    def connected(self) -> bool:
+        return not self._closed and self._writer is not None
+
+    # --------------------------------------------------------------- rx loop
+
+    async def _rx_loop(self) -> None:
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                if "push" in msg:
+                    await self._on_push(msg)
+                else:
+                    fut = self._pending.pop(msg.get("seq"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            log.exception("dcp client rx error")
+        finally:
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(DcpError("connection lost"))
+            self._pending.clear()
+            for q in self._watch_queues.values():
+                q.put_nowait(None)
+
+    async def _on_push(self, msg: dict) -> None:
+        kind = msg["push"]
+        if kind == "watch":
+            q = self._watch_queues.get(msg["watch_id"])
+            if q is not None:
+                q.put_nowait(WatchEvent(msg["event"], msg["key"], msg.get("value")))
+        elif kind in ("msg", "req"):
+            handler = self._sub_handlers.get(msg["sid"])
+            if handler is not None:
+                asyncio.ensure_future(self._run_handler(handler, msg))
+            elif kind == "req":
+                await self._send_raw(
+                    {"op": "reply", "seq": next(self._seq), "reply": msg["reply"],
+                     "ok": False, "error": "no handler"})
+
+    async def _run_handler(self, handler, msg: dict) -> None:
+        try:
+            await handler(msg)
+        except Exception:
+            log.exception("subscription handler failed for %s", msg.get("subject"))
+
+    # ------------------------------------------------------------------- rpc
+
+    async def _send_raw(self, msg: dict) -> None:
+        async with self._wlock:
+            self._writer.write(pack_frame(msg))
+            await self._writer.drain()
+
+    async def _call(self, op: str, timeout: Optional[float] = None, **kw) -> dict:
+        if self._closed:
+            raise DcpError("client closed")
+        seq = next(self._seq)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[seq] = fut
+        await self._send_raw({"op": op, "seq": seq, **kw})
+        try:
+            resp = await (asyncio.wait_for(fut, timeout) if timeout else fut)
+        finally:
+            self._pending.pop(seq, None)
+        if not resp.get("ok", True):
+            err = resp.get("error", "unknown")
+            if "no responders" in str(err):
+                raise NoRespondersError(err)
+            raise DcpError(err)
+        return resp
+
+    # ---------------------------------------------------------------- KV API
+
+    async def kv_put(self, key: str, value: bytes, lease: int = 0) -> int:
+        resp = await self._call("kv_put", key=key, value=value, lease=lease)
+        return resp["rev"]
+
+    async def kv_create(self, key: str, value: bytes, lease: int = 0) -> bool:
+        """Create-if-absent; returns False when the key already exists."""
+        try:
+            await self._call("kv_create", key=key, value=value, lease=lease)
+            return True
+        except DcpError as e:
+            if "exists" in str(e):
+                return False
+            raise
+
+    async def kv_get(self, key: str) -> Optional[bytes]:
+        resp = await self._call("kv_get", key=key)
+        return resp["value"] if resp.get("found") else None
+
+    async def kv_get_prefix(self, prefix: str) -> List[KvItem]:
+        resp = await self._call("kv_get_prefix", prefix=prefix)
+        return [KvItem(i["key"], i["value"], i.get("lease", 0)) for i in resp["items"]]
+
+    async def kv_delete(self, key: str) -> bool:
+        return (await self._call("kv_delete", key=key))["deleted"]
+
+    async def kv_delete_prefix(self, prefix: str) -> int:
+        return (await self._call("kv_delete_prefix", prefix=prefix))["deleted"]
+
+    async def kv_watch_prefix(
+        self, prefix: str
+    ) -> Tuple[List[KvItem], "PrefixWatch"]:
+        """Returns (current items, watch stream) — reference
+        etcd.rs kv_get_and_watch_prefix."""
+        wid = next(self._watch_ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._watch_queues[wid] = q
+        resp = await self._call("watch_prefix", prefix=prefix, watch_id=wid)
+        items = [KvItem(i["key"], i["value"], i.get("lease", 0)) for i in resp["items"]]
+        return items, PrefixWatch(self, wid, q)
+
+    # ------------------------------------------------------------- lease API
+
+    async def lease_grant(self, ttl: float = 10.0) -> int:
+        return (await self._call("lease_grant", ttl=ttl))["lease"]
+
+    async def lease_keepalive(self, lease: int) -> None:
+        await self._call("lease_keepalive", lease=lease)
+
+    async def lease_revoke(self, lease: int) -> None:
+        await self._call("lease_revoke", lease=lease)
+
+    def spawn_keepalive(self, lease: int, ttl: float,
+                        cancel: Optional[asyncio.Event] = None) -> asyncio.Task:
+        """Background keep-alive tied to a cancel event (reference
+        transports/etcd/lease.rs: keep-alive tied to CancellationToken)."""
+
+        async def _loop():
+            interval = max(ttl / 3.0, 0.1)
+            while cancel is None or not cancel.is_set():
+                await asyncio.sleep(interval)
+                try:
+                    await self.lease_keepalive(lease)
+                except DcpError:
+                    log.warning("lease %x keepalive failed", lease)
+                    return
+
+        return asyncio.create_task(_loop())
+
+    # ----------------------------------------------------------- pub/sub API
+
+    async def subscribe(
+        self,
+        subject: str,
+        handler: Callable[["Message"], Awaitable[None]],
+        group: Optional[str] = None,
+    ) -> int:
+        """Subscribe; ``handler(Message)`` runs per delivery. For request-plane
+        subjects, use ``msg.respond()`` to send the reply."""
+
+        async def _raw(msg: dict) -> None:
+            await handler(Message(self, msg))
+
+        resp = await self._call("sub", subject=subject, group=group)
+        sid = resp["sid"]
+        self._sub_handlers[sid] = _raw
+        return sid
+
+    async def unsubscribe(self, sid: int) -> None:
+        self._sub_handlers.pop(sid, None)
+        await self._call("unsub", sid=sid)
+
+    async def publish(self, subject: str, payload: bytes) -> None:
+        await self._call("pub", subject=subject, payload=payload)
+
+    async def request(self, subject: str, payload: bytes,
+                      timeout: float = 30.0) -> bytes:
+        resp = await self._call("req", subject=subject, payload=payload,
+                                timeout=timeout)
+        return resp["payload"]
+
+    # --------------------------------------------------------- work-queue API
+
+    async def queue_put(self, queue: str, payload: bytes) -> None:
+        await self._call("q_put", queue=queue, payload=payload)
+
+    async def queue_pull(self, queue: str,
+                         timeout: float = 0.0) -> Optional[bytes]:
+        resp = await self._call(
+            "q_pull", queue=queue, timeout_ms=int(timeout * 1000))
+        return resp["payload"] if resp.get("found") else None
+
+    async def queue_len(self, queue: str) -> int:
+        return (await self._call("q_len", queue=queue))["len"]
+
+    async def ping(self) -> float:
+        return (await self._call("ping"))["time"]
+
+
+class Message:
+    """A delivered pub/sub or request-plane message."""
+
+    __slots__ = ("_client", "subject", "payload", "_reply")
+
+    def __init__(self, client: DcpClient, raw: dict):
+        self._client = client
+        self.subject: str = raw["subject"]
+        self.payload: bytes = raw["payload"]
+        self._reply: Optional[int] = raw.get("reply")
+
+    @property
+    def needs_reply(self) -> bool:
+        return self._reply is not None
+
+    async def respond(self, payload: bytes) -> None:
+        assert self._reply is not None, "not a request message"
+        await self._client._send_raw(
+            {"op": "reply", "seq": next(self._client._seq),
+             "reply": self._reply, "ok": True, "payload": payload})
+
+    async def respond_error(self, error: str) -> None:
+        assert self._reply is not None, "not a request message"
+        await self._client._send_raw(
+            {"op": "reply", "seq": next(self._client._seq),
+             "reply": self._reply, "ok": False, "error": error})
+
+
+class PrefixWatch:
+    """Async iterator of WatchEvents; ``stop()`` to end."""
+
+    def __init__(self, client: DcpClient, watch_id: int, queue: asyncio.Queue):
+        self._client = client
+        self._watch_id = watch_id
+        self._queue = queue
+        self._stopped = False
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        if self._stopped:
+            raise StopAsyncIteration
+        ev = await self._queue.get()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev
+
+    async def stop(self) -> None:
+        self._stopped = True
+        self._client._watch_queues.pop(self._watch_id, None)
+        try:
+            await self._client._call("unwatch", watch_id=self._watch_id)
+        except DcpError:
+            pass
+        self._queue.put_nowait(None)
+
+
+def pack(obj) -> bytes:
+    """Standard payload serialization for the framework (msgpack)."""
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes):
+    return msgpack.unpackb(data, raw=False)

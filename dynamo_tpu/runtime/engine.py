@@ -1,0 +1,111 @@
+"""AsyncEngine abstraction — the universal streaming-engine interface.
+
+Reference lib/runtime/src/engine.rs: ``AsyncEngine::generate(SingleIn<Req>)
+-> ManyOut<Resp>`` with an ``AsyncEngineContext`` carrying the request id and
+``stop_generating``/``kill`` controls, and ``Annotated<T>`` (reference
+lib/runtime/src/protocols/annotated.rs) as the SSE-shaped envelope every
+streamed response travels in.
+
+In this framework an engine is any object with::
+
+    async def generate(self, request, context: Context) -> AsyncIterator[Any]
+
+where the returned async iterator yields JSON/msgpack-serializable items.
+``Context.stopped``/``killed`` must be honored by long-running engines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional, Protocol, runtime_checkable
+
+
+class Context:
+    """Per-request context: id + cancellation controls.
+
+    ``stop_generating`` asks for a graceful early finish (emit what you have);
+    ``kill`` demands immediate termination (reference engine.rs:47-85).
+    """
+
+    __slots__ = ("id", "_stop", "_kill", "annotations")
+
+    def __init__(self, request_id: Optional[str] = None):
+        self.id: str = request_id or uuid.uuid4().hex
+        self._stop = asyncio.Event()
+        self._kill = asyncio.Event()
+        self.annotations: dict = {}
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set() or self._kill.is_set()
+
+    @property
+    def killed(self) -> bool:
+        return self._kill.is_set()
+
+    def stop_generating(self) -> None:
+        self._stop.set()
+
+    def kill(self) -> None:
+        self._stop.set()
+        self._kill.set()
+
+    async def wait_stopped(self) -> None:
+        await self._stop.wait()
+
+
+@runtime_checkable
+class AsyncEngine(Protocol):
+    """Structural type for engines; anything with this shape qualifies."""
+
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        ...
+
+
+@dataclass
+class Annotated:
+    """SSE-shaped response envelope: exactly one of data/event-comment forms.
+
+    Reference lib/runtime/src/protocols/annotated.rs — every streamed
+    response crosses process boundaries inside this envelope so that
+    annotations (events/comments) can ride the same stream as data.
+    """
+
+    data: Any = None
+    id: Optional[str] = None
+    event: Optional[str] = None
+    comment: Optional[list] = None
+
+    def to_dict(self) -> dict:
+        d: dict = {}
+        if self.data is not None:
+            d["data"] = self.data
+        if self.id is not None:
+            d["id"] = self.id
+        if self.event is not None:
+            d["event"] = self.event
+        if self.comment is not None:
+            d["comment"] = self.comment
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Annotated":
+        return cls(data=d.get("data"), id=d.get("id"), event=d.get("event"),
+                   comment=d.get("comment"))
+
+    @classmethod
+    def from_error(cls, message: str) -> "Annotated":
+        return cls(event="error", comment=[message])
+
+    @classmethod
+    def from_annotation(cls, name: str, value: Any) -> "Annotated":
+        return cls(event=name, comment=[value] if not isinstance(value, list) else value)
+
+    @property
+    def is_error(self) -> bool:
+        return self.event == "error"
+
+    def error_message(self) -> str:
+        return "; ".join(str(c) for c in (self.comment or []))

@@ -1,0 +1,49 @@
+"""Structured logging init (reference lib/runtime/src/logging.rs:16-100):
+env-driven level filter (``DYN_LOG``), optional JSONL mode
+(``DYN_LOGGING_JSONL``) for machine-ingestible logs."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+
+class JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+_initialized = False
+
+
+def init(level: str | None = None, jsonl: bool | None = None) -> None:
+    global _initialized
+    if _initialized:
+        return
+    _initialized = True
+    level = level or os.environ.get("DYN_LOG", "INFO").upper()
+    if jsonl is None:
+        jsonl = os.environ.get("DYN_LOGGING_JSONL", "").lower() in ("1", "true")
+    handler = logging.StreamHandler(sys.stderr)
+    if jsonl:
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"))
+    root = logging.getLogger()
+    root.addHandler(handler)
+    try:
+        root.setLevel(level)
+    except ValueError:
+        root.setLevel(logging.INFO)

@@ -1,0 +1,174 @@
+"""Runtime + DistributedRuntime + Worker bootstrap.
+
+Reference lib/runtime/src/{lib.rs,runtime.rs,distributed.rs,worker.rs}:
+``Runtime`` owns the execution context and root cancellation;
+``DistributedRuntime`` adds the control-plane client (etcd+NATS analog: DCP),
+the primary lease (worker identity + liveness), and the lazily-created TCP
+response-plane server; ``Worker.execute`` is the process entrypoint running a
+user async fn with SIGINT-triggered graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+from typing import Awaitable, Callable, Optional
+
+from .component import Namespace
+from .config import RuntimeConfig
+from .dcp_client import DcpClient
+from .dcp_server import DcpServer
+from .tcp import TcpStreamServer
+
+log = logging.getLogger("dynamo_tpu.runtime")
+
+DEFAULT_DCP = os.environ.get("DYN_DCP_ADDRESS", "127.0.0.1:6650")
+
+
+class Runtime:
+    """Process-local execution context + hierarchical cancellation."""
+
+    def __init__(self, config: Optional[RuntimeConfig] = None):
+        self.config = config or RuntimeConfig.from_settings()
+        self._shutdown = asyncio.Event()
+
+    @property
+    def shutdown_event(self) -> asyncio.Event:
+        return self._shutdown
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    @property
+    def is_shutdown(self) -> bool:
+        return self._shutdown.is_set()
+
+    def child_event(self) -> asyncio.Event:
+        """A cancellation event that fires when the root shuts down."""
+        ev = asyncio.Event()
+
+        async def _link():
+            await self._shutdown.wait()
+            ev.set()
+
+        asyncio.ensure_future(_link())
+        return ev
+
+
+class DistributedRuntime:
+    """Runtime + control-plane connectivity + worker identity.
+
+    ``lease_id`` (primary lease) doubles as the worker/instance id, exactly
+    as the reference uses the etcd lease id (distributed.rs:31-66).
+    """
+
+    def __init__(self, runtime: Runtime, dcp: DcpClient, lease: int):
+        self.runtime = runtime
+        self.dcp = dcp
+        self.primary_lease = lease
+        self._tcp_server: Optional[TcpStreamServer] = None
+        self._tcp_lock = asyncio.Lock()
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._embedded_server: Optional[DcpServer] = None
+
+    @classmethod
+    async def attach(
+        cls,
+        dcp_address: Optional[str] = None,
+        runtime: Optional[Runtime] = None,
+        lease_ttl: Optional[float] = None,
+    ) -> "DistributedRuntime":
+        """Connect to the control plane and acquire the primary lease."""
+        runtime = runtime or Runtime()
+        address = dcp_address or runtime.config.dcp_address or DEFAULT_DCP
+        lease_ttl = lease_ttl if lease_ttl is not None else runtime.config.lease_ttl
+        dcp = await DcpClient.connect(address)
+        lease = await dcp.lease_grant(lease_ttl)
+        self = cls(runtime, dcp, lease)
+        self._keepalive_task = dcp.spawn_keepalive(
+            lease, lease_ttl, runtime.shutdown_event)
+        return self
+
+    @classmethod
+    async def detached(cls, runtime: Optional[Runtime] = None) -> "DistributedRuntime":
+        """Single-process mode: embed a DCP server in-process (reference
+        ``Runtime::single_threaded`` standalone mode). Used by tests and
+        ``run`` when no control plane is configured."""
+        server = await DcpServer.start("127.0.0.1", 0)
+        drt = await cls.attach(server.address, runtime)
+        drt._embedded_server = server
+        return drt
+
+    @property
+    def instance_id(self) -> int:
+        return self.primary_lease
+
+    def namespace(self, name: str) -> Namespace:
+        return Namespace(self, name)
+
+    async def tcp_server(self) -> TcpStreamServer:
+        """Lazily-created response-plane listener (distributed.rs:110-120)."""
+        async with self._tcp_lock:
+            if self._tcp_server is None:
+                self._tcp_server = await TcpStreamServer.start()
+            return self._tcp_server
+
+    async def shutdown(self) -> None:
+        self.runtime.shutdown()
+        if self._keepalive_task:
+            self._keepalive_task.cancel()
+        try:
+            await self.dcp.lease_revoke(self.primary_lease)
+        except Exception:
+            pass
+        if self._tcp_server:
+            await self._tcp_server.stop()
+        await self.dcp.close()
+        if self._embedded_server is not None:
+            await self._embedded_server.stop()
+
+
+class Worker:
+    """Process entrypoint (reference worker.rs:60-133): builds the runtime,
+    runs the user's async main, handles SIGINT/SIGTERM gracefully."""
+
+    def __init__(self, config: Optional[RuntimeConfig] = None):
+        self.config = config or RuntimeConfig.from_settings()
+
+    def execute(self, main: Callable[[DistributedRuntime], Awaitable[None]]) -> None:
+        asyncio.run(self._run(main))
+
+    async def _run(self, main) -> None:
+        runtime = Runtime(self.config)
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, runtime.shutdown)
+            except NotImplementedError:
+                pass
+        if self.config.dcp_address:
+            drt = await DistributedRuntime.attach(
+                self.config.dcp_address, runtime)
+        else:
+            drt = await DistributedRuntime.detached(runtime)
+        try:
+            await main(drt)
+        finally:
+            await drt.shutdown()
+
+
+def dynamo_worker(config: Optional[RuntimeConfig] = None):
+    """Decorator: ``@dynamo_worker()`` turns an async fn taking a
+    DistributedRuntime into a blocking main() (reference Python bindings
+    ``@dynamo_worker()``)."""
+
+    def deco(fn: Callable[[DistributedRuntime], Awaitable[None]]):
+        def main() -> None:
+            Worker(config).execute(fn)
+
+        main.__wrapped__ = fn
+        return main
+
+    return deco

@@ -1,0 +1,315 @@
+"""Runtime-core tests: DCP control plane (KV/lease/watch, pub-sub,
+request-reply, queues), two-part codec, and the end-to-end component
+request/response path (reference test model: lib/runtime/tests/)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (Annotated, Context, DcpClient, DcpServer,
+                                DistributedRuntime, NoRespondersError, pack,
+                                unpack)
+from dynamo_tpu.runtime.codec import TwoPartMessage, decode_buffer, encode
+from dynamo_tpu.runtime.dcp_server import subject_matches
+
+
+def test_subject_matching():
+    assert subject_matches("a.b.c", "a.b.c")
+    assert subject_matches("a.*.c", "a.b.c")
+    assert not subject_matches("a.*.c", "a.b.d")
+    assert subject_matches("a.>", "a.b.c")
+    assert subject_matches("a.>", "a.b")
+    assert not subject_matches("a.>", "a")
+    assert not subject_matches("a.b", "a.b.c")
+
+
+def test_two_part_codec_roundtrip():
+    msg = TwoPartMessage({"t": "data", "n": 42}, b"\x00\x01payload\xff")
+    buf = encode(msg)
+    decoded, rest = decode_buffer(buf + b"extra")
+    assert decoded.header == {"t": "data", "n": 42}
+    assert decoded.body == b"\x00\x01payload\xff"
+    assert rest == b"extra"
+    # corruption detected
+    bad = bytearray(buf)
+    bad[-1] ^= 0xFF
+    with pytest.raises(Exception):
+        decode_buffer(bytes(bad))
+
+
+def test_kv_lease_watch(run_async):
+    async def main():
+        server = await DcpServer.start()
+        c1 = await DcpClient.connect(server.address)
+        c2 = await DcpClient.connect(server.address)
+
+        # basic KV
+        await c1.kv_put("config/a", b"1")
+        assert await c2.kv_get("config/a") == b"1"
+        assert await c2.kv_get("config/missing") is None
+        assert await c1.kv_create("config/a", b"2") is False  # already exists
+        assert await c1.kv_create("config/b", b"2") is True
+
+        items = await c2.kv_get_prefix("config/")
+        assert [(i.key, i.value) for i in items] == [
+            ("config/a", b"1"), ("config/b", b"2")]
+
+        # watch sees put + lease-expiry delete
+        items, watch = await c2.kv_watch_prefix("inst/")
+        assert items == []
+        lease = await c1.lease_grant(ttl=0.5)
+        await c1.kv_put("inst/x", b"alive", lease=lease)
+        ev = await asyncio.wait_for(watch.__anext__(), 2)
+        assert (ev.event, ev.key, ev.value) == ("put", "inst/x", b"alive")
+        # no keepalive → expiry → delete event
+        ev = await asyncio.wait_for(watch.__anext__(), 3)
+        assert (ev.event, ev.key) == ("delete", "inst/x")
+        assert await c1.kv_get("inst/x") is None
+        await watch.stop()
+
+        # lease revoke deletes attached keys immediately
+        lease2 = await c1.lease_grant(ttl=30)
+        await c1.kv_put("inst/y", b"v", lease=lease2)
+        await c1.lease_revoke(lease2)
+        assert await c1.kv_get("inst/y") is None
+
+        await c1.close()
+        await c2.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_pubsub_and_request_reply(run_async):
+    async def main():
+        server = await DcpServer.start()
+        pub = await DcpClient.connect(server.address)
+        sub1 = await DcpClient.connect(server.address)
+        sub2 = await DcpClient.connect(server.address)
+
+        got1, got2 = [], []
+
+        async def h1(msg):
+            got1.append(msg.payload)
+
+        async def h2(msg):
+            got2.append(msg.payload)
+
+        await sub1.subscribe("events.kv", h1)
+        await sub2.subscribe("events.kv", h2)
+        await pub.publish("events.kv", b"e1")
+        await asyncio.sleep(0.1)
+        assert got1 == [b"e1"] and got2 == [b"e1"]  # fan-out to plain subs
+
+        # queue group: exactly one member receives each message
+        qgot = []
+
+        async def hq(msg):
+            qgot.append(msg.payload)
+
+        await sub1.subscribe("work.items", hq, group="g")
+        await sub2.subscribe("work.items", hq, group="g")
+        for i in range(4):
+            await pub.publish("work.items", bytes([i]))
+        await asyncio.sleep(0.1)
+        assert sorted(qgot) == [bytes([i]) for i in range(4)]
+
+        # request/reply
+        async def echo(msg):
+            await msg.respond(b"re:" + msg.payload)
+
+        await sub1.subscribe("svc.echo", echo, group="workers")
+        assert await pub.request("svc.echo", b"hi") == b"re:hi"
+
+        with pytest.raises(NoRespondersError):
+            await pub.request("svc.nobody", b"hi", timeout=2)
+
+        await pub.close()
+        await sub1.close()
+        await sub2.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_work_queue(run_async):
+    async def main():
+        server = await DcpServer.start()
+        a = await DcpClient.connect(server.address)
+        b = await DcpClient.connect(server.address)
+
+        assert await a.queue_pull("q1") is None  # empty, no wait
+        await a.queue_put("q1", b"item1")
+        assert await a.queue_len("q1") == 1
+        assert await b.queue_pull("q1") == b"item1"
+
+        # blocking pull woken by a later put
+        async def delayed_put():
+            await asyncio.sleep(0.1)
+            await a.queue_put("q1", b"item2")
+
+        t = asyncio.ensure_future(delayed_put())
+        assert await b.queue_pull("q1", timeout=2) == b"item2"
+        await t
+        await a.close()
+        await b.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_component_end_to_end(run_async):
+    """Worker serves an endpoint; client discovers it and streams responses
+    over the TCP call-home plane (reference runtime hello_world example)."""
+
+    async def main():
+        drt = await DistributedRuntime.detached()
+        ns = drt.namespace("test")
+
+        async def handler(request, context: Context):
+            for i in range(int(request["n"])):
+                yield {"i": i, "msg": request["msg"]}
+
+        comp = ns.component("greeter")
+        await comp.create_service()
+        handle = await comp.endpoint("generate").serve(
+            handler, stats_handler=lambda: {"custom": 7})
+
+        client = await ns.component("greeter").endpoint("generate").client()
+        ids = await client.wait_for_instances()
+        assert ids == [drt.instance_id]
+
+        stream = await client.round_robin({"n": 3, "msg": "hello"})
+        out = [env.data async for env in stream]
+        assert out == [{"i": 0, "msg": "hello"}, {"i": 1, "msg": "hello"},
+                       {"i": 2, "msg": "hello"}]
+
+        # direct routing + stats
+        stream = await client.direct({"n": 1, "msg": "d"}, ids[0])
+        assert [e.data async for e in stream] == [{"i": 0, "msg": "d"}]
+        stats = await client.collect_stats()
+        assert stats[ids[0]]["data"] == {"custom": 7}
+
+        # errors propagate as error Annotated
+        async def failing(request, context):
+            yield {"ok": 1}
+            raise ValueError("boom")
+
+        fcomp = ns.component("fail")
+        await fcomp.endpoint("generate").serve(failing)
+        fclient = await fcomp.endpoint("generate").client()
+        await fclient.wait_for_instances()
+        stream = await fclient.round_robin({})
+        with pytest.raises(RuntimeError):
+            async for _ in stream:
+                pass
+
+        # withdrawing the endpoint removes it from discovery
+        await handle.stop()
+        await asyncio.sleep(0.1)
+        assert client.instance_ids() == []
+        with pytest.raises(NoRespondersError):
+            await client.round_robin({"n": 1, "msg": "x"})
+
+        await client.close()
+        await fclient.close()
+        await drt.shutdown()
+
+    run_async(main())
+
+
+def test_annotated_envelope():
+    a = Annotated(data={"x": 1})
+    assert Annotated.from_dict(a.to_dict()).data == {"x": 1}
+    err = Annotated.from_error("bad")
+    assert err.is_error and err.error_message() == "bad"
+    assert unpack(pack({"a": [1, 2, b"x"]})) == {"a": [1, 2, b"x"]}
+
+
+def test_blocking_pull_does_not_stall_connection(run_async):
+    """Regression: a long q_pull on a connection must not serialize other
+    ops on the same connection (lease keepalives would miss)."""
+
+    async def main():
+        server = await DcpServer.start()
+        c = await DcpClient.connect(server.address)
+
+        async def slow_pull():
+            return await c.queue_pull("empty", timeout=3)
+
+        t0 = asyncio.get_event_loop().time()
+        pull = asyncio.ensure_future(slow_pull())
+        await asyncio.sleep(0.05)
+        await c.ping()  # must complete while the pull is still waiting
+        assert asyncio.get_event_loop().time() - t0 < 1.0
+        pull.cancel()
+        await c.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_server_stop_with_live_clients(run_async):
+    """Regression: stop() must not hang while clients are connected
+    (Python 3.12 wait_closed waits for handlers)."""
+
+    async def main():
+        server = await DcpServer.start()
+        c = await DcpClient.connect(server.address)
+        await c.ping()
+        await asyncio.wait_for(server.stop(), 8)
+        await c.close()
+
+    run_async(main())
+
+
+def test_responder_death_fails_inflight_request(run_async):
+    """Regression: if the responder conn dies mid-request, the requester
+    gets an immediate error, not a full timeout."""
+
+    async def main():
+        server = await DcpServer.start()
+        worker = await DcpClient.connect(server.address)
+        requester = await DcpClient.connect(server.address)
+
+        async def never_respond(msg):
+            await worker.close()  # die before replying
+
+        await worker.subscribe("svc.dead", never_respond, group="g")
+        t0 = asyncio.get_event_loop().time()
+        with pytest.raises(Exception) as ei:
+            await requester.request("svc.dead", b"x", timeout=10)
+        assert asyncio.get_event_loop().time() - t0 < 5.0
+        assert "disconnect" in str(ei.value)
+        await requester.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_plain_subscriber_does_not_steal_requests(run_async):
+    """Regression: requests route only to queue-group members; a plain
+    observer subscription on the subject must not consume them."""
+
+    async def main():
+        server = await DcpServer.start()
+        observer = await DcpClient.connect(server.address)
+        worker = await DcpClient.connect(server.address)
+        requester = await DcpClient.connect(server.address)
+
+        observed = []
+
+        async def observe(msg):
+            observed.append(msg.payload)  # never responds
+
+        async def serve(msg):
+            await msg.respond(b"served:" + msg.payload)
+
+        await observer.subscribe("svc.x", observe)  # plain, no group
+        await worker.subscribe("svc.x", serve, group="workers")
+        assert await requester.request("svc.x", b"r1", timeout=5) == b"served:r1"
+        for c in (observer, worker, requester):
+            await c.close()
+        await server.stop()
+
+    run_async(main())
