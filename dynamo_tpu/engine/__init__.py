@@ -1,0 +1,4 @@
+"""Serving engines: the JAX engine (paged KV, continuous batching) and the
+deterministic echo engines used for accelerator-free testing."""
+
+from .echo import EchoEngineCore, EchoEngineFull

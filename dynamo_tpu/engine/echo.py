@@ -1,0 +1,66 @@
+"""Deterministic echo engines — the framework's no-TPU test engines.
+
+Reference launch/dynamo-run/src/output/{echo_core.rs,echo_full.rs}:1-89:
+``EchoEngineCore`` echoes the prompt tokens back one-by-one at a fixed
+cadence (token-level, sits behind the Backend detokenizer);
+``EchoEngineFull`` echoes at the OpenAI level. They exercise the entire
+serving stack (HTTP → preprocessor → router → worker → backend → SSE) with
+no accelerator, making the distributed plane CI-testable (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+from ..llm.protocols.common import EngineOutput, PreprocessedRequest
+from ..runtime.engine import Context
+
+DEFAULT_DELAY_MS = 1.0
+
+
+class EchoEngineCore:
+    """Token-level echo: yields the prompt's tokens back as output tokens."""
+
+    def __init__(self, delay_ms: float = DEFAULT_DELAY_MS):
+        self.delay_ms = delay_ms
+
+    async def generate(self, request: PreprocessedRequest,
+                       context: Context) -> AsyncIterator[EngineOutput]:
+        ids = list(request.token_ids)
+        max_tokens = request.stop.max_tokens or len(ids)
+        prompt_tokens = len(ids)
+        for i, tid in enumerate(ids[:max_tokens]):
+            if context.stopped:
+                return
+            if self.delay_ms:
+                await asyncio.sleep(self.delay_ms / 1000.0)
+            yield EngineOutput(token_ids=[tid], prompt_tokens=prompt_tokens)
+        yield EngineOutput(token_ids=[], finish_reason="length"
+                           if max_tokens < len(ids) else "stop",
+                           prompt_tokens=prompt_tokens)
+
+
+class EchoEngineFull:
+    """OpenAI-level echo: streams the last user message's text back in
+    word-sized deltas (bypasses tokenization entirely)."""
+
+    def __init__(self, delay_ms: float = DEFAULT_DELAY_MS):
+        self.delay_ms = delay_ms
+
+    async def generate(self, request, context: Context):
+        # request: ChatCompletionRequest-shaped dict or object
+        messages = request["messages"] if isinstance(request, dict) else request.messages
+        text = ""
+        for m in reversed(messages):
+            role = m["role"] if isinstance(m, dict) else m.role
+            if role == "user":
+                text = m["content"] if isinstance(m, dict) else m.text()
+                break
+        for word in text.split(" "):
+            if context.stopped:
+                return
+            if self.delay_ms:
+                await asyncio.sleep(self.delay_ms / 1000.0)
+            yield {"text": word + " "}
+        yield {"text": "", "finish_reason": "stop"}

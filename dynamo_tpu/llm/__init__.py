@@ -1,0 +1,10 @@
+"""LLM layer: OpenAI protocol, HTTP frontend, preprocessing, detokenizing
+backend, model cards, KV routing. Reference: lib/llm/src/."""
+
+from .backend import Backend
+from .engines import LocalChatChain, LocalCompletionChain, RemoteOpenAIEngine
+from .entry import ModelEntry, list_models, register_model, remove_model
+from .model_card import ModelDeploymentCard
+from .preprocessor import OpenAIPreprocessor
+from .tokenizer import ByteTokenizer, DecodeStream, HFTokenizer, Tokenizer
+from .worker import serve_openai_model

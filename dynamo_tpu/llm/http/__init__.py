@@ -1,0 +1,5 @@
+"""OpenAI-compatible HTTP frontend (reference lib/llm/src/http/service/)."""
+
+from .discovery import ModelWatcher
+from .metrics import Metrics
+from .service import HttpService, ModelManager

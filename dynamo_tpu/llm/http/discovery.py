@@ -1,0 +1,78 @@
+"""Frontend model discovery: KV watcher → ModelManager registration.
+
+Reference lib/llm/src/http/service/discovery.rs:36-145 (``model_watcher``):
+watch the ``models/`` prefix; on Put build a client to the worker endpoint
+and register a chat/completions engine for the model; on Delete remove it.
+This is what makes workers (and ``llmctl``-registered models) appear on the
+frontend with zero restarts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from ...runtime.dcp_client import unpack
+from ...runtime.runtime import DistributedRuntime
+from ..engines import RemoteOpenAIEngine
+from ..entry import MODEL_PREFIX, ModelEntry
+from .service import ModelManager
+
+log = logging.getLogger("dynamo_tpu.http.discovery")
+
+
+class ModelWatcher:
+    def __init__(self, drt: DistributedRuntime, manager: ModelManager):
+        self.drt = drt
+        self.manager = manager
+        self._clients: Dict[str, object] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._watch = None
+
+    async def start(self) -> None:
+        items, watch = await self.drt.dcp.kv_watch_prefix(MODEL_PREFIX)
+        self._watch = watch
+        for item in items:
+            await self._register(ModelEntry.from_dict(unpack(item.value)))
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._watch:
+            await self._watch.stop()
+        if self._task:
+            self._task.cancel()
+
+    async def _loop(self) -> None:
+        async for ev in self._watch:
+            try:
+                if ev.event == "put":
+                    await self._register(ModelEntry.from_dict(unpack(ev.value)))
+                elif ev.event == "delete":
+                    self._unregister(ev.key)
+            except Exception:
+                log.exception("model watcher event failed for %s", ev.key)
+
+    async def _register(self, entry: ModelEntry) -> None:
+        addr = entry.address
+        client = await self.drt.namespace(addr.namespace) \
+            .component(addr.component).endpoint(addr.endpoint).client()
+        engine = RemoteOpenAIEngine(client)
+        if entry.model_type in ("chat", "both"):
+            self.manager.add_chat_model(entry.name, engine)
+        if entry.model_type in ("completions", "both"):
+            self.manager.add_completions_model(entry.name, engine)
+        self._clients[entry.kv_key()] = client
+        log.info("discovered model %r -> %s", entry.name, entry.endpoint)
+
+    def _unregister(self, kv_key: str) -> None:
+        # key: models/<type>/<name>
+        parts = kv_key[len(MODEL_PREFIX):].split("/", 1)
+        if len(parts) != 2:
+            return
+        _mtype, name = parts
+        self.manager.remove_model(name)
+        client = self._clients.pop(kv_key, None)
+        if client is not None:
+            asyncio.ensure_future(client.close())
+        log.info("model %r withdrawn", name)

@@ -1,0 +1,122 @@
+"""Prometheus metrics for the HTTP frontend (hand-rolled text exposition).
+
+Reference lib/llm/src/http/service/metrics.rs:82-260:
+``dyn_llm_http_service_requests_total{model,endpoint,request_type,status}``,
+``..._inflight_requests{model}``, ``..._request_duration_seconds{model}``
+histogram, and the RAII ``InflightGuard`` that stamps status on drop.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+PREFIX = "dyn_llm_http_service"
+
+# histogram buckets in seconds (reference uses prometheus defaults + LLM tail)
+BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+           30.0, 60.0, 120.0, 300.0]
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.requests_total: Dict[Tuple[str, str, str, str], int] = defaultdict(int)
+        self.inflight: Dict[str, int] = defaultdict(int)
+        self.duration_buckets: Dict[str, List[int]] = defaultdict(
+            lambda: [0] * (len(BUCKETS) + 1))
+        self.duration_sum: Dict[str, float] = defaultdict(float)
+        self.duration_count: Dict[str, int] = defaultdict(int)
+        # streaming metrics
+        self.ttft_sum: Dict[str, float] = defaultdict(float)
+        self.ttft_count: Dict[str, int] = defaultdict(int)
+        self.output_tokens_total: Dict[str, int] = defaultdict(int)
+
+    def guard(self, model: str, endpoint: str, request_type: str) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint, request_type)
+
+    def observe_duration(self, model: str, seconds: float) -> None:
+        self.duration_sum[model] += seconds
+        self.duration_count[model] += 1
+        buckets = self.duration_buckets[model]
+        for i, ub in enumerate(BUCKETS):
+            if seconds <= ub:
+                buckets[i] += 1
+        buckets[-1] += 1  # +Inf
+
+    def observe_ttft(self, model: str, seconds: float) -> None:
+        self.ttft_sum[model] += seconds
+        self.ttft_count[model] += 1
+
+    def count_output_tokens(self, model: str, n: int) -> None:
+        self.output_tokens_total[model] += n
+
+    def render(self) -> str:
+        lines: List[str] = []
+
+        def _h(name: str, typ: str, help_: str) -> None:
+            lines.append(f"# HELP {PREFIX}_{name} {help_}")
+            lines.append(f"# TYPE {PREFIX}_{name} {typ}")
+
+        _h("requests_total", "counter", "Total requests by model/endpoint/type/status")
+        for (model, endpoint, rtype, status), n in sorted(self.requests_total.items()):
+            lines.append(
+                f'{PREFIX}_requests_total{{model="{model}",endpoint="{endpoint}",'
+                f'request_type="{rtype}",status="{status}"}} {n}')
+        _h("inflight_requests", "gauge", "Requests currently being processed")
+        for model, n in sorted(self.inflight.items()):
+            lines.append(f'{PREFIX}_inflight_requests{{model="{model}"}} {n}')
+        _h("request_duration_seconds", "histogram", "Request duration")
+        for model in sorted(self.duration_count):
+            cum = 0
+            for i, ub in enumerate(BUCKETS):
+                cum = self.duration_buckets[model][i]
+                lines.append(
+                    f'{PREFIX}_request_duration_seconds_bucket{{model="{model}",'
+                    f'le="{ub}"}} {cum}')
+            lines.append(
+                f'{PREFIX}_request_duration_seconds_bucket{{model="{model}",'
+                f'le="+Inf"}} {self.duration_buckets[model][-1]}')
+            lines.append(
+                f'{PREFIX}_request_duration_seconds_sum{{model="{model}"}} '
+                f'{self.duration_sum[model]}')
+            lines.append(
+                f'{PREFIX}_request_duration_seconds_count{{model="{model}"}} '
+                f'{self.duration_count[model]}')
+        _h("time_to_first_token_seconds", "summary", "TTFT for streamed requests")
+        for model in sorted(self.ttft_count):
+            lines.append(
+                f'{PREFIX}_time_to_first_token_seconds_sum{{model="{model}"}} '
+                f'{self.ttft_sum[model]}')
+            lines.append(
+                f'{PREFIX}_time_to_first_token_seconds_count{{model="{model}"}} '
+                f'{self.ttft_count[model]}')
+        _h("output_tokens_total", "counter", "Total generated tokens")
+        for model, n in sorted(self.output_tokens_total.items()):
+            lines.append(f'{PREFIX}_output_tokens_total{{model="{model}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+
+class InflightGuard:
+    """RAII-style guard (reference metrics.rs:188-260): counts inflight and
+    stamps the final status; default status is 'error' unless marked ok."""
+
+    def __init__(self, metrics: Metrics, model: str, endpoint: str,
+                 request_type: str):
+        self.metrics = metrics
+        self.model = model
+        self.endpoint = endpoint
+        self.request_type = request_type
+        self.status = "error"
+        self.t0 = time.monotonic()
+        metrics.inflight[model] += 1
+
+    def mark_ok(self) -> None:
+        self.status = "success"
+
+    def done(self) -> None:
+        m = self.metrics
+        m.inflight[self.model] -= 1
+        m.requests_total[(self.model, self.endpoint, self.request_type,
+                          self.status)] += 1
+        m.observe_duration(self.model, time.monotonic() - self.t0)

@@ -1,0 +1,264 @@
+"""OpenAI-compatible HTTP frontend.
+
+Reference lib/llm/src/http/service/{service_v2.rs,openai.rs,service.rs}:
+axum server with ``/v1/chat/completions``, ``/v1/completions``,
+``/v1/models``, ``/metrics``, ``/health``; SSE streaming with a final
+``[DONE]``; a ``ModelManager`` mapping model name → engine. Implemented on
+aiohttp; engines are OpenAI-level async generators so local chains
+(preprocessor→backend→JAX engine) and remote workers plug in uniformly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import AsyncIterator, Callable, Dict, Optional
+
+from aiohttp import web
+
+from ...runtime.engine import Annotated, Context
+from ..protocols.openai import (ChatAggregator, ChatCompletionRequest,
+                                CompletionAggregator, CompletionRequest,
+                                ModelInfo, ModelList)
+from .metrics import Metrics
+
+log = logging.getLogger("dynamo_tpu.http")
+
+# An OpenAI-level engine: request (pydantic model) + Context -> async iterator
+# of chunk dicts (ChatCompletionChunk-shaped) or Annotated envelopes.
+OpenAIEngine = Callable[[object, Context], AsyncIterator]
+
+
+class ModelManager:
+    """Per-model engine registry (reference service.rs ModelManager)."""
+
+    def __init__(self) -> None:
+        self.chat_engines: Dict[str, OpenAIEngine] = {}
+        self.completion_engines: Dict[str, OpenAIEngine] = {}
+
+    def add_chat_model(self, name: str, engine: OpenAIEngine) -> None:
+        self.chat_engines[name] = engine
+        log.info("registered chat model %r", name)
+
+    def add_completions_model(self, name: str, engine: OpenAIEngine) -> None:
+        self.completion_engines[name] = engine
+        log.info("registered completions model %r", name)
+
+    def remove_model(self, name: str) -> None:
+        self.chat_engines.pop(name, None)
+        self.completion_engines.pop(name, None)
+        log.info("removed model %r", name)
+
+    def list_models(self) -> ModelList:
+        names = sorted(set(self.chat_engines) | set(self.completion_engines))
+        return ModelList(data=[ModelInfo(id=n) for n in names])
+
+
+class HttpService:
+    def __init__(self, manager: Optional[ModelManager] = None,
+                 metrics: Optional[Metrics] = None):
+        self.manager = manager or ModelManager()
+        self.metrics = metrics or Metrics()
+        self.app = web.Application()
+        self.app.add_routes([
+            web.post("/v1/chat/completions", self._chat),
+            web.post("/v1/completions", self._completions),
+            web.get("/v1/models", self._models),
+            web.get("/metrics", self._metrics),
+            web.get("/health", self._health),
+            web.get("/live", self._health),
+        ])
+        self._runner: Optional[web.AppRunner] = None
+        self.port = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self, host: str = "0.0.0.0", port: int = 8080) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        log.info("OpenAI HTTP service on %s:%d", host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------- handlers
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy",
+                                  "models": [m.id for m in
+                                             self.manager.list_models().data]})
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response(self.manager.list_models().model_dump())
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(),
+                            content_type="text/plain", charset="utf-8")
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, ChatCompletionRequest,
+                                 self.manager.chat_engines, "chat_completions")
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, CompletionRequest,
+                                 self.manager.completion_engines, "completions")
+
+    async def _serve(self, request: web.Request, model_cls, engines: dict,
+                     endpoint: str) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            req = model_cls(**body)
+        except Exception as e:  # noqa: BLE001
+            return _error_response(400, f"invalid request: {e}")
+        engine = engines.get(req.model)
+        if engine is None:
+            return _error_response(
+                404, f"model {req.model!r} not found; available: "
+                     f"{sorted(engines)}")
+        guard = self.metrics.guard(
+            req.model, endpoint, "stream" if req.stream else "unary")
+        ctx = Context()
+        try:
+            t0 = time.monotonic()
+            aiter = engine(req, ctx).__aiter__()
+            # pull the first item BEFORE committing response headers so
+            # early failures (validation, routing) map to clean HTTP errors
+            try:
+                first = await aiter.__anext__()
+            except StopAsyncIteration:
+                first = None
+            if req.stream:
+                return await self._sse(request, req, first, aiter, ctx, guard, t0)
+            return await self._unary(req, first, aiter, endpoint, guard)
+        except ValueError as e:
+            return _error_response(400, str(e))
+        except Exception as e:  # noqa: BLE001
+            log.exception("request %s failed", ctx.id)
+            return _error_response(500, repr(e))
+        finally:
+            guard.done()
+
+    async def _sse(self, http_request: web.Request, req, first, aiter,
+                   ctx: Context, guard, t0: float) -> web.StreamResponse:
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        })
+        await resp.prepare(http_request)
+        errored = False
+        saw_first_token = False
+
+        async def _write_chunk(chunk) -> bool:
+            """Writes one stream item; returns False to stop the stream."""
+            nonlocal errored, saw_first_token
+            if chunk is None:
+                return True
+            if isinstance(chunk, Annotated) and chunk.event and chunk.data is None:
+                if chunk.is_error:
+                    errored = True
+                    await resp.write(
+                        b"event: error\ndata: " +
+                        json.dumps(chunk.error_message()).encode() + b"\n\n")
+                    return False
+                # annotation event (formatted_prompt, token_ids, ...)
+                await resp.write(
+                    f"event: {chunk.event}\n".encode() + b"data: " +
+                    json.dumps(chunk.comment).encode() + b"\n\n")
+                return True
+            data = _chunk_dict(chunk)
+            if data is None:
+                return True
+            if not saw_first_token:
+                self.metrics.observe_ttft(req.model, time.monotonic() - t0)
+                saw_first_token = True
+            await resp.write(b"data: " + json.dumps(data).encode() + b"\n\n")
+            return True
+
+        try:
+            if await _write_chunk(first):
+                async for chunk in aiter:
+                    if not await _write_chunk(chunk):
+                        break
+            if not errored:
+                await resp.write(b"data: [DONE]\n\n")
+                guard.mark_ok()
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.kill()  # client went away → propagate cancellation upstream
+            raise
+        except Exception as e:  # noqa: BLE001 — headers are committed; emit
+            # an SSE error event instead of a second response
+            log.exception("stream %s failed mid-flight", ctx.id)
+            errored = True
+            try:
+                await resp.write(b"event: error\ndata: " +
+                                 json.dumps(repr(e)).encode() + b"\n\n")
+            except (ConnectionError, RuntimeError):
+                pass
+        await resp.write_eof()
+        return resp
+
+    async def _unary(self, req, first, aiter, endpoint: str,
+                     guard) -> web.Response:
+        async def _items():
+            if first is not None:
+                yield first
+            async for chunk in aiter:
+                yield chunk
+
+        if endpoint == "chat_completions":
+            agg = ChatAggregator(req.model)
+            async for chunk in _items():
+                if isinstance(chunk, Annotated) and chunk.is_error:
+                    return _error_response(500, chunk.error_message())
+                data = _chunk_dict(chunk)
+                if data is None:
+                    continue
+                from ..protocols.openai import ChatCompletionChunk
+
+                agg.add_chunk(ChatCompletionChunk(**data))
+            guard.mark_ok()
+            return web.json_response(agg.response().model_dump(exclude_none=True))
+        agg = CompletionAggregator(req.model)
+        async for chunk in _items():
+            if isinstance(chunk, Annotated) and chunk.is_error:
+                return _error_response(500, chunk.error_message())
+            data = _chunk_dict(chunk)
+            if data is None:
+                continue
+            for choice in data.get("choices", []):
+                agg.add_text(choice.get("text", ""), choice.get("finish_reason"))
+            if data.get("usage"):
+                from ..protocols.openai import Usage
+
+                agg.usage = Usage(**data["usage"])
+        guard.mark_ok()
+        return web.json_response(agg.response().model_dump(exclude_none=True))
+
+
+def _chunk_dict(chunk) -> Optional[dict]:
+    """Normalize engine output: pydantic model / Annotated / dict → dict."""
+    if chunk is None:
+        return None
+    if isinstance(chunk, Annotated):
+        if chunk.is_error:
+            return {"event": "error", "comment": chunk.error_message()}
+        if chunk.data is None:
+            return None  # pure annotation/comment event; not an SSE data chunk
+        return chunk.data
+    if hasattr(chunk, "model_dump"):
+        return chunk.model_dump(exclude_none=True)
+    return chunk
+
+
+def _error_response(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error"
+                   if status < 500 else "internal_error", "code": status}},
+        status=status)

@@ -1,0 +1,1 @@
+"""Protocol types: internal (engine-facing) + OpenAI API surface."""

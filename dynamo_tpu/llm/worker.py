@@ -1,0 +1,77 @@
+"""Worker-side model serving: wire a core engine behind the LLM pipeline
+and publish it for frontend discovery.
+
+Reference launch/dynamo-run/src/input/endpoint.rs:35-117 (``in=dyn://``
+worker mode): build ``SegmentSource → OpenAIPreprocessor → Backend →
+engine`` behind an Ingress, then self-register a ``ModelEntry`` (and the
+model deployment card) in the KV store under the worker's lease so the
+frontend's model watcher picks it up — and drops it on lease expiry.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..runtime.engine import Context
+from ..runtime.runtime import DistributedRuntime
+from .engines import LocalChatChain, LocalCompletionChain
+from .entry import ModelEntry, register_model
+from .model_card import ModelDeploymentCard
+from .preprocessor import OpenAIPreprocessor
+from .protocols.openai import ChatCompletionRequest, CompletionRequest
+
+log = logging.getLogger("dynamo_tpu.llm.worker")
+
+
+async def serve_openai_model(
+    drt: DistributedRuntime,
+    mdc: ModelDeploymentCard,
+    core_engine,
+    *,
+    namespace: str = "dynamo",
+    component: Optional[str] = None,
+    endpoint: str = "generate",
+    stats_handler=None,
+    model_type: Optional[str] = None,
+):
+    """Serve ``mdc``'s model with ``core_engine`` (token-level) and register
+    it for discovery. Returns the ServeHandle."""
+    component = component or mdc.name.replace("/", "-").replace(".", "-").lower()
+    preprocessor = OpenAIPreprocessor(mdc)
+    chat_chain = LocalChatChain(mdc, core_engine, preprocessor)
+    completion_chain = LocalCompletionChain(mdc, core_engine, preprocessor)
+
+    async def handler(request: dict, context: Context):
+        # chat requests carry "messages"; completion requests carry "prompt"
+        if "messages" in request:
+            req = ChatCompletionRequest(**request)
+            async for chunk in chat_chain(req, context):
+                yield _to_payload(chunk)
+        else:
+            req = CompletionRequest(**request)
+            async for chunk in completion_chain(req, context):
+                yield _to_payload(chunk)
+
+    comp = drt.namespace(namespace).component(component)
+    await comp.create_service()
+    ep = comp.endpoint(endpoint)
+    handle = await ep.serve(handler, stats_handler=stats_handler)
+
+    await mdc.publish(drt.dcp, lease=drt.primary_lease)
+    mtype = model_type or mdc.model_type
+    entry = ModelEntry(name=mdc.name, endpoint=ep.path, model_type=mtype)
+    await register_model(drt.dcp, entry, lease=drt.primary_lease)
+    log.info("model %r serving at %s (type=%s)", mdc.name, ep.path, mtype)
+    return handle
+
+
+def _to_payload(chunk):
+    """Chunks cross the wire as plain dicts (Annotated pass through)."""
+    from ..runtime.engine import Annotated
+
+    if isinstance(chunk, Annotated):
+        return chunk
+    if hasattr(chunk, "model_dump"):
+        return chunk.model_dump(exclude_none=True)
+    return chunk

@@ -292,7 +292,7 @@ class ServeHandle:
             log.exception("handler failed for %s", req_id)
             if callhome:
                 try:
-                    await callhome.error(repr(e))
+                    await callhome.error(str(e), kind=type(e).__name__)
                 except Exception:
                     pass
         finally:
@@ -318,6 +318,10 @@ class AsyncResponseStream:
             raise StopAsyncIteration
         if isinstance(item, StreamError):
             self._pending.close()
+            # re-raise validation errors with their original type so callers
+            # (e.g. the HTTP frontend) can map them to 4xx responses
+            if item.kind == "ValueError":
+                raise ValueError(item.message)
             raise RuntimeError(f"stream error: {item.message}")
         return Annotated.from_dict(unpack(item))
 

@@ -35,6 +35,7 @@ STREAM_COMPLETE = object()
 @dataclass
 class StreamError:
     message: str
+    kind: str = ""  # exception class name from the worker, if known
 
 
 @dataclass
@@ -173,7 +174,8 @@ class TcpStreamServer:
                     ps.queue.put_nowait(STREAM_COMPLETE)
                     break
                 elif t == "err":
-                    ps.queue.put_nowait(StreamError(msg.header.get("message", "")))
+                    ps.queue.put_nowait(StreamError(msg.header.get("message", ""),
+                                                    msg.header.get("kind", "")))
                     break
                 else:
                     raise ValueError(f"unexpected frame type {t}")
@@ -242,8 +244,9 @@ class TcpCallHome:
     async def complete(self) -> None:
         await self._send(TwoPartMessage({"t": "complete"}))
 
-    async def error(self, message: str) -> None:
-        await self._send(TwoPartMessage({"t": "err", "message": message}))
+    async def error(self, message: str, kind: str = "") -> None:
+        await self._send(TwoPartMessage({"t": "err", "message": message,
+                                         "kind": kind}))
 
     async def close(self) -> None:
         self._ctrl_task.cancel()

@@ -190,7 +190,8 @@ def test_component_end_to_end(run_async):
         stats = await client.collect_stats()
         assert stats[ids[0]]["data"] == {"custom": 7}
 
-        # errors propagate as error Annotated
+        # errors propagate with their original type (ValueError survives the
+        # wire so frontends can map validation errors to 4xx)
         async def failing(request, context):
             yield {"ok": 1}
             raise ValueError("boom")
@@ -200,7 +201,7 @@ def test_component_end_to_end(run_async):
         fclient = await fcomp.endpoint("generate").client()
         await fclient.wait_for_instances()
         stream = await fclient.round_robin({})
-        with pytest.raises(RuntimeError):
+        with pytest.raises(ValueError, match="boom"):
             async for _ in stream:
                 pass
 
