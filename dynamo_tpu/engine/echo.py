@@ -51,11 +51,23 @@ class EchoEngineFull:
     async def generate(self, request, context: Context):
         # request: ChatCompletionRequest-shaped dict or object
         messages = request["messages"] if isinstance(request, dict) else request.messages
+
+        def _text(m) -> str:
+            if not isinstance(m, dict):
+                return m.text()
+            content = m.get("content")
+            if isinstance(content, str):
+                return content
+            if isinstance(content, list):  # OpenAI multipart content
+                return "".join(p.get("text", "") for p in content
+                               if isinstance(p, dict) and p.get("type") == "text")
+            return ""
+
         text = ""
         for m in reversed(messages):
             role = m["role"] if isinstance(m, dict) else m.role
             if role == "user":
-                text = m["content"] if isinstance(m, dict) else m.text()
+                text = _text(m)
                 break
         for word in text.split(" "):
             if context.stopped:

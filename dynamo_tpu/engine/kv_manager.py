@@ -1,0 +1,211 @@
+"""KV page manager: allocation, prefix-cache reuse, eviction, events.
+
+The host-side half of the KV cache (the device-side pool lives in
+models/llama.py). Re-designs three reference components as one coherent
+manager:
+
+- reference ``lib/llm/src/kv/reuse.rs`` (AvailableBlocks: priority+FIFO
+  reuse pool with sequence-hash match-and-reclaim) → ``PageManager``'s
+  reusable pool + ``match_prefix``;
+- reference ``lib/llm/src/tokens.rs`` (TokenBlock chained sequence hashes,
+  xxh3) → ``chain_hashes`` (same chained-hash construction, seed 1337 over
+  LE token bytes, indexer.rs:64,123-135);
+- the vLLM-patch ``event_manager.py`` (KVCacheEventManager publishing
+  stored/removed to the router) → ``drain_events``.
+
+Pages are identified by pool index. A page is either free (never valid),
+active (refcount > 0), or reusable (refcount 0, contents intact, reusable
+by hash until evicted). Evictions pop the least-recently-freed reusable
+page (LRU-FIFO like the reference's priority 0 tier).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import xxhash
+
+HASH_SEED = 1337  # match the reference's block hasher (kv_router/indexer.rs)
+
+
+def hash_block(parent: int, tokens: Sequence[int]) -> int:
+    """Chained block hash: xxh3_64(parent_hash_le || token_le_bytes)."""
+    h = xxhash.xxh3_64(seed=HASH_SEED)
+    h.update(int(parent).to_bytes(8, "little", signed=False))
+    for t in tokens:
+        h.update(int(t).to_bytes(4, "little", signed=False))
+    return h.intdigest()
+
+
+def chain_hashes(token_ids: Sequence[int], page_size: int,
+                 parent: int = 0) -> List[int]:
+    """Sequence hashes for each FULL block of token_ids."""
+    out = []
+    h = parent
+    for i in range(len(token_ids) // page_size):
+        h = hash_block(h, token_ids[i * page_size:(i + 1) * page_size])
+        out.append(h)
+    return out
+
+
+@dataclass
+class KvEvent:
+    """Stored/Removed cache event (reference kv_router/protocols.rs
+    KvCacheEvent)."""
+
+    kind: str                      # "stored" | "removed"
+    block_hashes: List[int]
+    parent_hash: Optional[int] = None
+    token_ids: Optional[List[int]] = None  # for stored: the tokens per block
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "block_hashes": self.block_hashes,
+                "parent_hash": self.parent_hash}
+
+
+@dataclass
+class PageState:
+    refcount: int = 0
+    block_hash: Optional[int] = None  # set when committed (full + hashed)
+
+
+class PageManager:
+    """Host-side page pool bookkeeping with prefix reuse."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        # page 0 is reserved as the padding target in device page tables
+        self.pages: List[PageState] = [PageState() for _ in range(num_pages)]
+        self.free: deque = deque(range(1, num_pages))
+        self.reusable: "OrderedDict[int, None]" = OrderedDict()  # LRU order
+        self.by_hash: Dict[int, int] = {}  # block_hash → page id
+        self.events: List[KvEvent] = []
+        self.pages[0].refcount = 1  # never allocated
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def available(self) -> int:
+        return len(self.free) + len(self.reusable)
+
+    @property
+    def active(self) -> int:
+        return self.num_pages - 1 - self.available
+
+    def usage(self) -> float:
+        return self.active / max(self.num_pages - 1, 1)
+
+    def match_prefix(self, token_ids: Sequence[int]) -> Tuple[List[int], List[int]]:
+        """Longest cached prefix: returns (page_ids, their hashes). Does NOT
+        take references — call ``allocate`` to claim."""
+        pages, hashes = [], []
+        for h in chain_hashes(token_ids, self.page_size):
+            page = self.by_hash.get(h)
+            if page is None:
+                break
+            pages.append(page)
+            hashes.append(h)
+        return pages, hashes
+
+    # ---------------------------------------------------------- allocation
+
+    def allocate_sequence(self, token_ids: Sequence[int],
+                          extra_pages: int = 0) -> Optional[Tuple[List[int], int]]:
+        """Claim pages for a prompt: reuse the longest cached prefix, then
+        fresh pages to cover the prompt (+extra_pages headroom).
+
+        Returns (page_ids, num_cached_tokens) or None if out of memory.
+        The last (partial) block is never matched (reference
+        manager.rs prepare_prefill_sequence semantics).
+        """
+        need_total = (len(token_ids) + self.page_size - 1) // self.page_size \
+            + extra_pages
+        cached_pages, _ = self.match_prefix(token_ids)
+        # full-prompt hit: leave at least the final token to recompute so
+        # prefill produces logits (cap reuse at len-1 tokens)
+        max_reuse = max((len(token_ids) - 1) // self.page_size, 0)
+        cached_pages = cached_pages[:max_reuse]
+        need_fresh = need_total - len(cached_pages)
+        if need_fresh > self.available:
+            return None
+        for p in cached_pages:
+            self._ref(p)
+        fresh = [self._pop_fresh() for _ in range(need_fresh)]
+        return cached_pages + fresh, len(cached_pages) * self.page_size
+
+    def allocate_page(self) -> Optional[int]:
+        """One more page for a growing sequence (decode)."""
+        if self.available == 0:
+            return None
+        return self._pop_fresh()
+
+    def grow(self, pages: List[int], needed_tokens: int) -> bool:
+        """Ensure the page list covers needed_tokens; appends fresh pages.
+        Returns False if out of memory."""
+        while len(pages) * self.page_size < needed_tokens:
+            p = self.allocate_page()
+            if p is None:
+                return False
+            pages.append(p)
+        return True
+
+    def commit(self, page: int, block_hash: int,
+               token_ids: Optional[List[int]] = None,
+               parent_hash: Optional[int] = None) -> None:
+        """Mark a page's contents as a complete, hashed block (prefix-cache
+        publish; emits the stored event for the KV router)."""
+        st = self.pages[page]
+        if st.block_hash == block_hash:
+            return
+        if block_hash in self.by_hash:
+            # another page already holds this block; keep the existing one
+            return
+        st.block_hash = block_hash
+        self.by_hash[block_hash] = page
+        self.events.append(KvEvent("stored", [block_hash],
+                                   parent_hash=parent_hash,
+                                   token_ids=token_ids))
+
+    def release_sequence(self, pages: List[int]) -> None:
+        """Drop one reference on each page; refcount-0 pages become reusable
+        (kept for prefix hits) or free (uncommitted)."""
+        for p in pages:
+            st = self.pages[p]
+            st.refcount -= 1
+            assert st.refcount >= 0, f"double free of page {p}"
+            if st.refcount == 0:
+                if st.block_hash is not None:
+                    self.reusable[p] = None  # most-recently-freed last
+                else:
+                    self.free.append(p)
+
+    # ------------------------------------------------------------- internal
+
+    def _ref(self, page: int) -> None:
+        st = self.pages[page]
+        if st.refcount == 0 and page in self.reusable:
+            del self.reusable[page]
+        st.refcount += 1
+
+    def _pop_fresh(self) -> int:
+        if self.free:
+            page = self.free.popleft()
+        else:
+            page, _ = self.reusable.popitem(last=False)  # evict LRU reusable
+            st = self.pages[page]
+            if st.block_hash is not None:
+                del self.by_hash[st.block_hash]
+                self.events.append(KvEvent("removed", [st.block_hash]))
+                st.block_hash = None
+        st = self.pages[page]
+        assert st.refcount == 0
+        st.refcount = 1
+        return page
+
+    def drain_events(self) -> List[KvEvent]:
+        out, self.events = self.events, []
+        return out

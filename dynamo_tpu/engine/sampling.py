@@ -1,0 +1,95 @@
+"""Batched token sampling (jitted, per-request parameters).
+
+The sampling stage runs on-device right after the forward pass so only the
+sampled token ids (a few bytes per sequence) cross back to the host — the
+TPU-native replacement for the reference engines' sampler (vLLM
+SamplingParams ← our SamplingOptions, lib/llm/src/protocols/common.rs).
+
+Per-row temperature/top-k/top-p live in device arrays so one jitted function
+serves heterogeneous batches (no recompile per request mix). Greedy rows are
+temperature=0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class SamplingBatch:
+    """Per-row sampling parameters, padded to the decode batch size."""
+
+    temperature: np.ndarray  # [B] float32; 0 → greedy
+    top_k: np.ndarray        # [B] int32; 0 → disabled
+    top_p: np.ndarray        # [B] float32; 1.0 → disabled
+    seeds: np.ndarray        # [B] uint32 per-row RNG streams
+
+    @classmethod
+    def build(cls, rows, pad_to: int) -> "SamplingBatch":
+        """rows: list of SamplingOptions-like objects with .temperature,
+        .top_k, .top_p, .seed."""
+        B = pad_to
+        temperature = np.zeros(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        seeds = np.zeros(B, np.uint32)
+        for i, s in enumerate(rows):
+            temperature[i] = s.temperature if s.temperature is not None else 0.0
+            top_k[i] = s.top_k or 0
+            top_p[i] = s.top_p if s.top_p is not None else 1.0
+            seeds[i] = (s.seed if s.seed is not None
+                        else np.random.randint(0, 2**31)) & 0xFFFFFFFF
+        return cls(temperature, top_k, top_p, seeds)
+
+
+@partial(jax.jit, static_argnames=("max_top_k",))
+def sample_tokens(logits: jax.Array, temperature: jax.Array,
+                  top_k: jax.Array, top_p: jax.Array, seeds: jax.Array,
+                  step: jax.Array, max_top_k: int = 64) -> jax.Array:
+    """Sample one token per row. logits: [B, V] float32; ``step`` is a
+    scalar or per-row [B] decode-step counter (advances the RNG stream).
+
+    Greedy rows (temperature==0) take argmax. Sampled rows apply
+    temperature → top-k (static bound ``max_top_k``, per-row effective k) →
+    top-p (nucleus) → categorical draw from a per-row fold_in'd key.
+    """
+    step = jnp.broadcast_to(step, temperature.shape)
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / temp
+
+    # top-k within a static bound: take max_top_k once, mask per-row k
+    k_vals, k_idx = jax.lax.top_k(scaled, max_top_k)  # [B, K]
+    ranks = jnp.arange(max_top_k)[None, :]
+    eff_k = jnp.where(top_k[:, None] > 0,
+                      jnp.minimum(top_k[:, None], max_top_k), max_top_k)
+    k_vals = jnp.where(ranks < eff_k, k_vals, -jnp.inf)
+
+    # top-p over the (sorted) top-k candidates
+    probs = jax.nn.softmax(k_vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]  # always keep the first candidate
+    k_vals = jnp.where(keep, k_vals, -jnp.inf)
+
+    def row_sample(i):
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), seeds[i]), step[i])
+        choice = jax.random.categorical(key, k_vals[i])
+        return k_idx[i, choice]
+
+    sampled = jax.vmap(row_sample)(jnp.arange(B))
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def compute_logprobs(logits: jax.Array, chosen: jax.Array) -> jax.Array:
+    """Log-probability of the chosen tokens: logits [B, V], chosen [B]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return logp[jnp.arange(logits.shape[0]), chosen]

@@ -88,12 +88,15 @@ class Backend:
             context.stop_generating()
             return
 
-        def _final_text(released: str, reason: str) -> str:
+        def _final_text(released: str, stop_seq_hit: bool) -> str:
             """Append held decoder/jail text to the finish-bearing chunk
-            (downstream consumers stop at the first finish_reason)."""
-            if reason == FINISH_STOP:
-                return released  # jail already truncated at the stop seq
-            tail, _ = jail.feed(decode.flush()) if decode else ("", False)
+            (downstream consumers stop at the first finish_reason). When a
+            stop STRING matched, the jail already truncated at the match and
+            held text is intentionally dropped; every other finish (eos,
+            stop TOKEN, length, cancel) must flush held text."""
+            if stop_seq_hit:
+                return released
+            tail, _ = jail.feed(decode.flush())
             return released + tail + jail.flush()
 
         async for raw in _aiter(self.engine.generate(request, context)):
@@ -125,7 +128,7 @@ class Backend:
             out.finish_reason = finished or out.finish_reason
             out.completion_tokens = produced
             if out.finish_reason:
-                out.text = _final_text(released, out.finish_reason)
+                out.text = _final_text(released, stop_seq_hit=hit)
                 yield out
                 context.stop_generating()
                 return
@@ -133,13 +136,13 @@ class Backend:
             yield out
             if context.stopped:
                 context.stop_generating()
-                yield EngineOutput(text=_final_text("", FINISH_CANCELLED) or None,
+                yield EngineOutput(text=_final_text("", False) or None,
                                    finish_reason=FINISH_CANCELLED,
                                    completion_tokens=produced)
                 return
         # engine stream exhausted without a finish reason: flush held text and
         # stamp a terminal reason so downstream never fabricates one
-        yield EngineOutput(token_ids=[], text=_final_text("", FINISH_STOP) or "",
+        yield EngineOutput(token_ids=[], text=_final_text("", False) or "",
                            finish_reason=FINISH_STOP, completion_tokens=produced)
 
 

@@ -18,7 +18,8 @@ from ..runtime.engine import Annotated, Context
 from .backend import Backend
 from .model_card import ModelDeploymentCard
 from .preprocessor import OpenAIPreprocessor
-from .protocols.openai import ChatCompletionRequest, CompletionRequest
+from .protocols.openai import (ChatCompletionRequest, CompletionRequest,
+                               _finish_reason_openai)
 
 log = logging.getLogger("dynamo_tpu.engines")
 
@@ -78,7 +79,8 @@ class LocalCompletionChain:
                     "id": rid, "object": "text_completion", "created": created,
                     "model": request.model,
                     "choices": [{"index": 0, "text": out.text or "",
-                                 "finish_reason": out.finish_reason}],
+                                 "finish_reason":
+                                     _finish_reason_openai(out.finish_reason)}],
                 }
             if out.finish_reason:
                 if request.stream_options and request.stream_options.include_usage:

@@ -62,17 +62,20 @@ class ModelWatcher:
             self.manager.add_chat_model(entry.name, engine)
         if entry.model_type in ("completions", "both"):
             self.manager.add_completions_model(entry.name, engine)
+        old = self._clients.pop(entry.kv_key(), None)
+        if old is not None:  # re-registration (worker restart/card refresh)
+            asyncio.ensure_future(old.close())
         self._clients[entry.kv_key()] = client
         log.info("discovered model %r -> %s", entry.name, entry.endpoint)
 
     def _unregister(self, kv_key: str) -> None:
-        # key: models/<type>/<name>
+        # key: models/<type>/<name> — remove only that type's route
         parts = kv_key[len(MODEL_PREFIX):].split("/", 1)
         if len(parts) != 2:
             return
-        _mtype, name = parts
-        self.manager.remove_model(name)
+        mtype, name = parts
+        self.manager.remove_model(name, model_type=mtype)
         client = self._clients.pop(kv_key, None)
         if client is not None:
             asyncio.ensure_future(client.close())
-        log.info("model %r withdrawn", name)
+        log.info("model %r withdrawn (type=%s)", name, mtype)

@@ -46,10 +46,12 @@ class ModelManager:
         self.completion_engines[name] = engine
         log.info("registered completions model %r", name)
 
-    def remove_model(self, name: str) -> None:
-        self.chat_engines.pop(name, None)
-        self.completion_engines.pop(name, None)
-        log.info("removed model %r", name)
+    def remove_model(self, name: str, model_type: str = "both") -> None:
+        if model_type in ("chat", "both"):
+            self.chat_engines.pop(name, None)
+        if model_type in ("completions", "both"):
+            self.completion_engines.pop(name, None)
+        log.info("removed model %r (type=%s)", name, model_type)
 
     def list_models(self) -> ModelList:
         names = sorted(set(self.chat_engines) | set(self.completion_engines))
@@ -138,6 +140,8 @@ class HttpService:
             return await self._unary(req, first, aiter, endpoint, guard)
         except ValueError as e:
             return _error_response(400, str(e))
+        except (ConnectionResetError, asyncio.CancelledError):
+            raise  # client went away; never answer with a second response
         except Exception as e:  # noqa: BLE001
             log.exception("request %s failed", ctx.id)
             return _error_response(500, repr(e))

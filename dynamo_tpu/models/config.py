@@ -1,0 +1,97 @@
+"""Model configuration.
+
+Covers the Llama family (incl. DeepSeek-R1-Distill-Llama — the reference's
+flagship example model, examples/llm/configs/agg.yaml) and Mixtral-style MoE.
+``from_hf_config`` maps a HuggingFace ``config.json`` dict.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclass
+class ModelConfig:
+    model_type: str = "llama"
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[dict] = None
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    # MoE (Mixtral-style); num_experts=0 → dense
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def jax_dtype(self):
+        return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                "float16": jnp.float16}[self.dtype]
+
+    @classmethod
+    def from_hf_config(cls, cfg: dict) -> "ModelConfig":
+        mt = cfg.get("model_type", "llama")
+        c = cls(
+            model_type="mixtral" if mt == "mixtral" else "llama",
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            num_kv_heads=cfg.get("num_key_value_heads",
+                                 cfg["num_attention_heads"]),
+            head_dim=cfg.get("head_dim"),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling=cfg.get("rope_scaling"),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+        )
+        if mt == "mixtral":
+            c.num_experts = cfg.get("num_local_experts", 8)
+            c.num_experts_per_tok = cfg.get("num_experts_per_tok", 2)
+        return c
+
+    @classmethod
+    def from_local_path(cls, path: str) -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return cls.from_hf_config(json.load(f))
+
+    @classmethod
+    def tiny(cls, **overrides) -> "ModelConfig":
+        """A CPU-testable configuration (vocab matches ByteTokenizer)."""
+        base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                    rope_theta=10000.0, dtype="float32")
+        base.update(overrides)
+        return cls(**base)
+
+    @classmethod
+    def llama3_8b(cls) -> "ModelConfig":
+        return cls()  # defaults above are Llama-3-8B
+
+    @classmethod
+    def llama3_70b(cls) -> "ModelConfig":
+        return cls(hidden_size=8192, intermediate_size=28672, num_layers=80,
+                   num_heads=64, num_kv_heads=8)
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "ModelConfig":
+        return cls(model_type="mixtral", vocab_size=32000, hidden_size=4096,
+                   intermediate_size=14336, num_layers=32, num_heads=32,
+                   num_kv_heads=8, rope_theta=1e6, num_experts=8,
+                   num_experts_per_tok=2)

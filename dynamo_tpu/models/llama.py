@@ -1,0 +1,375 @@
+"""Llama-family model in pure-functional JAX with a paged KV cache.
+
+This is the worker data plane the reference delegates to patched vLLM
+(container/deps/vllm/*-dynamo-kv-disagg-patch.patch) — re-designed TPU-first
+instead of ported:
+
+- layers are stacked on a leading axis and driven by ``lax.scan`` (one
+  layer trace → fast XLA compiles at any depth);
+- the KV cache is a preallocated page pool ``[L, num_pages, page_size,
+  kv_heads, head_dim]`` living in HBM; sequences own pages via page tables
+  (the vLLM paged-KV idea, expressed as JAX gather/scatter so XLA can fuse
+  and shard it);
+- prefill and decode share ONE attention path: write the new K/V into pages
+  (scatter), gather the sequence's pages, masked GQA attention — so chunked
+  prefill, prefix-cache continuation, and decode are the same program at
+  different query lengths;
+- shardings: heads over the "model" mesh axis, batch over "data"
+  (tensor-parallel decode per SURVEY §2.4), applied via NamedSharding on
+  params + cache (see dynamo_tpu/parallel/mesh.py).
+
+All shapes are static under jit; batches/chunks are bucketed and padded by
+the scheduler (dynamo_tpu/engine/scheduler.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .config import ModelConfig
+
+Params = Dict[str, jax.Array]
+
+# scatter sentinel for padded rows: guaranteed out-of-range so mode="drop"
+# discards the write (negative indices would WRAP per numpy semantics)
+DROP_SLOT = 1 << 30
+
+
+# ---------------------------------------------------------------- KV cache
+
+
+@dataclass
+class KVCacheSpec:
+    num_pages: int
+    page_size: int
+
+    def shape(self, cfg: ModelConfig) -> Tuple[int, ...]:
+        return (cfg.num_layers, self.num_pages, self.page_size,
+                cfg.num_kv_heads, cfg.head_dim_)
+
+
+def init_kv_cache(cfg: ModelConfig, spec: KVCacheSpec,
+                  dtype=None) -> Tuple[jax.Array, jax.Array]:
+    shape = spec.shape(cfg)
+    dtype = dtype or cfg.jax_dtype
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+# ------------------------------------------------------------------ params
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
+    """Random-init params (stacked layers on axis 0)."""
+    dtype = dtype or cfg.jax_dtype
+    D, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    V = cfg.vocab_size
+    ks = jax.random.split(key, 10)
+
+    def norm_init(k, *shape):
+        return jnp.ones(shape, dtype)
+
+    def w_init(k, *shape):
+        scale = 1.0 / math.sqrt(shape[-2]) if len(shape) > 1 else 0.02
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    p: Params = {
+        "embed": w_init(ks[0], V, D),
+        "wq": w_init(ks[1], L, D, H * hd),
+        "wk": w_init(ks[2], L, D, KV * hd),
+        "wv": w_init(ks[3], L, D, KV * hd),
+        "wo": w_init(ks[4], L, H * hd, D),
+        "w_gate": w_init(ks[5], L, D, I),
+        "w_up": w_init(ks[6], L, D, I),
+        "w_down": w_init(ks[7], L, I, D),
+        "ln_attn": norm_init(ks[8], L, D),
+        "ln_mlp": norm_init(ks[8], L, D),
+        "ln_final": norm_init(ks[8], D),
+    }
+    if not cfg.tie_word_embeddings:
+        p["lm_head"] = w_init(ks[9], D, V)
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        p["w_router"] = w_init(ks[5], L, D, E)
+        p["w_gate"] = w_init(ks[5], L, E, D, I)
+        p["w_up"] = w_init(ks[6], L, E, D, I)
+        p["w_down"] = w_init(ks[7], L, E, I, D)
+    return p
+
+
+# -------------------------------------------------------------- primitives
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope_freqs(cfg: ModelConfig) -> jax.Array:
+    hd = cfg.head_dim_
+    inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, jnp.float32) / hd))
+    scaling = cfg.rope_scaling or {}
+    if scaling.get("rope_type") == "llama3" or scaling.get("type") == "llama3":
+        # Llama-3.1-style NTK-by-parts frequency rescaling: low frequencies
+        # are divided by `factor`, high frequencies kept, mid smoothly mixed
+        factor = scaling.get("factor", 8.0)
+        low = scaling.get("low_freq_factor", 1.0)
+        high = scaling.get("high_freq_factor", 4.0)
+        orig = scaling.get("original_max_position_embeddings", 8192)
+        wavelen = 2 * jnp.pi / inv
+        smooth = jnp.clip((orig / wavelen - low) / (high - low), 0.0, 1.0)
+        inv = jnp.where(wavelen > orig / low, inv / factor,
+                        jnp.where(wavelen < orig / high, inv,
+                                  (1 - smooth) * inv / factor + smooth * inv))
+    return inv
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               inv_freq: jax.Array) -> jax.Array:
+    """x: [..., T, heads, head_dim]; positions: [..., T]."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [...,T,hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _scatter_pages(cache_layer: jax.Array, new: jax.Array,
+                   flat_slots: jax.Array) -> jax.Array:
+    """Write new K/V rows into the page pool.
+
+    cache_layer: [num_pages, page_size, KV, hd]; new: [B, T, KV, hd];
+    flat_slots: [B, T] flattened (page*page_size + slot) indices; indices
+    >= num_pages*page_size (use DROP_SLOT) are dropped (negative indices
+    would wrap, so padding must use the out-of-range sentinel).
+    (TPU-native replacement for the reference's block_copy.cu CUDA kernel —
+    an XLA scatter the compiler lays out on the VPU.)
+    """
+    np_, ps, kv, hd = cache_layer.shape
+    flat = cache_layer.reshape(np_ * ps, kv, hd)
+    idx = flat_slots.reshape(-1)
+    rows = new.reshape(-1, kv, hd).astype(flat.dtype)
+    flat = flat.at[idx].set(rows, mode="drop")
+    return flat.reshape(np_, ps, kv, hd)
+
+
+def _paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                     page_table: jax.Array, q_positions: jax.Array,
+                     scale: float) -> jax.Array:
+    """Gather-based paged GQA attention (XLA path; the Pallas kernel in
+    dynamo_tpu/ops/paged_attention.py replaces this on TPU hot paths).
+
+    q: [B, T, H, hd]; k_pages/v_pages: [num_pages, ps, KV, hd];
+    page_table: [B, P]; q_positions: [B, T] (absolute, -1 for padding).
+    Attends to logical positions j <= q_position (causal over the whole
+    cached sequence, which includes the just-written chunk).
+    """
+    B, T, H, hd = q.shape
+    _, ps, KV, _ = k_pages.shape
+    P = page_table.shape[1]
+    S = P * ps
+    group = H // KV
+
+    k = k_pages[page_table]  # [B, P, ps, KV, hd]
+    v = v_pages[page_table]
+    k = k.reshape(B, S, KV, hd)
+    v = v.reshape(B, S, KV, hd)
+
+    qg = q.reshape(B, T, KV, group, hd)
+    scores = jnp.einsum("btkgh,bskh->bkgts", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    # mask [B, T, S]: slot j (logical position) visible iff j <= query pos
+    mask = (jnp.arange(S)[None, None, :] <= q_positions[:, :, None])
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs, v.astype(jnp.float32))
+    return out.reshape(B, T, H, hd).astype(q.dtype)
+
+
+# ------------------------------------------------------------ forward pass
+
+
+def _mlp(h: jax.Array, w_gate, w_up, w_down) -> jax.Array:
+    return (jax.nn.silu(h @ w_gate) * (h @ w_up)) @ w_down
+
+
+def _moe_mlp(h: jax.Array, w_router, w_gate, w_up, w_down,
+             top_k: int) -> jax.Array:
+    """Mixtral-style MoE MLP: token-choice top-k routing, computed as a
+    dense einsum over all experts weighted by the routing mask (TPU-friendly:
+    static shapes, MXU-dominated; expert-parallel sharding splits the E axis
+    over the "expert"/"model" mesh axis)."""
+    B, T, D = h.shape
+    E = w_router.shape[-1]
+    logits = (h @ w_router).astype(jnp.float32)  # [B, T, E]
+    weights, idx = lax.top_k(logits, top_k)  # [B, T, k]
+    weights = jax.nn.softmax(weights, axis=-1)
+    full_gate = jnp.sum(
+        jax.nn.one_hot(idx, E, dtype=jnp.float32) * weights[..., None], axis=2)
+    # dense-over-experts: out = sum_e gate[...,e] * mlp_e(h)
+    ge = jnp.einsum("btd,edi->btei", h.astype(jnp.float32),
+                    w_gate.astype(jnp.float32))
+    up = jnp.einsum("btd,edi->btei", h.astype(jnp.float32),
+                    w_up.astype(jnp.float32))
+    act = jax.nn.silu(ge) * up
+    down = jnp.einsum("btei,eid->bted", act, w_down.astype(jnp.float32))
+    out = jnp.einsum("bted,bte->btd", down, full_gate)
+    return out.astype(h.dtype)
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            positions: jax.Array, kv_k: jax.Array, kv_v: jax.Array,
+            page_table: jax.Array, flat_slots: jax.Array,
+            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared prefill/decode forward.
+
+    tokens: [B, T] (T=1 for decode); positions: [B, T] absolute positions
+    (-1 for padding rows); page_table: [B, P]; flat_slots: [B, T] cache
+    write slots (page*page_size + offset, -1 to drop padding).
+
+    Returns (hidden [B, T, D], new_kv_k, new_kv_v).
+    """
+    inv_freq = rope_freqs(cfg)
+    scale = 1.0 / math.sqrt(cfg.head_dim_)
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    B, T = tokens.shape
+
+    h = params["embed"][tokens]  # [B, T, D]
+    safe_pos = jnp.maximum(positions, 0)
+
+    layer_params = {k: params[k] for k in
+                    ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                     "ln_attn", "ln_mlp")}
+    if cfg.num_experts > 0:
+        layer_params["w_router"] = params["w_router"]
+
+    def layer(h, xs):
+        lp, k_layer, v_layer = xs
+        x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, T, H, hd)
+        k = (x @ lp["wk"]).reshape(B, T, KV, hd)
+        v = (x @ lp["wv"]).reshape(B, T, KV, hd)
+        q = apply_rope(q, safe_pos, inv_freq)
+        k = apply_rope(k, safe_pos, inv_freq)
+        k_layer = _scatter_pages(k_layer, k, flat_slots)
+        v_layer = _scatter_pages(v_layer, v, flat_slots)
+        attn = _paged_attention(q, k_layer, v_layer, page_table, positions,
+                                scale)
+        h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
+        x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+        if cfg.num_experts > 0:
+            h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
+                             lp["w_down"], cfg.num_experts_per_tok)
+        else:
+            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return h, (k_layer, v_layer)
+
+    h, (new_k, new_v) = lax.scan(layer, h, (layer_params, kv_k, kv_v))
+    h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+    return h, new_k, new_v
+
+
+def logits_at(params: Params, cfg: ModelConfig, hidden: jax.Array,
+              gather_idx: jax.Array) -> jax.Array:
+    """LM head at selected positions. hidden: [B, T, D];
+    gather_idx: [B] position per row → logits [B, V] (float32)."""
+    B = hidden.shape[0]
+    h_last = hidden[jnp.arange(B), gather_idx]  # [B, D]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (h_last @ head).astype(jnp.float32)
+
+
+# ----------------------------------------------------- jitted entry points
+
+
+def make_step_fns(cfg: ModelConfig):
+    """Build the jitted (prefill_step, decode_step) pair for one config.
+
+    Closures instead of static args because ModelConfig holds dicts
+    (rope_scaling). KV buffers are donated so XLA updates pages in place.
+    """
+
+    @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
+    def prefill_step(params: Params, tokens: jax.Array, positions: jax.Array,
+                     kv_k: jax.Array, kv_v: jax.Array, page_table: jax.Array,
+                     flat_slots: jax.Array, last_idx: jax.Array):
+        """Process prompt chunks [B, T]; returns (logits [B, V], kv_k, kv_v)."""
+        h, kv_k2, kv_v2 = forward(params, cfg, tokens, positions, kv_k, kv_v,
+                                  page_table, flat_slots)
+        return logits_at(params, cfg, h, last_idx), kv_k2, kv_v2
+
+    @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
+    def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
+                    kv_k: jax.Array, kv_v: jax.Array, page_table: jax.Array,
+                    flat_slots: jax.Array):
+        """One decode step: tokens [B], positions [B] →
+        (logits [B, V], kv_k, kv_v)."""
+        h, kv_k2, kv_v2 = forward(params, cfg, tokens[:, None],
+                                  positions[:, None], kv_k, kv_v,
+                                  page_table, flat_slots[:, None])
+        return (logits_at(params, cfg, h,
+                          jnp.zeros(tokens.shape[0], jnp.int32)),
+                kv_k2, kv_v2)
+
+    return prefill_step, decode_step
+
+
+# -------------------------------------------------- full-attention reference
+
+
+def reference_forward(params: Params, cfg: ModelConfig,
+                      tokens: jax.Array) -> jax.Array:
+    """Plain full-attention forward (no paging) used to validate the paged
+    path in tests; returns logits for every position [B, T, V]."""
+    B, T = tokens.shape
+    inv_freq = rope_freqs(cfg)
+    scale = 1.0 / math.sqrt(cfg.head_dim_)
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    h = params["embed"][tokens]
+
+    layer_params = {k: params[k] for k in
+                    ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                     "ln_attn", "ln_mlp")}
+    if cfg.num_experts > 0:
+        layer_params["w_router"] = params["w_router"]
+
+    def layer(h, lp):
+        x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+        q = apply_rope((x @ lp["wq"]).reshape(B, T, H, hd), pos, inv_freq)
+        k = apply_rope((x @ lp["wk"]).reshape(B, T, KV, hd), pos, inv_freq)
+        v = (x @ lp["wv"]).reshape(B, T, KV, hd)
+        qg = q.reshape(B, T, KV, H // KV, hd)
+        scores = jnp.einsum("btkgh,bskh->bkgts", qg.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(causal[None, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bkgts,bskh->btkgh", probs, v.astype(jnp.float32))
+        attn = attn.reshape(B, T, H * hd).astype(h.dtype)
+        h = h + attn @ lp["wo"]
+        x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+        if cfg.num_experts > 0:
+            h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
+                             lp["w_down"], cfg.num_experts_per_tok)
+        else:
+            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return h, None
+
+    h, _ = lax.scan(layer, h, layer_params)
+    h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (h @ head).astype(jnp.float32)

@@ -1,0 +1,94 @@
+"""Weight loading from local HF-style checkpoints (safetensors).
+
+Maps HuggingFace Llama/Mixtral parameter names onto this framework's
+stacked-layer layout (models/llama.py). HF ``nn.Linear`` stores ``[out, in]``
+weights; our matmuls are ``x @ W`` so every projection is transposed once at
+load time (cheaper than transposing per step).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+
+def _index(path: str) -> Dict[str, str]:
+    """tensor name → shard file, from the safetensors index (or single file)."""
+    idx_path = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(idx_path):
+        with open(idx_path) as f:
+            return json.load(f)["weight_map"]
+    single = os.path.join(path, "model.safetensors")
+    if os.path.exists(single):
+        from safetensors import safe_open
+
+        with safe_open(single, framework="np") as f:
+            return {k: "model.safetensors" for k in f.keys()}
+    raise FileNotFoundError(f"no safetensors checkpoint under {path}")
+
+
+def load_params(path: str, cfg: Optional[ModelConfig] = None,
+                dtype=None) -> Dict[str, jax.Array]:
+    """Load and restack a local HF checkpoint; returns the params pytree."""
+    from safetensors import safe_open
+
+    cfg = cfg or ModelConfig.from_local_path(path)
+    dtype = dtype or cfg.jax_dtype
+    wmap = _index(path)
+    handles: Dict[str, "safe_open"] = {}
+
+    def get(name: str) -> np.ndarray:
+        fname = wmap[name]
+        if fname not in handles:
+            handles[fname] = safe_open(os.path.join(path, fname),
+                                       framework="np")
+        return handles[fname].get_tensor(name)
+
+    def linear(name: str) -> np.ndarray:
+        return np.ascontiguousarray(get(name).T)  # [out,in] → [in,out]
+
+    L = cfg.num_layers
+    p: Dict[str, np.ndarray] = {
+        "embed": get("model.embed_tokens.weight"),
+        "ln_final": get("model.norm.weight"),
+    }
+    if not cfg.tie_word_embeddings:
+        p["lm_head"] = linear("lm_head.weight")
+
+    def stack(fmt: str, fn=linear) -> np.ndarray:
+        return np.stack([fn(fmt.format(i)) for i in range(L)])
+
+    p["wq"] = stack("model.layers.{}.self_attn.q_proj.weight")
+    p["wk"] = stack("model.layers.{}.self_attn.k_proj.weight")
+    p["wv"] = stack("model.layers.{}.self_attn.v_proj.weight")
+    p["wo"] = stack("model.layers.{}.self_attn.o_proj.weight")
+    p["ln_attn"] = stack("model.layers.{}.input_layernorm.weight", get)
+    p["ln_mlp"] = stack("model.layers.{}.post_attention_layernorm.weight", get)
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        p["w_router"] = stack(
+            "model.layers.{}.block_sparse_moe.gate.weight")
+
+        def experts(proj: str) -> np.ndarray:
+            return np.stack([
+                np.stack([linear(
+                    f"model.layers.{i}.block_sparse_moe.experts.{e}.{proj}.weight")
+                    for e in range(E)])
+                for i in range(L)])
+
+        p["w_gate"] = experts("w1")
+        p["w_up"] = experts("w3")
+        p["w_down"] = experts("w2")
+    else:
+        p["w_gate"] = stack("model.layers.{}.mlp.gate_proj.weight")
+        p["w_up"] = stack("model.layers.{}.mlp.up_proj.weight")
+        p["w_down"] = stack("model.layers.{}.mlp.down_proj.weight")
+
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), p)

@@ -318,11 +318,12 @@ class AsyncResponseStream:
             raise StopAsyncIteration
         if isinstance(item, StreamError):
             self._pending.close()
-            # re-raise validation errors with their original type so callers
-            # (e.g. the HTTP frontend) can map them to 4xx responses
-            if item.kind == "ValueError":
+            # client-error kinds re-raise as ValueError so frontends map
+            # them to 4xx; everything else is a server-side RuntimeError
+            if item.kind in ("ValueError", "ValidationError"):
                 raise ValueError(item.message)
-            raise RuntimeError(f"stream error: {item.message}")
+            raise RuntimeError(
+                f"stream error ({item.kind or 'unknown'}): {item.message}")
         return Annotated.from_dict(unpack(item))
 
     async def stop_generating(self) -> None:

@@ -3,11 +3,20 @@ semantics are testable without TPU hardware (SURVEY.md §4 TPU test plan)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the ambient environment points at a TPU (JAX_PLATFORMS
+# is pre-set to the TPU platform in the serving image); set DYN_TEST_TPU=1 to
+# run the suite against real hardware instead
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+if not os.environ.get("DYN_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    # the TPU platform plugin overrides JAX_PLATFORMS in jax.config; force
+    # it back before the backend initializes
+    jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 
