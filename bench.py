@@ -1,0 +1,187 @@
+"""Serving benchmark — the framework's north-star measurement harness.
+
+Reproduces the reference's batch-mode benchmarking (launch/dynamo-run
+input/batch.rs:42-105: per-request tokens_in/tokens_out/elapsed + aggregate
+throughput) against this framework's serving chain: OpenAIPreprocessor →
+Backend → JaxEngine (continuous batching, paged KV, prefix cache).
+
+Workload: ShareGPT-like synthetic conversations (lognormal ISL centered
+~512, OSL ~128) issued concurrently. Reports output-token throughput as the
+headline metric plus req/s and p50/p99 TTFT & ITL, and prints the ONE JSON
+line the driver records.
+
+Run on the real TPU chip (default) or CPU smoke mode:
+    python bench.py [--requests N] [--concurrency N] [--cpu] [--model 1b|tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def parse_args():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--isl", type=int, default=512, help="mean input len")
+    ap.add_argument("--osl", type=int, default=128, help="output len")
+    ap.add_argument("--cpu", action="store_true", help="CPU smoke mode")
+    ap.add_argument("--model", default="1b", choices=["1b", "tiny"])
+    ap.add_argument("--seed", type=int, default=0)
+    return ap.parse_args()
+
+
+def build_engine(args):
+    import jax
+
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+
+    if args.model == "tiny":
+        cfg = ModelConfig.tiny()
+        ecfg = EngineConfig(page_size=16, num_pages=256, max_batch=16,
+                            prefill_chunk=128, prefill_buckets=(128,),
+                            batch_buckets=(4, 16), page_buckets=(16,))
+    else:
+        # Llama-3.2-1B-shaped: ~2.5 GB bf16 params + KV pool on one v5e chip
+        cfg = ModelConfig(vocab_size=128256, hidden_size=2048,
+                          intermediate_size=8192, num_layers=16,
+                          num_heads=32, num_kv_heads=8, head_dim=64,
+                          dtype="bfloat16")
+        # KV pool: 2048 pages x 64 tok = 128K cached tokens
+        # (2*16L*2048p*64t*8h*64d*2B ≈ 4.3 GB)
+        ecfg = EngineConfig(page_size=64, num_pages=2048, max_batch=32,
+                            prefill_chunk=1024, prefill_buckets=(1024,),
+                            batch_buckets=(8, 32), page_buckets=(32,))
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    engine = JaxEngine(cfg, ecfg, seed=args.seed)
+    return engine, cfg
+
+
+def synth_requests(args, vocab: int):
+    """ShareGPT-like synthetic prompts: lognormal input lengths."""
+    import numpy as np
+
+    rng = np.random.RandomState(args.seed)
+    reqs = []
+    for i in range(args.requests):
+        isl = int(np.clip(rng.lognormal(mean=np.log(args.isl), sigma=0.6),
+                          32, 3072))
+        token_ids = rng.randint(1, min(vocab - 10, 255), size=isl).tolist()
+        reqs.append((token_ids, args.osl))
+    return reqs
+
+
+async def run_bench(args):
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.runtime.engine import Context
+
+    engine, cfg = build_engine(args)
+    print("warming up (compiling bucket grid)...", file=sys.stderr)
+    t0 = time.monotonic()
+    engine.warmup()
+    print(f"warmup done in {time.monotonic()-t0:.1f}s", file=sys.stderr)
+
+    reqs = synth_requests(args, cfg.vocab_size)
+    sem = asyncio.Semaphore(args.concurrency)
+    results = []
+
+    async def one(req_idx, token_ids, osl):
+        async with sem:
+            pre = PreprocessedRequest(
+                token_ids=token_ids,
+                sampling=SamplingOptions(),  # greedy
+                stop=StopConditions(max_tokens=osl, ignore_eos=True),
+                eos_token_ids=[])
+            ctx = Context()
+            t_start = time.monotonic()
+            t_first = None
+            stamps = []
+            n_out = 0
+            async for out in engine.generate(pre, ctx):
+                now = time.monotonic()
+                if out.token_ids:
+                    if t_first is None:
+                        t_first = now
+                    stamps.extend([now] * len(out.token_ids))
+                    n_out += len(out.token_ids)
+                if out.finish_reason:
+                    break
+            t_end = time.monotonic()
+            itls = [b - a for a, b in zip(stamps, stamps[1:])]
+            results.append({
+                "tokens_in": len(token_ids), "tokens_out": n_out,
+                "ttft": (t_first - t_start) if t_first else None,
+                "elapsed": t_end - t_start, "itls": itls,
+            })
+
+    bench_t0 = time.monotonic()
+    await asyncio.gather(*(one(i, t, o) for i, (t, o) in enumerate(reqs)))
+    wall = time.monotonic() - bench_t0
+    await engine.stop()
+
+    total_out = sum(r["tokens_out"] for r in results)
+    total_in = sum(r["tokens_in"] for r in results)
+    ttfts = sorted(r["ttft"] for r in results if r["ttft"] is not None)
+    itls = sorted(x for r in results for x in r["itls"])
+
+    def pct(v, p):
+        return v[min(int(len(v) * p / 100), len(v) - 1)] if v else None
+
+    report = {
+        "requests": len(results), "wall_s": round(wall, 3),
+        "req_per_s": round(len(results) / wall, 3),
+        "output_tok_per_s": round(total_out / wall, 1),
+        "total_tok_per_s": round((total_in + total_out) / wall, 1),
+        "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 1) if ttfts else None,
+        "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 1) if ttfts else None,
+        "itl_p50_ms": round(pct(itls, 50) * 1000, 2) if itls else None,
+        "itl_p99_ms": round(pct(itls, 99) * 1000, 2) if itls else None,
+        "prefix_hit_rate": round(engine.stats()["gpu_prefix_cache_hit_rate"], 4),
+    }
+    print(json.dumps(report), file=sys.stderr)
+    return report
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    report = asyncio.run(run_bench(args))
+    # the ONE line the driver records (vs_baseline: reference publishes no
+    # absolute numbers — BASELINE.json.published == {} — so round-over-round
+    # ratio starts at 1.0)
+    prev = None
+    for path in ("BENCH_prev.json",):
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    prev = json.load(f).get("value")
+            except Exception:
+                prev = None
+    value = report["output_tok_per_s"]
+    vs = round(value / prev, 3) if prev else 1.0
+    print(json.dumps({
+        "metric": "output tokens/s, synthetic ShareGPT "
+                  f"(ISL~{args.isl}/OSL {args.osl}, {args.requests} reqs, "
+                  f"conc {args.concurrency}, {args.model} llama, 1 chip)",
+        "value": value,
+        "unit": "tok/s",
+        "vs_baseline": vs,
+        "detail": report,
+    }))
+
+
+if __name__ == "__main__":
+    main()

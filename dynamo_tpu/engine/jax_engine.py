@@ -54,27 +54,32 @@ class EngineConfig:
     max_batch: int = 64
     prefill_chunk: int = 512
     max_top_k: int = 64
-    # bucketing (static shapes under jit)
+    # bucketing (static shapes under jit); keep these sets SMALL — every
+    # (bucket combination) is one XLA compile, and warmup() pre-compiles
+    # the full grid so serving never compiles mid-flight
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+    prefill_buckets: Tuple[int, ...] = (16, 64, 512)
+    page_buckets: Tuple[int, ...] = (8, 64)
     watermark_pages: int = 4  # keep-free headroom before admitting
 
-    def bucket_batch(self, n: int) -> int:
-        for b in self.batch_buckets:
+    @staticmethod
+    def _pick(buckets: Tuple[int, ...], n: int) -> int:
+        for b in buckets:
             if n <= b:
                 return b
-        return self.batch_buckets[-1]
-
-    def bucket_len(self, n: int) -> int:
-        b = 16
-        while b < n:
-            b *= 2
-        return min(b, self.prefill_chunk)
-
-    def bucket_pages(self, n: int) -> int:
-        b = 8
+        b = buckets[-1]
         while b < n:
             b *= 2
         return b
+
+    def bucket_batch(self, n: int) -> int:
+        return min(self._pick(self.batch_buckets, n), self.max_batch)
+
+    def bucket_len(self, n: int) -> int:
+        return min(self._pick(self.prefill_buckets, n), self.prefill_chunk)
+
+    def bucket_pages(self, n: int) -> int:
+        return self._pick(self.page_buckets, n)
 
 
 @dataclass
@@ -135,6 +140,43 @@ class JaxEngine:
         self.prompt_tokens_total = 0
 
     # ---------------------------------------------------------- lifecycle
+
+    def warmup(self, progress: bool = False) -> int:
+        """Pre-compile the full bucket grid (prefill T×P, decode B×P,
+        sampling per B) so no compile ever happens mid-serving — a
+        mid-flight compile stalls every in-flight request for the compile
+        latency. Returns the number of programs compiled."""
+        ecfg = self.ecfg
+        page_buckets = [p for p in ecfg.page_buckets] or [8]
+        t0 = time.monotonic()
+        n = 0
+        for P in page_buckets:
+            table = jnp.zeros((1, P), jnp.int32)
+            for T in {ecfg.bucket_len(t) for t in ecfg.prefill_buckets}:
+                logits, self.kv_k, self.kv_v = self.prefill_fn(
+                    self.params, jnp.zeros((1, T), jnp.int32),
+                    jnp.zeros((1, T), jnp.int32) - 1, self.kv_k, self.kv_v,
+                    table, jnp.full((1, T), DROP_SLOT, jnp.int32),
+                    jnp.zeros((1,), jnp.int32))
+                n += 1
+            for B in {ecfg.bucket_batch(b) for b in ecfg.batch_buckets}:
+                tableB = jnp.zeros((B, P), jnp.int32)
+                logits, self.kv_k, self.kv_v = self.decode_fn(
+                    self.params, jnp.zeros(B, jnp.int32),
+                    jnp.zeros(B, jnp.int32) - 1, self.kv_k, self.kv_v,
+                    tableB, jnp.full((B,), DROP_SLOT, jnp.int32))
+                sample_tokens(logits, jnp.zeros(B), jnp.zeros(B, jnp.int32),
+                              jnp.ones(B), jnp.zeros(B, jnp.uint32),
+                              jnp.zeros(B, jnp.int32),
+                              max_top_k=ecfg.max_top_k)
+                n += 1
+                if progress:
+                    print(f"warmup: {n} programs, {time.monotonic()-t0:.0f}s",
+                          flush=True)
+        jax.block_until_ready(self.kv_k)
+        log.info("warmup compiled %d programs in %.1fs", n,
+                 time.monotonic() - t0)
+        return n
 
     def start(self) -> None:
         if self._loop_task is None:
@@ -340,7 +382,7 @@ class JaxEngine:
         logits, self.kv_k, self.kv_v = self.decode_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots))
-        sampled = self._sample(batch, logits[:len(batch)])
+        sampled = self._sample(batch, logits)
         self.steps += 1
         self.decode_tokens_total += len(batch)
         for seq, tok in zip(batch, sampled):
@@ -349,13 +391,17 @@ class JaxEngine:
     # ------------------------------------------------------------- helpers
 
     def _sample(self, seqs: List[Sequence], logits) -> np.ndarray:
-        sb = SamplingBatch.build([s.req.sampling for s in seqs], len(seqs))
-        steps = np.asarray([s.generated for s in seqs], np.int32)
+        """logits: [B_padded, V] (bucketed); pads sampling params to match
+        so every distinct batch bucket compiles exactly once."""
+        pad_to = logits.shape[0]
+        sb = SamplingBatch.build([s.req.sampling for s in seqs], pad_to)
+        steps = np.zeros(pad_to, np.int32)
+        steps[:len(seqs)] = [s.generated for s in seqs]
         toks = sample_tokens(logits, jnp.asarray(sb.temperature),
                              jnp.asarray(sb.top_k), jnp.asarray(sb.top_p),
                              jnp.asarray(sb.seeds), jnp.asarray(steps),
                              max_top_k=self.ecfg.max_top_k)
-        return np.asarray(toks)  # host sync (inside executor thread)
+        return np.asarray(toks)[:len(seqs)]  # host sync (executor thread)
 
     def _append_token(self, seq: Sequence, tok: int) -> None:
         """Record a generated token: emit, check termination, commit pages."""

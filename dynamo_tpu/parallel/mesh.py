@@ -1,0 +1,126 @@
+"""Device mesh construction + sharding specs.
+
+The TPU-native replacement for the reference's engine-delegated parallelism
+(SURVEY §2.4: vLLM `--tensor-parallel-size` + Ray head/follower for
+multi-node TP, engines/vllm/ray.rs; SGLang per-rank subprocesses): here one
+worker = one SPMD program over a ``jax.sharding.Mesh``, and GSPMD inserts
+the collectives that NCCL calls performed in the reference.
+
+Axes:
+- ``data``  — batch rows (independent sequences; DP within one engine)
+- ``model`` — tensor parallelism: attention heads / MLP hidden / vocab
+- ``expert``— MoE expert parallelism (falls back onto ``model`` when absent)
+
+Multi-host: ``initialize_multihost`` wraps ``jax.distributed.initialize``
+(coordinator address per slice — the Ray replacement; SURVEY §5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+
+@dataclass
+class MeshSpec:
+    data: int = 1
+    model: int = 1
+    expert: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.model * self.expert
+
+    def build(self, devices=None) -> Mesh:
+        devices = devices if devices is not None else jax.devices()
+        if len(devices) < self.num_devices:
+            raise ValueError(
+                f"mesh needs {self.num_devices} devices, have {len(devices)}")
+        devs = np.asarray(devices[: self.num_devices]).reshape(
+            self.data, self.expert, self.model)
+        return Mesh(devs, ("data", "expert", "model"))
+
+    @classmethod
+    def single(cls) -> "MeshSpec":
+        return cls()
+
+
+def initialize_multihost(coordinator: str, num_processes: int,
+                         process_id: int) -> None:
+    """Join a multi-host SPMD group (replaces the reference's Ray/torch-dist
+    bootstrap, engines/vllm/ray.rs + sglang MultiGPUConfig)."""
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
+    """PartitionSpecs for the params pytree (megatron-style TP):
+    column-parallel qkv/gate/up, row-parallel o/down, vocab-sharded
+    embed/lm_head; GSPMD derives the psums."""
+    specs: Dict[str, P] = {
+        "embed": P("model", None),          # vocab-sharded
+        "wq": P(None, None, "model"),
+        "wk": P(None, None, "model"),
+        "wv": P(None, None, "model"),
+        "wo": P(None, "model", None),
+        "ln_attn": P(None, None),
+        "ln_mlp": P(None, None),
+        "ln_final": P(None),
+        "lm_head": P(None, "model"),
+    }
+    if cfg.num_experts > 0:
+        specs.update({
+            "w_router": P(None, None, None),
+            # experts sharded over the expert axis; per-expert matrices
+            # additionally TP-sharded over model
+            "w_gate": P(None, "expert", None, "model"),
+            "w_up": P(None, "expert", None, "model"),
+            "w_down": P(None, "expert", "model", None),
+        })
+    else:
+        specs.update({
+            "w_gate": P(None, None, "model"),
+            "w_up": P(None, None, "model"),
+            "w_down": P(None, "model", None),
+        })
+    return specs
+
+
+def kv_cache_pspec(cfg: ModelConfig) -> P:
+    """KV pool [L, pages, page_size, kv_heads, head_dim]: heads over
+    "model" (requires kv_heads % model_parallel == 0 — true for Llama-3
+    8B/70B GQA at TP<=8); replicated over "data" so any data row can
+    reference any page."""
+    return P(None, None, None, "model", None)
+
+
+def shard_params(params, cfg: ModelConfig, mesh: Mesh):
+    specs = param_pspecs(cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def shard_kv_cache(kv_k, kv_v, cfg: ModelConfig, mesh: Mesh):
+    s = NamedSharding(mesh, kv_cache_pspec(cfg))
+    return jax.device_put(kv_k, s), jax.device_put(kv_v, s)
+
+
+def shard_batch(mesh: Mesh, **arrays):
+    """device_put step inputs sharded batch-first over "data" (every
+    per-step array — tokens/positions/page_table/flat_slots/last_idx — has
+    the batch as its leading axis); returns dict keyed by name."""
+    import jax.numpy as jnp
+
+    out = {}
+    for name, arr in arrays.items():
+        arr = jnp.asarray(arr)
+        spec = P("data", *([None] * (arr.ndim - 1))) if arr.ndim else P()
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out
