@@ -1,0 +1,8 @@
+"""KV-cache-aware routing (reference lib/llm/src/kv_router/)."""
+
+from .indexer import KvIndexer, OverlapScores, RadixTree
+from .protocols import (ForwardPassMetrics, KVHitRateEvent, KvCacheEventWire,
+                        KV_EVENT_SUBJECT, KV_HIT_RATE_SUBJECT)
+from .publisher import KvEventPublisher
+from .router import KvRouter
+from .scheduler import KvScheduler, WorkerState

@@ -1,0 +1,69 @@
+"""KV-router wire protocols.
+
+Reference lib/llm/src/kv_router/protocols.rs:18-97: ``ForwardPassMetrics``
+(worker load snapshot), ``KvCacheEvent`` (Stored/Removed block updates),
+and the hit-rate event emitted per routing decision (scheduler.rs:27-32).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+KV_EVENT_SUBJECT = "kv_events"       # published under <ns>.<component>.
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"  # router observability events
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Per-worker load snapshot (reference protocols.rs:18-30)."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ForwardPassMetrics":
+        known = {f for f in cls.__dataclass_fields__}  # type: ignore[attr-defined]
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class KvCacheEventWire:
+    """Stored/Removed event as published on the bus (reference
+    protocols.rs KvCacheEvent + the worker id tag added on receive)."""
+
+    worker_id: int
+    kind: str                        # "stored" | "removed"
+    block_hashes: List[int]
+    parent_hash: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {"worker_id": self.worker_id, "kind": self.kind,
+                "block_hashes": self.block_hashes,
+                "parent_hash": self.parent_hash}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KvCacheEventWire":
+        return cls(worker_id=d["worker_id"], kind=d["kind"],
+                   block_hashes=list(d["block_hashes"]),
+                   parent_hash=d.get("parent_hash"))
+
+
+@dataclass
+class KVHitRateEvent:
+    """Per-decision observability event (reference scheduler.rs:27-32)."""
+
+    worker_id: int
+    isl_blocks: int
+    overlap_blocks: int
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)

@@ -1,0 +1,64 @@
+"""Worker-side KV event + metrics publishing.
+
+Reference lib/llm/src/kv_router/publisher.rs:33-137 (KvEventPublisher →
+NATS ``kv_events``; KvMetricsPublisher → ``load_metrics`` endpoint + stats)
+and the vLLM-patch ``event_manager.py`` → C FFI path the reference needs to
+get events OUT of the engine process. Here the engine is in-process, so the
+publisher drains ``PageManager.drain_events()`` directly — no FFI shim.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ...engine.jax_engine import JaxEngine
+from ...runtime.dcp_client import DcpClient, pack
+from .protocols import KV_EVENT_SUBJECT, KvCacheEventWire
+
+log = logging.getLogger("dynamo_tpu.kv_router.publisher")
+
+
+class KvEventPublisher:
+    """Periodically drains engine KV events onto the bus subject
+    ``<namespace>.<component>.kv_events``."""
+
+    def __init__(self, dcp: DcpClient, namespace: str, component: str,
+                 worker_id: int, engine: JaxEngine,
+                 interval: float = 0.25):
+        self.dcp = dcp
+        self.subject = f"{namespace}.{component}.{KV_EVENT_SUBJECT}"
+        self.worker_id = worker_id
+        self.engine = engine
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+        await self.flush()
+
+    async def flush(self) -> None:
+        events = self.engine.pm.drain_events()
+        if not events:
+            return
+        payload = pack([
+            KvCacheEventWire(worker_id=self.worker_id, kind=e.kind,
+                             block_hashes=e.block_hashes,
+                             parent_hash=e.parent_hash).to_dict()
+            for e in events])
+        try:
+            await self.dcp.publish(self.subject, payload)
+        except Exception:
+            log.exception("kv event publish failed")
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            await self.flush()

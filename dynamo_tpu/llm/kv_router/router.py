@@ -1,0 +1,147 @@
+"""The KV router: event-fed index + metrics-fed scheduler.
+
+Reference lib/llm/src/kv_router.rs:45-143: subscribes the component's
+``kv_events`` subject into the ``KvIndexer``, polls worker stats into the
+scheduler's ``ProcessedEndpoints`` (metrics_aggregator.rs:27-109), and
+answers ``schedule(token_ids) → worker_id``. Also prunes dead workers on
+discovery Delete events and publishes per-decision KVHitRateEvents for the
+metrics component.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Sequence
+
+from ...runtime.component import Client
+from ...runtime.dcp_client import DcpClient, pack, unpack
+from ...runtime.runtime import DistributedRuntime
+from .indexer import KvIndexer, OverlapScores
+from .protocols import (KV_EVENT_SUBJECT, KV_HIT_RATE_SUBJECT,
+                        ForwardPassMetrics, KvCacheEventWire)
+from .scheduler import KvScheduler
+
+log = logging.getLogger("dynamo_tpu.kv_router")
+
+
+class KvRouter:
+    """Routes requests onto the workers of one component using the global
+    prefix index + load cost function."""
+
+    def __init__(self, drt: DistributedRuntime, namespace: str,
+                 component: str, *, block_size: int = 64,
+                 load_balance_weight: float = 0.3,
+                 scrape_interval: float = 1.0):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.indexer = KvIndexer(block_size)
+        self.scheduler = KvScheduler(
+            block_size=block_size, load_balance_weight=load_balance_weight,
+            on_hit_rate_event=self._on_hit_rate)
+        self.scrape_interval = scrape_interval
+        self.client: Optional[Client] = None
+        self._sid: Optional[int] = None
+        self._scrape_task: Optional[asyncio.Task] = None
+        self._hit_events = 0
+        self._overlap_blocks_total = 0
+        self._isl_blocks_total = 0
+
+    async def start(self, endpoint: str = "generate_tokens") -> None:
+        drt = self.drt
+        self.client = await drt.namespace(self.namespace) \
+            .component(self.component).endpoint(endpoint).client()
+        self._sid = await drt.dcp.subscribe(
+            f"{self.namespace}.{self.component}.{KV_EVENT_SUBJECT}",
+            self._on_events)
+        self._scrape_task = asyncio.create_task(self._scrape_loop())
+
+    async def stop(self) -> None:
+        if self._sid is not None:
+            try:
+                await self.drt.dcp.unsubscribe(self._sid)
+            except Exception:
+                pass
+        if self._scrape_task:
+            self._scrape_task.cancel()
+        if self.client:
+            await self.client.close()
+
+    # ------------------------------------------------------------- inputs
+
+    async def _on_events(self, msg) -> None:
+        try:
+            for raw in unpack(msg.payload):
+                self.indexer.apply_event(KvCacheEventWire.from_dict(raw))
+        except Exception:
+            log.exception("bad kv event payload")
+
+    async def _scrape_loop(self) -> None:
+        while True:
+            try:
+                await self.scrape_once()
+            except Exception:
+                log.exception("stats scrape failed")
+            await asyncio.sleep(self.scrape_interval)
+
+    async def scrape_once(self) -> None:
+        """Scrape worker stats + reconcile live instances (reference
+        collect_endpoints_task)."""
+        stats = await self.client.collect_stats(timeout=self.scrape_interval)
+        metrics: Dict[int, ForwardPassMetrics] = {}
+        for wid, payload in stats.items():
+            metrics[wid] = ForwardPassMetrics.from_dict(payload.get("data", {}))
+        self.scheduler.update_metrics(metrics)
+        # prune index entries of workers that disappeared from discovery
+        live = set(self.client.instance_ids())
+        for wid in list(self.indexer.tree.lookup):
+            if wid not in live:
+                log.info("pruning dead worker %x from KV index", wid)
+                self.indexer.remove_worker(wid)
+
+    # ------------------------------------------------------------ routing
+
+    async def schedule(self, token_ids: Sequence[int]) -> int:
+        """token_ids → worker instance id."""
+        if not self.scheduler.workers:
+            await self.scrape_once()
+        if not self.scheduler.workers:
+            # no stats yet: fall back to any live instance
+            ids = await self.client.wait_for_instances(timeout=10)
+            self.scheduler.update_metrics(
+                {wid: ForwardPassMetrics() for wid in ids})
+        overlaps = self.indexer.find_matches_for_request(token_ids)
+        # only consider overlaps from live workers
+        return self.scheduler.schedule(len(token_ids), overlaps)
+
+    def overlap_for(self, token_ids: Sequence[int], worker_id: int) -> int:
+        """Matched prefix BLOCKS on the chosen worker (feeds the disagg
+        router's prefix_hit_length)."""
+        scores = self.indexer.find_matches_for_request(token_ids).scores
+        return scores.get(worker_id, 0)
+
+    # -------------------------------------------------------- observability
+
+    def _on_hit_rate(self, ev) -> None:
+        self._hit_events += 1
+        self._overlap_blocks_total += ev.overlap_blocks
+        self._isl_blocks_total += ev.isl_blocks
+        asyncio.ensure_future(self._publish_hit_rate(ev))
+
+    async def _publish_hit_rate(self, ev) -> None:
+        try:
+            await self.drt.dcp.publish(
+                f"{self.namespace}.{KV_HIT_RATE_SUBJECT}",
+                pack(ev.to_dict()))
+        except Exception:
+            pass
+
+    def stats(self) -> dict:
+        return {
+            "decisions": self._hit_events,
+            "avg_hit_rate": (self._overlap_blocks_total /
+                             max(self._isl_blocks_total, 1)),
+            "indexed_blocks": self.indexer.tree.block_count(),
+            "workers": len(self.scheduler.workers),
+        }

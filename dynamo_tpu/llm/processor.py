@@ -1,0 +1,127 @@
+"""Frontend-side processor: tokenize → KV-route → worker → detokenize.
+
+Reference examples/llm/components/processor.py:41-208 (the Processor of the
+``agg_router`` graph): lowers the OpenAI request with the model card's
+tokenizer, asks the Router for a worker, calls the worker's token-level
+endpoint with ``direct()`` routing, and maps the token stream back to
+OpenAI chunks through the detokenizing Backend.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import AsyncIterator, Optional
+
+from ..runtime.component import Client
+from ..runtime.engine import Context
+from .backend import Backend
+from .kv_router.router import KvRouter
+from .model_card import ModelDeploymentCard
+from .preprocessor import OpenAIPreprocessor
+from .protocols.common import EngineOutput, PreprocessedRequest
+from .protocols.openai import (ChatCompletionRequest, CompletionRequest,
+                               _finish_reason_openai)
+
+log = logging.getLogger("dynamo_tpu.processor")
+
+
+class _RemoteTokenEngine:
+    """Adapts a worker's token-level endpoint to the local AsyncEngine
+    shape so the Backend can detokenize the remote stream."""
+
+    def __init__(self, client: Client, worker_id: Optional[int],
+                 router: Optional[KvRouter]):
+        self.client = client
+        self.worker_id = worker_id
+        self.router = router
+
+    async def generate(self, request: PreprocessedRequest, context: Context):
+        if self.worker_id is not None:
+            stream = await self.client.direct(request.to_dict(),
+                                              self.worker_id,
+                                              context=context)
+        else:
+            stream = await self.client.round_robin(request.to_dict(),
+                                                   context=context)
+        try:
+            async for env in stream:
+                if env.is_error:
+                    raise RuntimeError(env.error_message())
+                if env.data is not None:
+                    yield EngineOutput.from_dict(env.data)
+        finally:
+            if context.killed:
+                await stream.kill()
+            elif context.stopped:
+                await stream.stop_generating()
+
+
+class Processor:
+    """KV-routed OpenAI engine (chat + completions callables for the
+    ModelManager)."""
+
+    def __init__(self, mdc: ModelDeploymentCard, client: Client,
+                 router: Optional[KvRouter] = None):
+        self.mdc = mdc
+        self.client = client
+        self.router = router
+        self.preprocessor = OpenAIPreprocessor(mdc)
+
+    async def _route(self, pre: PreprocessedRequest) -> Optional[int]:
+        if self.router is None:
+            return None
+        worker_id = await self.router.schedule(pre.token_ids)
+        return worker_id
+
+    def chat(self, request: ChatCompletionRequest,
+             context: Context) -> AsyncIterator:
+        return self._chat(request, context)
+
+    async def _chat(self, request: ChatCompletionRequest, context: Context):
+        pre, annotations = self.preprocessor.preprocess_chat(request)
+        for ann in annotations:
+            yield ann
+        worker_id = await self._route(pre)
+        engine = _RemoteTokenEngine(self.client, worker_id, self.router)
+        backend = Backend(engine, self.preprocessor.tokenizer)
+        async for chunk in self.preprocessor.chat_stream(
+                request, backend.generate(pre, context), context,
+                len(pre.token_ids)):
+            yield chunk
+
+    def completion(self, request: CompletionRequest,
+                   context: Context) -> AsyncIterator:
+        return self._completion(request, context)
+
+    async def _completion(self, request: CompletionRequest, context: Context):
+        pre, annotations = self.preprocessor.preprocess_completion(request)
+        for ann in annotations:
+            yield ann
+        worker_id = await self._route(pre)
+        engine = _RemoteTokenEngine(self.client, worker_id, self.router)
+        backend = Backend(engine, self.preprocessor.tokenizer)
+        rid = f"cmpl-{context.id or uuid.uuid4().hex}"
+        created = int(time.time())
+        n_out = 0
+        async for out in backend.generate(pre, context):
+            n_out += len(out.token_ids)
+            if out.text or out.finish_reason:
+                yield {"id": rid, "object": "text_completion",
+                       "created": created, "model": request.model,
+                       "choices": [{
+                           "index": 0, "text": out.text or "",
+                           "finish_reason":
+                               _finish_reason_openai(out.finish_reason)}]}
+            if out.finish_reason:
+                if request.stream_options and \
+                        request.stream_options.include_usage:
+                    yield {"id": rid, "object": "text_completion",
+                           "created": created, "model": request.model,
+                           "choices": [],
+                           "usage": {"prompt_tokens": len(pre.token_ids),
+                                     "completion_tokens": n_out,
+                                     "total_tokens":
+                                         len(pre.token_ids) + n_out}}
+                return

@@ -24,6 +24,10 @@ from .protocols.openai import ChatCompletionRequest, CompletionRequest
 log = logging.getLogger("dynamo_tpu.llm.worker")
 
 
+def _component_slug(mdc: ModelDeploymentCard) -> str:
+    return mdc.name.replace("/", "-").replace(".", "-").lower()
+
+
 async def serve_openai_model(
     drt: DistributedRuntime,
     mdc: ModelDeploymentCard,
@@ -37,7 +41,7 @@ async def serve_openai_model(
 ):
     """Serve ``mdc``'s model with ``core_engine`` (token-level) and register
     it for discovery. Returns the ServeHandle."""
-    component = component or mdc.name.replace("/", "-").replace(".", "-").lower()
+    component = component or _component_slug(mdc)
     preprocessor = OpenAIPreprocessor(mdc)
     chat_chain = LocalChatChain(mdc, core_engine, preprocessor)
     completion_chain = LocalCompletionChain(mdc, core_engine, preprocessor)
@@ -58,12 +62,58 @@ async def serve_openai_model(
     ep = comp.endpoint(endpoint)
     handle = await ep.serve(handler, stats_handler=stats_handler)
 
-    await mdc.publish(drt.dcp, lease=drt.primary_lease)
+    await mdc.publish(drt.dcp)
     mtype = model_type or mdc.model_type
     entry = ModelEntry(name=mdc.name, endpoint=ep.path, model_type=mtype)
     await register_model(drt.dcp, entry, lease=drt.primary_lease)
     log.info("model %r serving at %s (type=%s)", mdc.name, ep.path, mtype)
     return handle
+
+
+async def serve_token_model(
+    drt: DistributedRuntime,
+    mdc: ModelDeploymentCard,
+    engine,
+    *,
+    namespace: str = "dynamo",
+    component: Optional[str] = None,
+    endpoint: str = "generate_tokens",
+    publish_kv_events: bool = True,
+):
+    """Serve the token-level engine endpoint (PreprocessedRequest dicts in,
+    EngineOutput dicts out) with ForwardPassMetrics stats and KV event
+    publishing — the worker of the KV-routed graph (reference
+    examples/llm/components/worker.py: engine + KV metrics/event
+    publishers behind a direct()-routable endpoint).
+
+    Returns (ServeHandle, KvEventPublisher|None).
+    """
+    from .kv_router.publisher import KvEventPublisher
+    from .protocols.common import PreprocessedRequest
+
+    component = component or _component_slug(mdc)
+
+    async def handler(request: dict, context: Context):
+        pre = PreprocessedRequest.from_dict(request)
+        async for out in engine.generate(pre, context):
+            yield out.to_dict()
+
+    comp = drt.namespace(namespace).component(component)
+    await comp.create_service()
+    ep = comp.endpoint(endpoint)
+    handle = await ep.serve(handler,
+                            stats_handler=getattr(engine, "stats", None))
+    # the card is shared by all workers of the model: publish WITHOUT a
+    # lease so one worker's death cannot delete it from under the others
+    await mdc.publish(drt.dcp)
+
+    publisher = None
+    if publish_kv_events and hasattr(engine, "pm"):
+        publisher = KvEventPublisher(
+            drt.dcp, namespace, component, drt.instance_id, engine)
+        publisher.start()
+    log.info("token-level model %r serving at %s", mdc.name, ep.path)
+    return handle, publisher
 
 
 def _to_payload(chunk):
