@@ -28,6 +28,7 @@ import logging
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from functools import partial
 from typing import AsyncIterator, Dict, List, Optional, Tuple
 
 import jax
@@ -95,6 +96,9 @@ class Sequence:
     finished: Optional[str] = None
     last_token: int = 0          # next decode input
     arrival: float = field(default_factory=time.monotonic)
+    # disaggregation: keep pages alive after finish so the prefill worker
+    # can extract them (caller must release_pages() afterwards)
+    hold_pages: bool = False
 
     def max_new(self) -> int:
         mt = self.req.stop.max_tokens
@@ -339,6 +343,9 @@ class JaxEngine:
 
     def _decode_step(self) -> None:
         batch = [s for s in self.running if s.finished is None]
+        # submit_prefilled can push running past max_batch; overflow rows
+        # simply wait a round (arrays below are sized ≤ max_batch)
+        batch = batch[: self.ecfg.max_batch]
         if not batch:
             return
         # cancellations + page growth (preempt newest on OOM)
@@ -442,6 +449,8 @@ class JaxEngine:
                            token_ids=seq.tokens[i * ps:(i + 1) * ps])
 
     def _release(self, seq: Sequence) -> None:
+        if seq.hold_pages:
+            return  # disagg prefill-only: caller extracts, then releases
         if seq.pages:
             self.pm.release_sequence(seq.pages)
             seq.pages = []
@@ -468,3 +477,146 @@ class JaxEngine:
     def _reap(self) -> None:
         """Drop finished sequences that linger in running (safety net)."""
         self.running = [s for s in self.running if s.finished is None]
+
+    # ------------------------------------------------- disaggregation plane
+    # Engine-side primitives for prefill/decode disaggregation (reference
+    # vllm_v0.7.2-dynamo-kv-disagg-patch: remote_prefill.py
+    # RemotePrefillRequest staging + DynamoNixlConnector block reads/writes).
+    # On TPU the RDMA path becomes: gather pages → host bytes → TCP/DCN →
+    # donated scatter back into the destination pool (llm/disagg/transfer.py);
+    # same-process transfers skip the host round-trip entirely.
+
+    async def reserve_remote(self, token_ids: List[int]
+                             ) -> Optional["RemoteReservation"]:
+        """Decode-side page reservation for a remote prefill: claims pages
+        covering the prompt (reusing the longest cached prefix) without
+        admitting a sequence. Returns None when the pool is full."""
+        loop = asyncio.get_running_loop()
+
+        def _do():
+            alloc = self.pm.allocate_sequence(token_ids)
+            if alloc is None:
+                return None
+            return RemoteReservation(pages=alloc[0], cached_tokens=alloc[1],
+                                     page_size=self.ecfg.page_size)
+
+        return await loop.run_in_executor(self._exec, _do)
+
+    async def release_pages(self, pages: List[int]) -> None:
+        """Return pages claimed by reserve_remote()/prefill_only()."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(self._exec, self.pm.release_sequence,
+                                   list(pages))
+
+    async def extract_pages(self, page_ids: List[int]
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+        """Gather KV pages to host memory: returns (k, v) arrays of shape
+        [L, n, page_size, KV, hd]. Serialized with engine steps on the
+        single-worker executor so it never races buffer donation."""
+        loop = asyncio.get_running_loop()
+
+        def _do():
+            idx = jnp.asarray(page_ids, jnp.int32)
+            return (np.asarray(self.kv_k[:, idx]),
+                    np.asarray(self.kv_v[:, idx]))
+
+        return await loop.run_in_executor(self._exec, _do)
+
+    async def inject_pages(self, page_ids: List[int], k: np.ndarray,
+                           v: np.ndarray) -> None:
+        """Scatter host KV pages into the pool at page_ids (donated jit —
+        in-place on device; the block_copy.cu analog for ingest)."""
+        loop = asyncio.get_running_loop()
+
+        def _do():
+            idx = jnp.asarray(page_ids, jnp.int32)
+            self.kv_k = _inject_pages(self.kv_k, idx, jnp.asarray(k))
+            self.kv_v = _inject_pages(self.kv_v, idx, jnp.asarray(v))
+            jax.block_until_ready(self.kv_k)
+
+        await loop.run_in_executor(self._exec, _do)
+
+    async def prefill_only(self, request: PreprocessedRequest,
+                           context: Context) -> Tuple[int, List[int]]:
+        """Prefill worker path: compute the prompt's KV + sample the first
+        token, holding the pages for extraction. Returns (first_token,
+        page_ids); the caller MUST release_pages(page_ids) when done.
+        (Reference prefill_worker.py:109-137 — max_tokens=1 generate.)"""
+        import copy
+
+        req = copy.copy(request)
+        req.stop = copy.copy(request.stop)
+        req.stop.max_tokens = 1
+        self.start()
+        seq = Sequence(req=req, context=context, out=asyncio.Queue(),
+                       tokens=list(req.token_ids),
+                       num_prompt=len(req.token_ids), hold_pages=True)
+        if seq.num_prompt == 0:
+            raise ValueError("empty prompt")
+        self.waiting.append(seq)
+        self._wake.set()
+        first: Optional[int] = None
+        while True:
+            out: EngineOutput = await seq.out.get()
+            if out.token_ids:
+                first = out.token_ids[0]
+            if out.finish_reason is not None:
+                break
+        if first is None:
+            # failed before sampling: nothing to extract, so return the held
+            # pages ourselves (hold_pages disabled the engine-side release)
+            if seq.pages:
+                await self.release_pages(seq.pages)
+                seq.pages = []
+            raise RuntimeError(f"prefill produced no token "
+                               f"({out.finish_reason})")
+        return first, seq.pages
+
+    async def submit_prefilled(self, request: PreprocessedRequest,
+                               context: Context, pages: List[int],
+                               first_token: int) -> Sequence:
+        """Decode-side entry after a remote prefill: the reserved pages now
+        hold the prompt's KV (injected via inject_pages); enter decode
+        directly with the remotely sampled first token already emitted."""
+        if not isinstance(request, PreprocessedRequest):
+            request = PreprocessedRequest.from_dict(request)
+        self.start()
+        seq = Sequence(req=request, context=context, out=asyncio.Queue(),
+                       tokens=list(request.token_ids),
+                       num_prompt=len(request.token_ids))
+        seq.pages = list(pages)
+        seq.computed = seq.num_prompt
+        loop = asyncio.get_running_loop()
+
+        def _do():
+            self.prompt_tokens_total += seq.num_prompt
+            self._commit_full_pages(seq)  # prefix-cache publish + KV events
+            self._append_token(seq, int(first_token))
+
+        await loop.run_in_executor(self._exec, _do)
+        if seq.finished is None:
+            self.running.append(seq)
+            self._wake.set()
+        return seq
+
+
+@dataclass
+class RemoteReservation:
+    """Decode-side pages claimed ahead of a remote prefill."""
+
+    pages: List[int]
+    cached_tokens: int  # prompt tokens already covered by the prefix cache
+    page_size: int
+
+    @property
+    def skip_pages(self) -> int:
+        """Leading pages the prefill worker need not transfer (already
+        valid on the decode side via prefix-cache hits)."""
+        return self.cached_tokens // self.page_size
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _inject_pages(pool: jax.Array, idx: jax.Array,
+                  rows: jax.Array) -> jax.Array:
+    """pool: [L, num_pages, ps, KV, hd]; rows: [L, n, ps, KV, hd]."""
+    return pool.at[:, idx].set(rows.astype(pool.dtype))

@@ -1,0 +1,26 @@
+"""Prefill/decode disaggregation plane.
+
+The reference's core feature (docs/disagg_serving.md): long prefills run on
+dedicated prefill workers; computed KV pages migrate to the decode worker's
+pool; decode continues locally. Mapping to TPU:
+
+- NATS JetStream prefill queue      → DCP work queue (queue.py)
+- disagg_router.rs threshold        → DisaggRouter (router.py)
+- NIXL RDMA KV block transfer       → host-staged TCP page transfer with
+  DCP-registered endpoints (transfer.py); same-process: direct device copy
+- vLLM RemotePrefillRequest staging → engine.reserve_remote /
+  submit_prefilled / prefill_only (engine/jax_engine.py)
+"""
+
+from .decode import DisaggDecodeEngine
+from .prefill_worker import PrefillWorker
+from .protocols import RemotePrefillRequest
+from .queue import PrefillQueue
+from .router import DisaggRouter
+from .transfer import KvTransferClient, KvTransferServer
+
+__all__ = [
+    "DisaggDecodeEngine", "DisaggRouter", "KvTransferClient",
+    "KvTransferServer", "PrefillQueue", "PrefillWorker",
+    "RemotePrefillRequest",
+]

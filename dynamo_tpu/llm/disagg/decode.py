@@ -1,0 +1,145 @@
+"""Decode-side disaggregation orchestration.
+
+Reference examples/llm/components/worker.py:37-189 (VllmWorker): per
+request, consult the disagg router with (prefill_length, prefix_hit);
+remote → allocate decode-side KV blocks, enqueue a RemotePrefillRequest,
+wait for the prefill worker's block write + completion notification, then
+continue decoding locally. Falls back to fully local prefill whenever the
+pool is exhausted, the queue is saturated, or the remote path errors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Optional
+
+from ...runtime.engine import Context
+from ..protocols.common import (FINISH_CANCELLED, FINISH_ERROR, EngineOutput,
+                                PreprocessedRequest)
+from .protocols import RemotePrefillRequest
+from .queue import PrefillQueue
+from .router import DisaggRouter
+from .transfer import KvTransferServer
+
+log = logging.getLogger("dynamo_tpu.llm.disagg")
+
+
+class DisaggDecodeEngine:
+    """AsyncEngine wrapper adding conditional remote prefill to a JaxEngine.
+
+    Serves the same token-level protocol, so it drops into serve_token_model
+    / the Backend pipeline unchanged.
+    """
+
+    def __init__(self, engine, queue: PrefillQueue, transfer: KvTransferServer,
+                 router: DisaggRouter, engine_id: int,
+                 prefill_timeout: float = 120.0):
+        self.engine = engine
+        self.queue = queue
+        self.transfer = transfer
+        self.router = router
+        self.engine_id = engine_id
+        self.prefill_timeout = prefill_timeout
+        # observability
+        self.remote_prefills = 0
+        self.local_prefills = 0
+        self.remote_fallbacks = 0
+
+    def stats(self) -> dict:
+        s = dict(self.engine.stats())
+        s.update(remote_prefills=self.remote_prefills,
+                 local_prefills=self.local_prefills,
+                 remote_fallbacks=self.remote_fallbacks)
+        return s
+
+    async def generate(self, request, context: Context
+                       ) -> AsyncIterator[EngineOutput]:
+        if not isinstance(request, PreprocessedRequest):
+            request = PreprocessedRequest.from_dict(request)
+        tokens = request.token_ids
+
+        res = None
+        if self.router.enabled:
+            res = await self.engine.reserve_remote(tokens)
+        remote = False
+        if res is not None:
+            depth = await self.queue.depth()
+            remote = self.router.prefill_remote(len(tokens),
+                                                res.cached_tokens, depth)
+        if not remote:
+            if res is not None:
+                await self.engine.release_pages(res.pages)
+            self.local_prefills += 1
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+
+        self.remote_prefills += 1
+        first = await self._remote_prefill(request, context, res)
+        if first is None:  # remote path failed/timed out → local fallback
+            self.remote_fallbacks += 1
+            await self.engine.release_pages(res.pages)
+            if context.stopped:
+                yield EngineOutput(finish_reason=FINISH_CANCELLED)
+                return
+            log.warning("remote prefill fell back to local for %s",
+                        context.id)
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+
+        seq = await self.engine.submit_prefilled(request, context,
+                                                 res.pages, first)
+        while True:
+            out: EngineOutput = await seq.out.get()
+            yield out
+            if out.finish_reason is not None:
+                return
+
+    async def _remote_prefill(self, request: PreprocessedRequest,
+                              context: Context, res) -> Optional[int]:
+        """Enqueue + await the KV arrival; returns the first token or None."""
+        fut = self.transfer.expect(context.id)
+        await self.queue.put(RemotePrefillRequest(
+            request_id=context.id,
+            token_ids=list(request.token_ids),
+            sampling=request.sampling.to_dict(),
+            eos_token_ids=list(request.eos_token_ids),
+            page_ids=list(res.pages),
+            skip_pages=res.skip_pages,
+            engine_id=self.engine_id,
+        ))
+        try:
+            return await asyncio.wait_for(fut, self.prefill_timeout)
+        except asyncio.TimeoutError:
+            self.transfer.cancel(context.id)
+            return None
+        except asyncio.CancelledError:
+            # the handler task itself was cancelled — clean up and propagate
+            self.transfer.cancel(context.id)
+            await self.engine.release_pages(res.pages)
+            raise
+        except Exception:  # noqa: BLE001
+            log.exception("remote prefill failed for %s", context.id)
+            self.transfer.cancel(context.id)
+            return None
+
+
+async def build_disagg_decode(drt, engine, *, namespace: str = "dynamo",
+                              model: str = "default",
+                              router: Optional[DisaggRouter] = None,
+                              watch_config: bool = True
+                              ) -> DisaggDecodeEngine:
+    """Wire the decode side: transfer listener (registered under the
+    worker's lease), prefill queue handle, router with live config watch."""
+    router = router or DisaggRouter()
+    if watch_config:
+        await router.start_watch(drt.dcp, namespace, model)
+    transfer = KvTransferServer(engine)
+    await transfer.start()
+    await transfer.register(drt.dcp, namespace, drt.instance_id,
+                            lease=drt.primary_lease)
+    queue = PrefillQueue(drt.dcp, namespace)
+    return DisaggDecodeEngine(engine, queue, transfer, router,
+                              drt.instance_id)

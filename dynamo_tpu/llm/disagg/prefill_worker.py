@@ -1,0 +1,121 @@
+"""The prefill worker: pull queue → prefill → push KV pages.
+
+Reference examples/llm/components/prefill_worker.py:37-141: pulls the
+JetStream prefill queue, lazily fetches the decode engine's NIXL metadata
+from etcd on first contact, runs a max_tokens=1 generate, and RDMA-writes
+the computed blocks into decode VRAM. Here: DCP work queue, DCP-stored TCP
+endpoints, engine.prefill_only + extract_pages, TwoPartCodec page push.
+
+Elastic xPyD: any number of prefill workers pull the one shared queue;
+joining/leaving needs no coordination (docs/disagg_serving.md:93-100).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+from typing import Dict, Optional, Set
+
+from ...runtime.engine import Context
+from ..protocols.common import (PreprocessedRequest, SamplingOptions,
+                                StopConditions)
+from .protocols import RemotePrefillRequest
+from .queue import PrefillQueue
+from .transfer import KvTransferClient
+
+log = logging.getLogger("dynamo_tpu.llm.disagg")
+
+
+class PrefillWorker:
+    def __init__(self, drt, engine, *, namespace: str = "dynamo",
+                 max_inflight: int = 4):
+        self.drt = drt
+        self.engine = engine
+        self.namespace = namespace
+        self.queue = PrefillQueue(drt.dcp, namespace)
+        self.max_inflight = max_inflight
+        self._clients: Dict[int, KvTransferClient] = {}
+        self._tasks: Set[asyncio.Task] = set()
+        self._run_task: Optional[asyncio.Task] = None
+        self._stopped = False
+        self.completed = 0
+        self.failed = 0
+
+    def start(self) -> None:
+        if self._run_task is None:
+            self._run_task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._run_task:
+            self._run_task.cancel()
+            try:
+                await self._run_task
+            except asyncio.CancelledError:
+                pass
+        for t in list(self._tasks):
+            t.cancel()
+        for c in self._clients.values():
+            c.close()
+
+    async def _run(self) -> None:
+        while not self._stopped:
+            try:
+                if len(self._tasks) >= self.max_inflight:
+                    await asyncio.wait(self._tasks,
+                                       return_when=asyncio.FIRST_COMPLETED)
+                    continue
+                req = await self.queue.pull(timeout=0.5)
+                if req is None:
+                    continue
+                task = asyncio.ensure_future(self._handle(req))
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — a DCP hiccup must not
+                log.exception("prefill pull loop error; retrying")  # kill us
+                await asyncio.sleep(1.0)
+
+    async def _handle(self, req: RemotePrefillRequest) -> None:
+        """One remote prefill: compute, extract the non-cached pages, ship."""
+        pages = None
+        try:
+            pre = PreprocessedRequest(
+                token_ids=list(req.token_ids),
+                sampling=SamplingOptions.from_dict(req.sampling),
+                stop=StopConditions(max_tokens=1),
+                eos_token_ids=list(req.eos_token_ids),
+            )
+            ctx = Context(req.request_id)
+            first, pages = await self.engine.prefill_only(pre, ctx)
+
+            ps = self.engine.ecfg.page_size
+            n_prompt_pages = math.ceil(len(req.token_ids) / ps)
+            local_send = pages[req.skip_pages:n_prompt_pages]
+            remote_dst = req.page_ids[req.skip_pages:n_prompt_pages]
+            k, v = await self.engine.extract_pages(local_send)
+
+            client = await self._client(req.engine_id)
+            await client.send_kv(req.request_id, remote_dst, k, v, first)
+            self.completed += 1
+        except Exception:  # noqa: BLE001 — a bad job must not kill the loop
+            self.failed += 1
+            log.exception("remote prefill job %s failed (decode side will "
+                          "fall back on timeout)", req.request_id)
+        finally:
+            if pages is not None:
+                await self.engine.release_pages(pages)
+
+    async def _client(self, engine_id: int) -> KvTransferClient:
+        client = self._clients.get(engine_id)
+        if client is None:
+            client = await KvTransferClient.lookup(self.drt.dcp,
+                                                   self.namespace, engine_id)
+            self._clients[engine_id] = client
+        return client
+
+    def stats(self) -> dict:
+        return {"inflight": len(self._tasks), "completed": self.completed,
+                "failed": self.failed}

@@ -1,0 +1,49 @@
+"""Disaggregation wire types (reference vllm/remote_prefill.py
+RemotePrefillRequest — patch:3584 — carried over the JetStream prefill
+queue in the reference, over the DCP work queue here)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class RemotePrefillRequest:
+    """One queued remote-prefill job.
+
+    ``page_ids`` are DECODE-side pool pages, reserved before enqueueing
+    (reference: vLLM allocates decode blocks first, then enqueues with
+    ``block_ids`` so the prefill side can write straight into them).
+    ``skip_pages`` leading pages are already valid on the decode side
+    (prefix-cache hits) and are not transferred.
+    """
+
+    request_id: str
+    token_ids: List[int]
+    sampling: dict = field(default_factory=dict)
+    eos_token_ids: List[int] = field(default_factory=list)
+    page_ids: List[int] = field(default_factory=list)
+    skip_pages: int = 0
+    engine_id: int = 0          # decode engine instance (transfer lookup key)
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "token_ids": list(self.token_ids),
+            "sampling": self.sampling,
+            "eos_token_ids": list(self.eos_token_ids),
+            "page_ids": list(self.page_ids),
+            "skip_pages": self.skip_pages,
+            "engine_id": self.engine_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RemotePrefillRequest":
+        return cls(request_id=d["request_id"],
+                   token_ids=list(d["token_ids"]),
+                   sampling=d.get("sampling", {}),
+                   eos_token_ids=list(d.get("eos_token_ids", [])),
+                   page_ids=list(d.get("page_ids", [])),
+                   skip_pages=int(d.get("skip_pages", 0)),
+                   engine_id=int(d.get("engine_id", 0)))

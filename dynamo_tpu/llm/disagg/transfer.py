@@ -1,0 +1,208 @@
+"""KV page transfer plane — the TPU-native NIXL replacement.
+
+Reference: the vLLM patch's ``DynamoNixlConnector`` (patch:811-1216) RDMA-reads/
+writes KV blocks directly between GPU VRAM of prefill and decode engines,
+with agent metadata exchanged through etcd (``utils/nixl.py``
+NixlMetadataStore:56-105). TPUs expose no peer-to-peer RDMA API to user
+code, so the idiomatic equivalent is the reference's *cross-slice* path
+made primary: device→host gather (one XLA op), raw bytes over a dedicated
+TCP side channel framed by the TwoPartCodec, host→device donated scatter on
+the receiver (DCN host-staged transfer, SURVEY §5 "Distributed
+communication backend"). Endpoint metadata lives in the DCP KV store under
+the decode worker's lease, exactly like NIXL metadata in etcd.
+
+Layout conversion between prefill TP and decode TP (the Triton
+``kv_rearrange`` kernel, patch:743) is unnecessary here: pages travel in
+the logical host layout ``[L, n, page_size, KV, hd]`` and each side's
+sharded pool scatter applies its own GSPMD sharding on ingest.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ...runtime import codec
+from ...runtime.codec import TwoPartMessage
+from ...runtime.dcp_client import DcpClient
+
+log = logging.getLogger("dynamo_tpu.llm.disagg")
+
+
+def metadata_key(namespace: str, engine_id: int) -> str:
+    return f"{namespace}/disagg/transfer/{engine_id:x}"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bundled with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class KvTransferServer:
+    """Decode-side ingest listener.
+
+    Accepts KV page payloads, scatters them into the engine's pool, and
+    resolves the waiter registered under the request id with the remotely
+    sampled first token. One message per request:
+    header {request_id, page_ids, shape, dtype, first_token, k_len},
+    body = k_bytes || v_bytes; replies {ok: true} once injection completes
+    (the NIXL completion-notification analog).
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._waiters: Dict[str, asyncio.Future] = {}
+        self.host: str = ""
+        self.port: int = 0
+
+    async def start(self, host: str = "0.0.0.0") -> None:
+        self._server = await asyncio.start_server(self._on_conn, host, 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.host = _local_ip()
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for fut in self._waiters.values():
+            if not fut.done():
+                fut.cancel()
+        self._waiters.clear()
+
+    async def register(self, dcp: DcpClient, namespace: str, engine_id: int,
+                       lease: int = 0) -> None:
+        """Publish this listener for prefill workers (NixlMetadataStore
+        analog — dies with the worker's lease)."""
+        meta = {"host": self.host, "port": self.port}
+        await dcp.kv_put(metadata_key(namespace, engine_id),
+                         json.dumps(meta).encode(), lease=lease)
+
+    def expect(self, request_id: str) -> asyncio.Future:
+        """Future resolving to the first sampled token once the KV for
+        request_id has been injected."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters[request_id] = fut
+        return fut
+
+    def cancel(self, request_id: str) -> None:
+        fut = self._waiters.pop(request_id, None)
+        if fut and not fut.done():
+            fut.cancel()
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    msg = await codec.decode(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                try:
+                    await self._ingest(msg)
+                    writer.write(codec.encode(TwoPartMessage(
+                        header={"ok": True,
+                                "request_id": msg.header["request_id"]})))
+                except Exception as exc:  # noqa: BLE001 — report to sender
+                    log.exception("KV ingest failed")
+                    writer.write(codec.encode(TwoPartMessage(
+                        header={"ok": False, "error": str(exc),
+                                "request_id": msg.header.get("request_id")})))
+                await writer.drain()
+        finally:
+            writer.close()
+            log.debug("transfer conn from %s closed", peer)
+
+    async def _ingest(self, msg: TwoPartMessage) -> None:
+        h = msg.header
+        request_id = h["request_id"]
+        # claim the waiter FIRST: if the decode side already timed out and
+        # released the pages, they may belong to another request now — a
+        # late write would corrupt it, so drop the payload instead
+        fut = self._waiters.pop(request_id, None)
+        if fut is None:
+            log.warning("dropping KV for unknown/cancelled request %s",
+                        request_id)
+            return
+        page_ids = list(h["page_ids"])
+        if page_ids:
+            shape = tuple(h["shape"])  # [L, n, ps, KV, hd]
+            dtype = _np_dtype(h["dtype"])
+            k_len = h["k_len"]
+            k = np.frombuffer(msg.body[:k_len], dtype).reshape(shape)
+            v = np.frombuffer(msg.body[k_len:], dtype).reshape(shape)
+            await self.engine.inject_pages(page_ids, k, v)
+        if not fut.done():
+            fut.set_result(int(h["first_token"]))
+
+
+class KvTransferClient:
+    """Prefill-side sender: one persistent connection per decode engine."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    @classmethod
+    async def lookup(cls, dcp: DcpClient, namespace: str,
+                     engine_id: int) -> "KvTransferClient":
+        raw = await dcp.kv_get(metadata_key(namespace, engine_id))
+        if raw is None:
+            raise RuntimeError(
+                f"no KV transfer endpoint registered for engine "
+                f"{engine_id:x} (decode worker down?)")
+        meta = json.loads(raw)
+        return cls(meta["host"], meta["port"])
+
+    async def _ensure(self) -> None:
+        if self._writer is None or self._writer.is_closing():
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+
+    async def send_kv(self, request_id: str, page_ids, k: np.ndarray,
+                      v: np.ndarray, first_token: int,
+                      timeout: float = 60.0) -> None:
+        """Ship pages + first token; returns once the decode side has
+        injected them (raises on remote failure)."""
+        k = np.ascontiguousarray(k)
+        v = np.ascontiguousarray(v)
+        header = {
+            "request_id": request_id,
+            "page_ids": list(int(p) for p in page_ids),
+            "shape": list(k.shape),
+            "dtype": str(k.dtype),
+            "k_len": k.nbytes,
+            "first_token": int(first_token),
+        }
+        async with self._lock:  # frame-atomic per request
+            await self._ensure()
+            self._writer.write(codec.encode(TwoPartMessage(
+                header=header, body=k.tobytes() + v.tobytes())))
+            await self._writer.drain()
+            ack = await asyncio.wait_for(codec.decode(self._reader), timeout)
+        if not ack.header.get("ok"):
+            raise RuntimeError(
+                f"decode-side KV ingest failed: {ack.header.get('error')}")
+
+    def close(self) -> None:
+        if self._writer:
+            self._writer.close()
+            self._writer = None
+
+
+def _local_ip() -> str:
+    from ...runtime.tcp import _local_ip as impl
+
+    return impl()

@@ -53,11 +53,9 @@ class RadixTree:
         self.root = _Node(0, None)
         self.lookup: Dict[int, Dict[int, _Node]] = defaultdict(dict)
 
-    def find_matches(self, block_hashes: Sequence[int],
-                     early_exit: bool = False) -> OverlapScores:
+    def find_matches(self, block_hashes: Sequence[int]) -> OverlapScores:
         """Walk the chain from the root; count per-worker contiguous
-        matches. ``early_exit`` stops at the first node where only one
-        worker remains competitive (reference find_matches early-exit)."""
+        matches (reference indexer.rs find_matches, :239+)."""
         scores: Dict[int, int] = {}
         node = self.root
         for h in block_hashes:
@@ -67,17 +65,6 @@ class RadixTree:
             for w in nxt.workers:
                 scores[w] = scores.get(w, 0) + 1
             node = nxt
-            if early_exit and len(nxt.workers) == 1:
-                # the sole holder can only extend its own lead
-                sole = next(iter(nxt.workers))
-                rest = node
-                h_idx = block_hashes.index(h)
-                for h2 in block_hashes[h_idx + 1:]:
-                    rest = rest.children.get(h2)
-                    if rest is None or sole not in rest.workers:
-                        break
-                    scores[sole] += 1
-                break
         return OverlapScores(scores)
 
     def apply_event(self, ev: KvCacheEventWire) -> None:
@@ -149,10 +136,10 @@ class KvIndexer:
         self.block_size = block_size
         self.tree = RadixTree()
 
-    def find_matches_for_request(self, token_ids: Sequence[int],
-                                 early_exit: bool = False) -> OverlapScores:
+    def find_matches_for_request(self, token_ids: Sequence[int]
+                                 ) -> OverlapScores:
         hashes = chain_hashes(token_ids, self.block_size)
-        return self.tree.find_matches(hashes, early_exit=early_exit)
+        return self.tree.find_matches(hashes)
 
     def apply_event(self, ev: KvCacheEventWire) -> None:
         self.tree.apply_event(ev)

@@ -31,11 +31,9 @@ class _RemoteTokenEngine:
     """Adapts a worker's token-level endpoint to the local AsyncEngine
     shape so the Backend can detokenize the remote stream."""
 
-    def __init__(self, client: Client, worker_id: Optional[int],
-                 router: Optional[KvRouter]):
+    def __init__(self, client: Client, worker_id: Optional[int]):
         self.client = client
         self.worker_id = worker_id
-        self.router = router
 
     async def generate(self, request: PreprocessedRequest, context: Context):
         if self.worker_id is not None:
@@ -84,7 +82,7 @@ class Processor:
         for ann in annotations:
             yield ann
         worker_id = await self._route(pre)
-        engine = _RemoteTokenEngine(self.client, worker_id, self.router)
+        engine = _RemoteTokenEngine(self.client, worker_id)
         backend = Backend(engine, self.preprocessor.tokenizer)
         async for chunk in self.preprocessor.chat_stream(
                 request, backend.generate(pre, context), context,
@@ -100,7 +98,7 @@ class Processor:
         for ann in annotations:
             yield ann
         worker_id = await self._route(pre)
-        engine = _RemoteTokenEngine(self.client, worker_id, self.router)
+        engine = _RemoteTokenEngine(self.client, worker_id)
         backend = Backend(engine, self.preprocessor.tokenizer)
         rid = f"cmpl-{context.id or uuid.uuid4().hex}"
         created = int(time.time())
