@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -127,6 +128,10 @@ class JaxEngine:
         self.kv_k, self.kv_v = init_kv_cache(model_cfg, spec, dtype)
         self.prefill_fn, self.decode_fn = make_step_fns(model_cfg)
         self.pm = PageManager(self.ecfg.num_pages, self.ecfg.page_size)
+        # guards PageManager between the event-loop thread (_admit) and
+        # executor-thread disagg jobs (reserve/release/submit); engine steps
+        # are already serialized with those jobs by the single-worker executor
+        self._pm_lock = threading.Lock()
         self.waiting: List[Sequence] = []
         self.prefilling: List[Sequence] = []
         self.running: List[Sequence] = []
@@ -268,11 +273,13 @@ class JaxEngine:
                 self.waiting.pop(0)
                 self._finish(seq, FINISH_CANCELLED)
                 continue
-            alloc = self.pm.allocate_sequence(seq.tokens)
-            if alloc is None or self.pm.available < self.ecfg.watermark_pages:
-                if alloc is not None:
-                    self.pm.release_sequence(alloc[0])
-                break  # out of pages; wait for frees
+            with self._pm_lock:
+                alloc = self.pm.allocate_sequence(seq.tokens)
+                if (alloc is None
+                        or self.pm.available < self.ecfg.watermark_pages):
+                    if alloc is not None:
+                        self.pm.release_sequence(alloc[0])
+                    break  # out of pages; wait for frees
             self.waiting.pop(0)
             pages, cached_tokens = alloc
             seq.pages = pages
@@ -494,7 +501,8 @@ class JaxEngine:
         loop = asyncio.get_running_loop()
 
         def _do():
-            alloc = self.pm.allocate_sequence(token_ids)
+            with self._pm_lock:
+                alloc = self.pm.allocate_sequence(token_ids)
             if alloc is None:
                 return None
             return RemoteReservation(pages=alloc[0], cached_tokens=alloc[1],
@@ -505,14 +513,19 @@ class JaxEngine:
     async def release_pages(self, pages: List[int]) -> None:
         """Return pages claimed by reserve_remote()/prefill_only()."""
         loop = asyncio.get_running_loop()
-        await loop.run_in_executor(self._exec, self.pm.release_sequence,
-                                   list(pages))
+
+        def _do():
+            with self._pm_lock:
+                self.pm.release_sequence(list(pages))
+
+        await loop.run_in_executor(self._exec, _do)
 
     async def extract_pages(self, page_ids: List[int]
                             ) -> Tuple[np.ndarray, np.ndarray]:
         """Gather KV pages to host memory: returns (k, v) arrays of shape
-        [L, n, page_size, KV, hd]. Serialized with engine steps on the
-        single-worker executor so it never races buffer donation."""
+        [L, n, KV, page_size, hd] (kv-head-major pool layout). Serialized
+        with engine steps on the single-worker executor so it never races
+        buffer donation."""
         loop = asyncio.get_running_loop()
 
         def _do():
@@ -524,8 +537,9 @@ class JaxEngine:
 
     async def inject_pages(self, page_ids: List[int], k: np.ndarray,
                            v: np.ndarray) -> None:
-        """Scatter host KV pages into the pool at page_ids (donated jit —
-        in-place on device; the block_copy.cu analog for ingest)."""
+        """Scatter host KV pages [L, n, KV, page_size, hd] into the pool at
+        page_ids (donated jit — in-place on device; the block_copy.cu
+        analog for ingest)."""
         loop = asyncio.get_running_loop()
 
         def _do():
@@ -590,8 +604,9 @@ class JaxEngine:
 
         def _do():
             self.prompt_tokens_total += seq.num_prompt
-            self._commit_full_pages(seq)  # prefix-cache publish + KV events
-            self._append_token(seq, int(first_token))
+            with self._pm_lock:
+                self._commit_full_pages(seq)  # prefix-cache publish + events
+                self._append_token(seq, int(first_token))
 
         await loop.run_in_executor(self._exec, _do)
         if seq.finished is None:

@@ -59,38 +59,52 @@ class DisaggDecodeEngine:
             request = PreprocessedRequest.from_dict(request)
         tokens = request.token_ids
 
+        # short prompts can never go remote (prefill_len - hit <= prefill_len
+        # <= threshold), so skip the reservation churn on the hot path
         res = None
-        if self.router.enabled:
+        if (self.router.enabled
+                and len(tokens) > self.router.max_local_prefill_length):
             res = await self.engine.reserve_remote(tokens)
-        remote = False
-        if res is not None:
-            depth = await self.queue.depth()
-            remote = self.router.prefill_remote(len(tokens),
-                                                res.cached_tokens, depth)
-        if not remote:
+
+        seq = None
+        try:
+            remote = False
             if res is not None:
-                await self.engine.release_pages(res.pages)
-            self.local_prefills += 1
-            async for out in self.engine.generate(request, context):
-                yield out
-            return
-
-        self.remote_prefills += 1
-        first = await self._remote_prefill(request, context, res)
-        if first is None:  # remote path failed/timed out → local fallback
-            self.remote_fallbacks += 1
-            await self.engine.release_pages(res.pages)
-            if context.stopped:
-                yield EngineOutput(finish_reason=FINISH_CANCELLED)
+                depth = await self.queue.depth()
+                remote = self.router.prefill_remote(len(tokens),
+                                                    res.cached_tokens, depth)
+            if not remote:
+                if res is not None:
+                    await self.engine.release_pages(res.pages)
+                    res = None
+                self.local_prefills += 1
+                async for out in self.engine.generate(request, context):
+                    yield out
                 return
-            log.warning("remote prefill fell back to local for %s",
-                        context.id)
-            async for out in self.engine.generate(request, context):
-                yield out
-            return
 
-        seq = await self.engine.submit_prefilled(request, context,
-                                                 res.pages, first)
+            self.remote_prefills += 1
+            first = await self._remote_prefill(request, context, res)
+            if first is None:  # remote failed/timed out → local fallback
+                self.remote_fallbacks += 1
+                await self.engine.release_pages(res.pages)
+                res = None
+                if context.stopped:
+                    yield EngineOutput(finish_reason=FINISH_CANCELLED)
+                    return
+                log.warning("remote prefill fell back to local for %s",
+                            context.id)
+                async for out in self.engine.generate(request, context):
+                    yield out
+                return
+
+            seq = await self.engine.submit_prefilled(request, context,
+                                                     res.pages, first)
+            res = None  # ownership passed to the sequence
+        finally:
+            if res is not None and seq is None:
+                # a failure between reserve and handoff must not leak pages
+                await self.engine.release_pages(res.pages)
+
         while True:
             out: EngineOutput = await seq.out.get()
             yield out
@@ -116,9 +130,9 @@ class DisaggDecodeEngine:
             self.transfer.cancel(context.id)
             return None
         except asyncio.CancelledError:
-            # the handler task itself was cancelled — clean up and propagate
+            # handler task cancelled — cancel the waiter and propagate;
+            # generate()'s finally releases the reserved pages
             self.transfer.cancel(context.id)
-            await self.engine.release_pages(res.pages)
             raise
         except Exception:  # noqa: BLE001
             log.exception("remote prefill failed for %s", context.id)

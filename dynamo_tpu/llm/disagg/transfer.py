@@ -13,7 +13,7 @@ the decode worker's lease, exactly like NIXL metadata in etcd.
 
 Layout conversion between prefill TP and decode TP (the Triton
 ``kv_rearrange`` kernel, patch:743) is unnecessary here: pages travel in
-the logical host layout ``[L, n, page_size, KV, hd]`` and each side's
+the logical host layout ``[L, n, KV, page_size, hd]`` and each side's
 sharded pool scatter applies its own GSPMD sharding on ingest.
 """
 
@@ -52,9 +52,10 @@ class KvTransferServer:
     Accepts KV page payloads, scatters them into the engine's pool, and
     resolves the waiter registered under the request id with the remotely
     sampled first token. One message per request:
-    header {request_id, page_ids, shape, dtype, first_token, k_len},
-    body = k_bytes || v_bytes; replies {ok: true} once injection completes
-    (the NIXL completion-notification analog).
+    header {request_id, page_ids, shape, dtype, first_token, k_len} with
+    shape = [L, n, KV, page_size, hd] (kv-head-major pool layout),
+    body = k_bytes || v_bytes; replies {ok, request_id} once injection
+    completes (the NIXL completion-notification analog).
     """
 
     def __init__(self, engine):
@@ -174,8 +175,8 @@ class KvTransferClient:
     async def send_kv(self, request_id: str, page_ids, k: np.ndarray,
                       v: np.ndarray, first_token: int,
                       timeout: float = 60.0) -> None:
-        """Ship pages + first token; returns once the decode side has
-        injected them (raises on remote failure)."""
+        """Ship pages [L, n, KV, ps, hd] + first token; returns once the
+        decode side has injected them (raises on remote failure)."""
         k = np.ascontiguousarray(k)
         v = np.ascontiguousarray(v)
         header = {
@@ -187,11 +188,23 @@ class KvTransferClient:
             "first_token": int(first_token),
         }
         async with self._lock:  # frame-atomic per request
-            await self._ensure()
-            self._writer.write(codec.encode(TwoPartMessage(
-                header=header, body=k.tobytes() + v.tobytes())))
-            await self._writer.drain()
-            ack = await asyncio.wait_for(codec.decode(self._reader), timeout)
+            try:
+                await self._ensure()
+                self._writer.write(codec.encode(TwoPartMessage(
+                    header=header, body=k.tobytes() + v.tobytes())))
+                await self._writer.drain()
+                ack = await asyncio.wait_for(codec.decode(self._reader),
+                                             timeout)
+            except Exception:
+                # a timed-out/aborted read leaves the stream mid-frame —
+                # drop the connection so the next send starts clean
+                self.close()
+                raise
+            if ack.header.get("request_id") != request_id:
+                self.close()  # desynced: stale ack from an earlier request
+                raise RuntimeError(
+                    f"KV transfer ack mismatch: sent {request_id}, "
+                    f"got {ack.header.get('request_id')}")
         if not ack.header.get("ok"):
             raise RuntimeError(
                 f"decode-side KV ingest failed: {ack.header.get('error')}")

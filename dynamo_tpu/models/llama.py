@@ -25,6 +25,7 @@ the scheduler (dynamo_tpu/engine/scheduler.py).
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, Optional, Tuple
@@ -33,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.paged_attention import paged_attention_decode
 from .config import ModelConfig
 
 Params = Dict[str, jax.Array]
@@ -51,8 +53,13 @@ class KVCacheSpec:
     page_size: int
 
     def shape(self, cfg: ModelConfig) -> Tuple[int, ...]:
-        return (cfg.num_layers, self.num_pages, self.page_size,
-                cfg.num_kv_heads, cfg.head_dim_)
+        # kv-head-major page layout [L, pages, KV, ps, hd]: the Pallas decode
+        # kernel then consumes pages with NO in-kernel transpose (batched
+        # MXU dots over the leading KV axis) and (ps, hd) is lane-aligned.
+        # The reference models this as KvLayout::{KvFirst,BlockFirst}
+        # (lib/llm/src/kv/layer.rs:100-106) — layout chosen for the device.
+        return (cfg.num_layers, self.num_pages, cfg.num_kv_heads,
+                self.page_size, cfg.head_dim_)
 
 
 def init_kv_cache(cfg: ModelConfig, spec: KVCacheSpec,
@@ -147,19 +154,45 @@ def _scatter_pages(cache_layer: jax.Array, new: jax.Array,
                    flat_slots: jax.Array) -> jax.Array:
     """Write new K/V rows into the page pool.
 
-    cache_layer: [num_pages, page_size, KV, hd]; new: [B, T, KV, hd];
+    cache_layer: [num_pages, KV, page_size, hd]; new: [B, T, KV, hd];
     flat_slots: [B, T] flattened (page*page_size + slot) indices; indices
     >= num_pages*page_size (use DROP_SLOT) are dropped (negative indices
     would wrap, so padding must use the out-of-range sentinel).
     (TPU-native replacement for the reference's block_copy.cu CUDA kernel —
     an XLA scatter the compiler lays out on the VPU.)
     """
-    np_, ps, kv, hd = cache_layer.shape
-    flat = cache_layer.reshape(np_ * ps, kv, hd)
+    np_, kv, ps, hd = cache_layer.shape
     idx = flat_slots.reshape(-1)
-    rows = new.reshape(-1, kv, hd).astype(flat.dtype)
-    flat = flat.at[idx].set(rows, mode="drop")
-    return flat.reshape(np_, ps, kv, hd)
+    pages = idx // ps   # DROP_SLOT → page >= num_pages → dropped
+    offs = idx % ps
+    rows = new.reshape(-1, kv, hd).astype(cache_layer.dtype)
+    # advanced indices (pages, offs) separated by the KV slice put the
+    # scatter axis first: target shape [B*T, KV, hd]
+    return cache_layer.at[pages, :, offs].set(rows, mode="drop")
+
+
+def _use_pallas() -> bool:
+    """Route decode attention through the Pallas kernel on TPU backends
+    (DYN_DISABLE_PALLAS=1 forces the XLA gather path everywhere)."""
+    if os.environ.get("DYN_DISABLE_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+               page_table: jax.Array, q_positions: jax.Array,
+               scale: float) -> jax.Array:
+    """Dispatch: decode (T==1) on TPU → Pallas flash kernel over pages;
+    otherwise the XLA gather path."""
+    if q.shape[1] == 1 and _use_pallas():
+        lengths = q_positions[:, 0] + 1  # padding rows: -1 → 0 → zeros out
+        return paged_attention_decode(q[:, 0], k_pages, v_pages, page_table,
+                                      lengths, scale=scale)[:, None]
+    return _paged_attention(q, k_pages, v_pages, page_table, q_positions,
+                            scale)
 
 
 def _paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
@@ -168,21 +201,21 @@ def _paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     """Gather-based paged GQA attention (XLA path; the Pallas kernel in
     dynamo_tpu/ops/paged_attention.py replaces this on TPU hot paths).
 
-    q: [B, T, H, hd]; k_pages/v_pages: [num_pages, ps, KV, hd];
+    q: [B, T, H, hd]; k_pages/v_pages: [num_pages, KV, ps, hd];
     page_table: [B, P]; q_positions: [B, T] (absolute, -1 for padding).
     Attends to logical positions j <= q_position (causal over the whole
     cached sequence, which includes the just-written chunk).
     """
     B, T, H, hd = q.shape
-    _, ps, KV, _ = k_pages.shape
+    _, KV, ps, _ = k_pages.shape
     P = page_table.shape[1]
     S = P * ps
     group = H // KV
 
-    k = k_pages[page_table]  # [B, P, ps, KV, hd]
+    k = k_pages[page_table]  # [B, P, KV, ps, hd]
     v = v_pages[page_table]
-    k = k.reshape(B, S, KV, hd)
-    v = v.reshape(B, S, KV, hd)
+    k = k.transpose(0, 1, 3, 2, 4).reshape(B, S, KV, hd)
+    v = v.transpose(0, 1, 3, 2, 4).reshape(B, S, KV, hd)
 
     qg = q.reshape(B, T, KV, group, hd)
     scores = jnp.einsum("btkgh,bskh->bkgts", qg.astype(jnp.float32),
@@ -262,8 +295,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         k = apply_rope(k, safe_pos, inv_freq)
         k_layer = _scatter_pages(k_layer, k, flat_slots)
         v_layer = _scatter_pages(v_layer, v, flat_slots)
-        attn = _paged_attention(q, k_layer, v_layer, page_table, positions,
-                                scale)
+        attn = _attention(q, k_layer, v_layer, page_table, positions, scale)
         h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
         x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
         if cfg.num_experts > 0:

@@ -1,0 +1,147 @@
+"""Pallas TPU kernel: decode-time paged GQA attention.
+
+The serving hot loop. The XLA fallback (models/llama.py _paged_attention)
+gathers every sequence's pages into a dense [B, S, KV, hd] tensor each
+decode step — O(B·S) HBM traffic through an intermediate buffer. This
+kernel instead walks the page table (scalar-prefetched so the index map
+can address pages before the body runs), streams each needed page
+HBM→VMEM exactly once, and runs an online-softmax (flash) accumulation
+on-chip for ALL heads of the sequence at once:
+
+  grid = (batch, pages); per (b, p): q·Kᵀ for every GQA group (MXU,
+  batched over the leading KV axis — the pool layout [N, KV, ps, hd] is
+  chosen so no in-kernel transpose is needed) → running max/sum rescale →
+  acc += softmax·V, output written on the final page step.
+
+Pages past a sequence's length are clamped to the row's first page in the
+index map: Pallas skips re-fetching a block whose index is unchanged, so
+trailing invalid pages cost no HBM traffic (and `pl.when` skips their
+compute). Short sequences therefore pay for the pages they own, not for
+the padded page-table width.
+
+This is the role block_copy.cu + the engines' paged-attention CUDA
+kernels play in the reference (SURVEY §2.3), expressed TPU-natively.
+
+Correctness contract (tests/test_ops.py): exact match with the XLA gather
+path in float32, masking by sequence length, page-0 padding convention
+(page_table rows padded with 0s; rows with length 0 produce zeros).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite "masked" value: keeps exp() NaN-free
+
+
+def _decode_kernel(ps: int, scale: float,
+                   # scalar prefetch
+                   pt_ref, len_ref,
+                   # blocks
+                   q_ref, k_ref, v_ref, o_ref,
+                   # scratch
+                   m_ref, l_ref, acc_ref):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    KV, group, hd = q_ref.shape[1:]
+    H = KV * group
+
+    @pl.when(p == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b]
+
+    @pl.when(p * ps < length)  # trailing invalid pages: no compute
+    def _():
+        q = q_ref[0].astype(jnp.float32)              # [KV, group, hd]
+        k = k_ref[0].astype(jnp.float32)              # [KV, ps, hd]
+        v = v_ref[0].astype(jnp.float32)
+
+        # batched over the shared leading KV axis (MXU, no transposes)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # [KV, group, ps]
+        pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_ref[:, :1].reshape(KV, group, 1)
+        l_prev = l_ref[:, :1].reshape(KV, group, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                # [KV, group, 1]
+        p_exp = jnp.exp(s - m_new)                     # [KV, group, ps]
+        l_new = alpha * l_prev + jnp.sum(p_exp, axis=2, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_exp, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)        # [KV, group, hd]
+        acc_ref[...] = acc_ref[...] * alpha.reshape(H, 1) + pv.reshape(H, hd)
+        m_ref[...] = jnp.broadcast_to(m_new.reshape(H, 1), m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new.reshape(H, 1), l_ref.shape)
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _():
+        l = jnp.maximum(l_ref[:, :1], 1e-9)  # length-0 (padding) rows → 0
+        o_ref[0] = (acc_ref[...] / l).reshape(KV, group, hd).astype(
+            o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention_decode(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_table: jax.Array,
+                           lengths: jax.Array, *, scale: float | None = None,
+                           interpret: bool = False) -> jax.Array:
+    """One decode step of paged GQA attention.
+
+    q: [B, H, hd]; k_pages/v_pages: [num_pages, KV, ps, hd];
+    page_table: [B, P] int32 (pad with 0 — page 0 is reserved);
+    lengths: [B] int32 — tokens of context per row INCLUDING the one just
+    written (rows with length 0 are padding and return zeros).
+    Returns [B, H, hd] in q.dtype.
+    """
+    B, H, hd = q.shape
+    _, KV, ps, _ = k_pages.shape
+    P = page_table.shape[1]
+    group = H // KV
+    if scale is None:
+        scale = hd ** -0.5
+    q4 = q.reshape(B, KV, group, hd)
+
+    def page_index(b, p, pt, ln):
+        # clamp invalid pages to the row's first page: identical consecutive
+        # block indices are not re-fetched by the pipeline
+        return (jnp.where(p * ps < ln[b], pt[b, p], pt[b, 0]), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, KV, group, hd),
+                         lambda b, p, pt, ln: (b, 0, 0, 0)),
+            pl.BlockSpec((1, KV, ps, hd), page_index),
+            pl.BlockSpec((1, KV, ps, hd), page_index),
+        ],
+        out_specs=pl.BlockSpec((1, KV, group, hd),
+                               lambda b, p, pt, ln: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),  # running max
+            pltpu.VMEM((H, 128), jnp.float32),  # running sum
+            pltpu.VMEM((H, hd), jnp.float32),   # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, ps, scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, group, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q4, k_pages, v_pages)
+    return out.reshape(B, H, hd)

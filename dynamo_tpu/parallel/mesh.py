@@ -94,11 +94,11 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
 
 
 def kv_cache_pspec(cfg: ModelConfig) -> P:
-    """KV pool [L, pages, page_size, kv_heads, head_dim]: heads over
+    """KV pool [L, pages, kv_heads, page_size, head_dim]: heads over
     "model" (requires kv_heads % model_parallel == 0 — true for Llama-3
     8B/70B GQA at TP<=8); replicated over "data" so any data row can
     reference any page."""
-    return P(None, None, None, "model", None)
+    return P(None, None, "model", None, None)
 
 
 def shard_params(params, cfg: ModelConfig, mesh: Mesh):

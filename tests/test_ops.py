@@ -1,0 +1,83 @@
+"""Pallas kernels vs their XLA reference paths (interpret mode on CPU —
+SURVEY §4 TPU test plan: sharding/kernels CI-testable without hardware)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.llama import _paged_attention
+from dynamo_tpu.ops.paged_attention import paged_attention_decode
+
+
+def _random_pages(key, num_pages, ps, KV, hd, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    shape = (num_pages, KV, ps, hd)  # kv-head-major pool layout
+    return (jax.random.normal(k1, shape, dtype),
+            jax.random.normal(k2, shape, dtype))
+
+
+@pytest.mark.parametrize("group,hd,ps", [(4, 64, 8), (1, 32, 16)])
+def test_decode_kernel_matches_gather(group, hd, ps):
+    KV = 2
+    H = KV * group
+    B, P, num_pages = 5, 4, 32
+    key = jax.random.PRNGKey(0)
+    kq, kp, kt = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, hd), jnp.float32)
+    k_pages, v_pages = _random_pages(kp, num_pages, ps, KV, hd)
+
+    # distinct random page tables + varied lengths (incl. exact page fill)
+    rng = np.random.RandomState(3)
+    table = np.zeros((B, P), np.int32)
+    lengths = np.array([1, ps, ps + 3, 2 * ps, P * ps], np.int32)
+    for b in range(B):
+        npages = -(-int(lengths[b]) // ps)
+        table[b, :npages] = rng.choice(
+            np.arange(1, num_pages), npages, replace=False)
+
+    scale = hd ** -0.5
+    got = paged_attention_decode(q, k_pages, v_pages, jnp.asarray(table),
+                                 jnp.asarray(lengths), scale=scale,
+                                 interpret=True)
+
+    # XLA gather path: q positions are length-1 (the just-written token)
+    positions = jnp.asarray(lengths - 1)[:, None]
+    want = _paged_attention(q[:, None], k_pages, v_pages, jnp.asarray(table),
+                            positions, scale)[:, 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_kernel_padding_rows_zero():
+    """length-0 rows (batch padding) must come out as zeros, not NaN."""
+    B, H, KV, hd, ps, P = 3, 4, 2, 32, 8, 2
+    q = jnp.ones((B, H, hd), jnp.float32)
+    k_pages, v_pages = _random_pages(jax.random.PRNGKey(1), 8, ps, KV, hd)
+    table = jnp.zeros((B, P), jnp.int32)
+    lengths = jnp.asarray([0, 5, 0], jnp.int32)
+    out = paged_attention_decode(q, k_pages, v_pages, table, lengths,
+                                 interpret=True)
+    out = np.asarray(out)
+    assert np.isfinite(out).all()
+    np.testing.assert_array_equal(out[0], 0.0)
+    np.testing.assert_array_equal(out[2], 0.0)
+    assert np.abs(out[1]).sum() > 0
+
+
+def test_decode_kernel_bf16():
+    B, H, KV, hd, ps, P = 2, 8, 4, 64, 8, 2
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, H, hd), jnp.bfloat16)
+    k_pages, v_pages = _random_pages(jax.random.PRNGKey(3), 8, ps, KV, hd,
+                                     jnp.bfloat16)
+    table = jnp.asarray([[1, 2], [3, 0]], jnp.int32)
+    lengths = jnp.asarray([11, 8], jnp.int32)
+    got = paged_attention_decode(q, k_pages, v_pages, table, lengths,
+                                 interpret=True)
+    assert got.dtype == jnp.bfloat16
+    positions = (lengths - 1)[:, None]
+    want = _paged_attention(q[:, None], k_pages, v_pages, table, positions,
+                            hd ** -0.5)[:, 0]
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=0.05, atol=0.05)
