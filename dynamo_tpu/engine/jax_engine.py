@@ -118,7 +118,7 @@ class JaxEngine:
     """AsyncEngine over the JAX model (token-level core engine)."""
 
     def __init__(self, model_cfg: ModelConfig, engine_cfg: Optional[EngineConfig]
-                 = None, params=None, seed: int = 0, dtype=None):
+                 = None, params=None, seed: int = 0, dtype=None, mesh=None):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
         if params is None:
@@ -126,7 +126,18 @@ class JaxEngine:
         self.params = params
         spec = KVCacheSpec(self.ecfg.num_pages, self.ecfg.page_size)
         self.kv_k, self.kv_v = init_kv_cache(model_cfg, spec, dtype)
-        self.prefill_fn, self.decode_fn = make_step_fns(model_cfg)
+        self.mesh = mesh
+        if mesh is not None and mesh.size > 1:
+            from ..parallel.mesh import shard_kv_cache, shard_params
+            self.params = shard_params(self.params, model_cfg, mesh)
+            self.kv_k, self.kv_v = shard_kv_cache(self.kv_k, self.kv_v,
+                                                  model_cfg, mesh)
+        # Pallas decode kernel only on unsharded pools: pallas_call has no
+        # GSPMD partitioning rule, so a mesh-sharded KV operand would be
+        # replicated per step (or fail to partition)
+        allow_pallas = mesh is None or mesh.size == 1
+        self.prefill_fn, self.decode_fn = make_step_fns(
+            model_cfg, allow_pallas=allow_pallas)
         self.pm = PageManager(self.ecfg.num_pages, self.ecfg.page_size)
         # guards PageManager between the event-loop thread (_admit) and
         # executor-thread disagg jobs (reserve/release/submit); engine steps
@@ -256,7 +267,8 @@ class JaxEngine:
             except Exception:  # noqa: BLE001 — engine loop must survive
                 log.exception("engine step failed")
                 for seq in self.prefilling + self.running:
-                    self._release(seq)
+                    with self._pm_lock:
+                        self._release(seq)
                     self._finish(seq, "error")
                 self.prefilling.clear()
                 self.running.clear()
@@ -633,5 +645,5 @@ class RemoteReservation:
 @partial(jax.jit, donate_argnums=(0,))
 def _inject_pages(pool: jax.Array, idx: jax.Array,
                   rows: jax.Array) -> jax.Array:
-    """pool: [L, num_pages, ps, KV, hd]; rows: [L, n, ps, KV, hd]."""
+    """pool: [L, num_pages, KV, ps, hd]; rows: [L, n, KV, ps, hd]."""
     return pool.at[:, idx].set(rows.astype(pool.dtype))

@@ -75,8 +75,10 @@ class DisaggDecodeEngine:
                                                     res.cached_tokens, depth)
             if not remote:
                 if res is not None:
-                    await self.engine.release_pages(res.pages)
-                    res = None
+                    # drop ownership before awaiting: a cancellation landing
+                    # at the await must not re-release in the finally block
+                    pages, res = res.pages, None
+                    await self.engine.release_pages(pages)
                 self.local_prefills += 1
                 async for out in self.engine.generate(request, context):
                     yield out
@@ -86,8 +88,8 @@ class DisaggDecodeEngine:
             first = await self._remote_prefill(request, context, res)
             if first is None:  # remote failed/timed out → local fallback
                 self.remote_fallbacks += 1
-                await self.engine.release_pages(res.pages)
-                res = None
+                pages, res = res.pages, None
+                await self.engine.release_pages(pages)
                 if context.stopped:
                     yield EngineOutput(finish_reason=FINISH_CANCELLED)
                     return

@@ -136,7 +136,7 @@ class KvTransferServer:
             return
         page_ids = list(h["page_ids"])
         if page_ids:
-            shape = tuple(h["shape"])  # [L, n, ps, KV, hd]
+            shape = tuple(h["shape"])  # [L, n, KV, ps, hd]
             dtype = _np_dtype(h["dtype"])
             k_len = h["k_len"]
             k = np.frombuffer(msg.body[:k_len], dtype).reshape(shape)

@@ -6,8 +6,8 @@ instead of ported:
 
 - layers are stacked on a leading axis and driven by ``lax.scan`` (one
   layer trace → fast XLA compiles at any depth);
-- the KV cache is a preallocated page pool ``[L, num_pages, page_size,
-  kv_heads, head_dim]`` living in HBM; sequences own pages via page tables
+- the KV cache is a preallocated page pool ``[L, num_pages, kv_heads,
+  page_size, head_dim]`` living in HBM; sequences own pages via page tables
   (the vLLM paged-KV idea, expressed as JAX gather/scatter so XLA can fuse
   and shard it);
 - prefill and decode share ONE attention path: write the new K/V into pages
@@ -184,10 +184,13 @@ def _use_pallas() -> bool:
 
 def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                page_table: jax.Array, q_positions: jax.Array,
-               scale: float) -> jax.Array:
+               scale: float, allow_pallas: bool = True) -> jax.Array:
     """Dispatch: decode (T==1) on TPU → Pallas flash kernel over pages;
-    otherwise the XLA gather path."""
-    if q.shape[1] == 1 and _use_pallas():
+    otherwise the XLA gather path. ``allow_pallas=False`` forces the XLA
+    path — required when the KV pool is sharded over a mesh (pallas_call
+    has no GSPMD partitioning rule, so a sharded operand would replicate
+    the whole pool per step)."""
+    if q.shape[1] == 1 and allow_pallas and _use_pallas():
         lengths = q_positions[:, 0] + 1  # padding rows: -1 → 0 → zeros out
         return paged_attention_decode(q[:, 0], k_pages, v_pages, page_table,
                                       lengths, scale=scale)[:, None]
@@ -262,6 +265,7 @@ def _moe_mlp(h: jax.Array, w_router, w_gate, w_up, w_down,
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             positions: jax.Array, kv_k: jax.Array, kv_v: jax.Array,
             page_table: jax.Array, flat_slots: jax.Array,
+            allow_pallas: bool = True,
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Shared prefill/decode forward.
 
@@ -295,7 +299,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         k = apply_rope(k, safe_pos, inv_freq)
         k_layer = _scatter_pages(k_layer, k, flat_slots)
         v_layer = _scatter_pages(v_layer, v, flat_slots)
-        attn = _attention(q, k_layer, v_layer, page_table, positions, scale)
+        attn = _attention(q, k_layer, v_layer, page_table, positions, scale,
+                          allow_pallas=allow_pallas)
         h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
         x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
         if cfg.num_experts > 0:
@@ -325,11 +330,13 @@ def logits_at(params: Params, cfg: ModelConfig, hidden: jax.Array,
 # ----------------------------------------------------- jitted entry points
 
 
-def make_step_fns(cfg: ModelConfig):
+def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True):
     """Build the jitted (prefill_step, decode_step) pair for one config.
 
     Closures instead of static args because ModelConfig holds dicts
     (rope_scaling). KV buffers are donated so XLA updates pages in place.
+    Pass ``allow_pallas=False`` when the KV pool is sharded over a mesh
+    (TP decode) until the kernel is shard_map-wrapped.
     """
 
     @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
@@ -338,7 +345,8 @@ def make_step_fns(cfg: ModelConfig):
                      flat_slots: jax.Array, last_idx: jax.Array):
         """Process prompt chunks [B, T]; returns (logits [B, V], kv_k, kv_v)."""
         h, kv_k2, kv_v2 = forward(params, cfg, tokens, positions, kv_k, kv_v,
-                                  page_table, flat_slots)
+                                  page_table, flat_slots,
+                                  allow_pallas=allow_pallas)
         return logits_at(params, cfg, h, last_idx), kv_k2, kv_v2
 
     @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
@@ -349,7 +357,8 @@ def make_step_fns(cfg: ModelConfig):
         (logits [B, V], kv_k, kv_v)."""
         h, kv_k2, kv_v2 = forward(params, cfg, tokens[:, None],
                                   positions[:, None], kv_k, kv_v,
-                                  page_table, flat_slots[:, None])
+                                  page_table, flat_slots[:, None],
+                                  allow_pallas=allow_pallas)
         return (logits_at(params, cfg, h,
                           jnp.zeros(tokens.shape[0], jnp.int32)),
                 kv_k2, kv_v2)

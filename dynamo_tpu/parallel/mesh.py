@@ -8,6 +8,8 @@ the collectives that NCCL calls performed in the reference.
 
 Axes:
 - ``data``  — batch rows (independent sequences; DP within one engine)
+- ``seq``   — sequence/context parallelism (ring attention over ICI for
+  long-context prefill; absent in the reference — SURVEY §5 long-context)
 - ``model`` — tensor parallelism: attention heads / MLP hidden / vocab
 - ``expert``— MoE expert parallelism (falls back onto ``model`` when absent)
 
@@ -32,19 +34,22 @@ class MeshSpec:
     data: int = 1
     model: int = 1
     expert: int = 1
+    seq: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.model * self.expert
+        return self.data * self.model * self.expert * self.seq
 
     def build(self, devices=None) -> Mesh:
         devices = devices if devices is not None else jax.devices()
         if len(devices) < self.num_devices:
             raise ValueError(
                 f"mesh needs {self.num_devices} devices, have {len(devices)}")
+        # seq innermost-but-one so ring ppermute hops ride neighbouring ICI
+        # links; model innermost (highest-bandwidth all-reduces)
         devs = np.asarray(devices[: self.num_devices]).reshape(
-            self.data, self.expert, self.model)
-        return Mesh(devs, ("data", "expert", "model"))
+            self.data, self.expert, self.seq, self.model)
+        return Mesh(devs, ("data", "expert", "seq", "model"))
 
     @classmethod
     def single(cls) -> "MeshSpec":

@@ -1,0 +1,205 @@
+"""Ring attention: sequence/context-parallel attention over an ICI ring.
+
+The reference has NO long-context story beyond KV reuse and disaggregating
+long prefills (SURVEY §5: "long-context / sequence parallelism: absent in
+the reference"); this module adds it as a first-class sharding strategy of
+the JAX prefill program, per the SURVEY's TPU plan.
+
+Design (blockwise/ring attention, Liu et al. style, TPU-idiomatic):
+
+- the sequence axis of Q/K/V activations is sharded over the mesh axis
+  ``seq``; each device holds a contiguous chunk;
+- K/V chunks rotate around the ring with ``lax.ppermute`` while each device
+  accumulates its queries' attention over every chunk using an online
+  (streaming) softmax — numerically identical to full softmax attention;
+- causality is enforced with absolute positions, so the same kernel serves
+  packed/padded and chunk-offset layouts (padding rows carry position -1);
+- the loop is a ``lax.scan`` of ``seq`` steps: one K/V block dot per step
+  on the MXU while the next block is in flight on ICI (XLA overlaps the
+  ppermute with compute since the carry has no data dependence on it until
+  the next step).
+
+``make_long_prefill_fn`` builds the full sequence-parallel prefill program:
+the Llama/Mixtral stack with activations sharded over ("data", "seq") and
+self-attention replaced by the ring kernel — producing per-layer K/V for
+the whole prompt (to be scattered into the paged pool / shipped to decode)
+plus last-position logits.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..models.config import ModelConfig
+
+NEG_INF = -1e30
+
+
+# ------------------------------------------------------------- ring kernel
+
+
+def _ring_attention_inner(q, k, v, q_pos, kv_pos, *, axis_name: str,
+                          scale: float):
+    """Per-device body (runs under shard_map over ``axis_name``).
+
+    q: [B, Tq, KV, G, hd] local query chunk (grouped GQA heads);
+    k/v: [B, Tk, KV, hd] local key/value chunk; q_pos/kv_pos: [B, T]
+    absolute positions (-1 = padding). Returns [B, Tq, KV, G, hd].
+    """
+    n = lax.psum(1, axis_name)
+    B, Tq, KV, G, hd = q.shape
+    qf = q.astype(jnp.float32)
+
+    m0 = jnp.full((B, KV, G, Tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, KV, G, Tq), jnp.float32)
+    acc0 = jnp.zeros((B, KV, G, Tq, hd), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        k_blk, v_blk, pos_blk, m, l, acc = carry
+        scores = jnp.einsum("btkgh,bskh->bkgts", qf,
+                            k_blk.astype(jnp.float32)) * scale
+        valid = (pos_blk[:, None, None, None, :] >= 0) & \
+                (pos_blk[:, None, None, None, :]
+                 <= q_pos[:, None, None, :, None])
+        scores = jnp.where(valid, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        # exp only where valid: when a row has no valid keys yet, m_new is
+        # still NEG_INF and exp(scores - m_new) would be exp(0)=1 — mask it
+        p = jnp.where(valid, jnp.exp(scores - m_new[..., None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bkgts,bskh->bkgth", p, v_blk.astype(jnp.float32))
+        k_blk, v_blk, pos_blk = (
+            lax.ppermute(k_blk, axis_name, perm),
+            lax.ppermute(v_blk, axis_name, perm),
+            lax.ppermute(pos_blk, axis_name, perm))
+        return (k_blk, v_blk, pos_blk, m_new, l, acc), None
+
+    (_, _, _, _, l, acc), _ = lax.scan(
+        step, (k, v, kv_pos, m0, l0, acc0), None, length=n)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, KV, G, Tq, hd]
+    return out.transpose(0, 3, 1, 2, 4).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   positions: jax.Array, mesh: Mesh, *,
+                   scale: float, seq_axis: str = "seq") -> jax.Array:
+    """Causal GQA attention with the sequence sharded over ``seq_axis``.
+
+    q: [B, T, H, hd]; k/v: [B, T, KV, hd]; positions: [B, T] absolute
+    (-1 for padding). All sequence-sharded over ``seq_axis``; heads may be
+    additionally sharded over "model" (the kernel is per-head, so TP
+    composes freely). Returns [B, T, H, hd] with q's sharding.
+    """
+    B, T, H, hd = q.shape
+    KV = k.shape[2]
+    qg = q.reshape(B, T, KV, H // KV, hd)
+
+    # TP shards KV heads over "model" (consistent with mesh.kv_cache_pspec);
+    # each TP rank runs the ring over its own head slice
+    qspec = P("data", seq_axis, "model", None, None)
+    kvspec = P("data", seq_axis, "model", None)
+    pspec = P("data", seq_axis)
+
+    inner = partial(_ring_attention_inner, axis_name=seq_axis, scale=scale)
+    out = shard_map(
+        inner, mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec, pspec, pspec),
+        out_specs=qspec, check_vma=False,
+    )(qg, k, v, positions, positions)
+    return out.reshape(B, T, H, hd)
+
+
+# -------------------------------------------- sequence-parallel prefill fn
+
+
+def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
+                         seq_axis: str = "seq"):
+    """Jitted long-context prefill: the model stack with activations
+    sharded over ("data", seq) and ring attention.
+
+    Returns ``fn(params, tokens, positions) -> (logits [B, V], k_all, v_all)``
+    where k_all/v_all are [L, B, T, KV, hd] (per-layer KV for the whole
+    prompt — scatter into the paged pool with
+    :func:`scatter_prefill_kv`, or ship to the decode mesh via the disagg
+    transfer plane). ``positions`` are absolute; -1 marks padding.
+    """
+    from ..models.llama import (_mlp, _moe_mlp, apply_rope, rms_norm,
+                                rope_freqs)
+
+    inv_freq = rope_freqs(cfg)
+    scale = 1.0 / math.sqrt(cfg.head_dim_)
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+
+    act_spec = NamedSharding(mesh, P("data", seq_axis, None))
+
+    @jax.jit
+    def long_prefill(params, tokens, positions):
+        B, T = tokens.shape
+        h = params["embed"][tokens]
+        h = lax.with_sharding_constraint(h, act_spec)
+        safe_pos = jnp.maximum(positions, 0)
+
+        layer_params = {kk: params[kk] for kk in
+                        ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                         "ln_attn", "ln_mlp")}
+        if cfg.num_experts > 0:
+            layer_params["w_router"] = params["w_router"]
+
+        def layer(h, lp):
+            x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+            q = apply_rope((x @ lp["wq"]).reshape(B, T, H, hd), safe_pos,
+                           inv_freq)
+            k = apply_rope((x @ lp["wk"]).reshape(B, T, KV, hd), safe_pos,
+                           inv_freq)
+            v = (x @ lp["wv"]).reshape(B, T, KV, hd)
+            attn = ring_attention(q, k, v, positions, mesh, scale=scale,
+                                  seq_axis=seq_axis)
+            h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
+            x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+            if cfg.num_experts > 0:
+                h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"],
+                                 lp["w_up"], lp["w_down"],
+                                 cfg.num_experts_per_tok)
+            else:
+                h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+            h = lax.with_sharding_constraint(h, act_spec)
+            return h, (k, v)
+
+        h, (k_all, v_all) = lax.scan(layer, h, layer_params)
+        h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+        # logits at the true last token of each row (max position)
+        last_idx = jnp.argmax(positions, axis=1)
+        h_last = h[jnp.arange(B), last_idx]
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T
+        logits = (h_last @ head).astype(jnp.float32)
+        return logits, k_all, v_all
+
+    return long_prefill
+
+
+def scatter_prefill_kv(kv_k: jax.Array, kv_v: jax.Array, k_all: jax.Array,
+                       v_all: jax.Array, flat_slots: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Write long-prefill K/V ([L, B, T, KV, hd]) into the paged pools
+    ([L, pages, KV, ps, hd]) at ``flat_slots`` [B, T] (page*ps + offset;
+    out-of-range = drop). Jit-compatible; vmapped over layers."""
+    from ..models.llama import _scatter_pages
+
+    def per_layer(cache_layer, new):
+        return _scatter_pages(cache_layer, new, flat_slots)
+
+    return (jax.vmap(per_layer)(kv_k, k_all),
+            jax.vmap(per_layer)(kv_v, v_all))
