@@ -56,6 +56,10 @@ class EngineConfig:
     max_batch: int = 64
     prefill_chunk: int = 512
     max_top_k: int = 64
+    # host-DRAM offload tier: blocks evicted from HBM spill here and
+    # restore on prefix hits (reference kv/ V2 multi-tier storage +
+    # docs/kv_cache_manager.md "+40% TTFT"); 0 disables the tier
+    host_pages: int = 0
     # bucketing (static shapes under jit); keep these sets SMALL — every
     # (bucket combination) is one XLA compile, and warmup() pre-compiles
     # the full grid so serving never compiles mid-flight
@@ -138,7 +142,19 @@ class JaxEngine:
         allow_pallas = mesh is None or mesh.size == 1
         self.prefill_fn, self.decode_fn = make_step_fns(
             model_cfg, allow_pallas=allow_pallas)
-        self.pm = PageManager(self.ecfg.num_pages, self.ecfg.page_size)
+        self.pm = PageManager(self.ecfg.num_pages, self.ecfg.page_size,
+                              host_pages=self.ecfg.host_pages)
+        # host-DRAM offload pools (same per-page layout as the HBM pool)
+        self.host_k = self.host_v = None
+        if self.ecfg.host_pages > 0:
+            hshape = (model_cfg.num_layers, self.ecfg.host_pages,
+                      model_cfg.num_kv_heads, self.ecfg.page_size,
+                      model_cfg.head_dim_)
+            hdtype = np.asarray(jnp.zeros((), self.kv_k.dtype)).dtype
+            self.host_k = np.zeros(hshape, hdtype)
+            self.host_v = np.zeros(hshape, hdtype)
+        self.offload_pages_total = 0
+        self.restore_pages_total = 0
         # guards PageManager between the event-loop thread (_admit) and
         # executor-thread disagg jobs (reserve/release/submit); engine steps
         # are already serialized with those jobs by the single-worker executor
@@ -246,6 +262,9 @@ class JaxEngine:
             "gpu_prefix_cache_hit_rate":
                 (self.prefix_hit_tokens_total /
                  max(self.prompt_tokens_total, 1)),
+            "host_cache_usage_perc": self.pm.host_usage(),
+            "host_offload_pages_total": self.offload_pages_total,
+            "host_restore_pages_total": self.restore_pages_total,
         }
 
     # ------------------------------------------------------- scheduler loop
@@ -301,10 +320,44 @@ class JaxEngine:
                 self.prompt_tokens_total += seq.num_prompt
             self.prefilling.append(seq)
 
+    # ------------------------------------------------------- KV tier drain
+
+    def _drain_kv_tier(self) -> None:
+        """Run queued HBM↔host page copies (executor thread, before any
+        device step so offloads read pre-step content and restores land
+        before their pages are attended to). Batched, pow2-padded gathers
+        keep the compile count logarithmic in batch size."""
+        if self.host_k is None:
+            return
+        with self._pm_lock:
+            off, res = self.pm.drain_tier_ops()
+        if off:
+            pages = [p for p, _ in off]
+            slots = [s for _, s in off]
+            idx = jnp.asarray(_pad_pow2(pages, 0), jnp.int32)
+            k = np.asarray(_gather_pages(self.kv_k, idx))
+            v = np.asarray(_gather_pages(self.kv_v, idx))
+            self.host_k[:, slots] = k[:, :len(off)]
+            self.host_v[:, slots] = v[:, :len(off)]
+            self.offload_pages_total += len(off)
+        if res:
+            pages = [p for p, _ in res]
+            slots = [s for _, s in res]
+            # pad targets out-of-range → dropped by the scatter; pad the
+            # host gather with slot 0 (content discarded)
+            idx = _pad_pow2(pages, self.ecfg.num_pages)
+            hsl = _pad_pow2(slots, 0)
+            self.kv_k = _inject_pages(self.kv_k, jnp.asarray(idx, jnp.int32),
+                                      jnp.asarray(self.host_k[:, hsl]))
+            self.kv_v = _inject_pages(self.kv_v, jnp.asarray(idx, jnp.int32),
+                                      jnp.asarray(self.host_v[:, hsl]))
+            self.restore_pages_total += len(res)
+
     # ------------------------------------------------------------- prefill
 
     def _prefill_step(self) -> None:
         """One chunked-prefill step for the oldest prefilling sequence."""
+        self._drain_kv_tier()
         seq = self.prefilling[0]
         if seq.context.stopped:
             self.prefilling.pop(0)
@@ -361,6 +414,7 @@ class JaxEngine:
     # -------------------------------------------------------------- decode
 
     def _decode_step(self) -> None:
+        self._drain_kv_tier()
         batch = [s for s in self.running if s.finished is None]
         # submit_prefilled can push running past max_batch; overflow rows
         # simply wait a round (arrays below are sized ≤ max_batch)
@@ -541,6 +595,7 @@ class JaxEngine:
         loop = asyncio.get_running_loop()
 
         def _do():
+            self._drain_kv_tier()  # restored pages must be resident first
             idx = jnp.asarray(page_ids, jnp.int32)
             return (np.asarray(self.kv_k[:, idx]),
                     np.asarray(self.kv_v[:, idx]))
@@ -645,5 +700,21 @@ class RemoteReservation:
 @partial(jax.jit, donate_argnums=(0,))
 def _inject_pages(pool: jax.Array, idx: jax.Array,
                   rows: jax.Array) -> jax.Array:
-    """pool: [L, num_pages, KV, ps, hd]; rows: [L, n, KV, ps, hd]."""
-    return pool.at[:, idx].set(rows.astype(pool.dtype))
+    """pool: [L, num_pages, KV, ps, hd]; rows: [L, n, KV, ps, hd].
+    Out-of-range idx entries are dropped (padding)."""
+    return pool.at[:, idx].set(rows.astype(pool.dtype), mode="drop")
+
+
+@jax.jit
+def _gather_pages(pool: jax.Array, idx: jax.Array) -> jax.Array:
+    """pool: [L, num_pages, KV, ps, hd] → [L, n, KV, ps, hd]."""
+    return pool[:, idx]
+
+
+def _pad_pow2(lst: List[int], fill: int) -> List[int]:
+    """Pad to the next power of two so batched page copies compile
+    O(log n) distinct shapes instead of one per length."""
+    n = 1
+    while n < len(lst):
+        n *= 2
+    return list(lst) + [fill] * (n - len(lst))

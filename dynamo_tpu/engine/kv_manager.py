@@ -17,6 +17,16 @@ Pages are identified by pool index. A page is either free (never valid),
 active (refcount > 0), or reusable (refcount 0, contents intact, reusable
 by hash until evicted). Evictions pop the least-recently-freed reusable
 page (LRU-FIFO like the reference's priority 0 tier).
+
+**Host offload tier** (reference kv/ V2 StorageType::{System,Pinned} +
+docs/kv_cache_manager.md, the "+40% TTFT" headline): with ``host_pages >
+0``, a block evicted from the HBM pool moves to a host-DRAM pool instead
+of being dropped — the manager queues a device→host copy
+(``pending_offload``) and keeps the block matchable via its hash. A prefix
+hit on a host block allocates a fresh HBM page and queues a host→device
+restore (``pending_restore``); the engine drains both queues as batched
+page copies before its next device step (jax_engine._drain_kv_tier).
+"removed" router events fire only when a block leaves BOTH tiers.
 """
 
 from __future__ import annotations
@@ -72,10 +82,27 @@ class PageState:
     block_hash: Optional[int] = None  # set when committed (full + hashed)
 
 
+@dataclass
+class Alloc:
+    """Result of ``allocate_sequence``. Iterates/indexes as the legacy
+    (pages, cached_tokens) pair; ``restores`` lists (page, host_slot)
+    host→device copies the engine must drain before computing on them."""
+
+    pages: List[int]
+    cached_tokens: int
+    restores: List[Tuple[int, int]] = field(default_factory=list)
+
+    def __iter__(self):
+        return iter((self.pages, self.cached_tokens))
+
+    def __getitem__(self, i):
+        return (self.pages, self.cached_tokens)[i]
+
+
 class PageManager:
     """Host-side page pool bookkeeping with prefix reuse."""
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int, host_pages: int = 0):
         self.num_pages = num_pages
         self.page_size = page_size
         # page 0 is reserved as the padding target in device page tables
@@ -85,6 +112,13 @@ class PageManager:
         self.by_hash: Dict[int, int] = {}  # block_hash → page id
         self.events: List[KvEvent] = []
         self.pages[0].refcount = 1  # never allocated
+        # host offload tier
+        self.host_pages = host_pages
+        self.host_free: deque = deque(range(host_pages))
+        self.host_by_hash: Dict[int, int] = {}   # block_hash → host slot
+        self.host_lru: "OrderedDict[int, int]" = OrderedDict()  # slot → hash
+        self.pending_offload: List[Tuple[int, int]] = []  # (page, host_slot)
+        self.pending_restore: List[Tuple[int, int]] = []  # (page, host_slot)
 
     # ------------------------------------------------------------- queries
 
@@ -114,28 +148,63 @@ class PageManager:
     # ---------------------------------------------------------- allocation
 
     def allocate_sequence(self, token_ids: Sequence[int],
-                          extra_pages: int = 0) -> Optional[Tuple[List[int], int]]:
-        """Claim pages for a prompt: reuse the longest cached prefix, then
-        fresh pages to cover the prompt (+extra_pages headroom).
+                          extra_pages: int = 0) -> Optional[Alloc]:
+        """Claim pages for a prompt: reuse the longest cached prefix
+        (HBM pages directly; host-tier blocks via a fresh page + queued
+        restore copy), then fresh pages to cover the prompt (+extra_pages
+        headroom).
 
-        Returns (page_ids, num_cached_tokens) or None if out of memory.
-        The last (partial) block is never matched (reference
-        manager.rs prepare_prefill_sequence semantics).
+        Returns an :class:`Alloc` or None if out of memory. The last
+        (partial) block is never matched (reference manager.rs
+        prepare_prefill_sequence semantics).
         """
         need_total = (len(token_ids) + self.page_size - 1) // self.page_size \
             + extra_pages
-        cached_pages, _ = self.match_prefix(token_ids)
         # full-prompt hit: leave at least the final token to recompute so
         # prefill produces logits (cap reuse at len-1 tokens)
         max_reuse = max((len(token_ids) - 1) // self.page_size, 0)
-        cached_pages = cached_pages[:max_reuse]
-        need_fresh = need_total - len(cached_pages)
+        chain = chain_hashes(token_ids, self.page_size)[:max_reuse]
+        # walk the chain across both tiers; device hit → reuse page,
+        # host hit → fresh page + restore; stop at the first full miss
+        plan: List[Tuple[Optional[int], Optional[int], int]] = []
+        for h in chain:
+            page = self.by_hash.get(h)
+            if page is not None:
+                plan.append((page, None, h))
+                continue
+            slot = self.host_by_hash.get(h)
+            if slot is not None:
+                plan.append((None, slot, h))
+                continue
+            break
+        n_restore = sum(1 for p, _, _ in plan if p is None)
+        need_fresh = need_total - (len(plan) - n_restore)
         if need_fresh > self.available:
             return None
-        for p in cached_pages:
-            self._ref(p)
-        fresh = [self._pop_fresh() for _ in range(need_fresh)]
-        return cached_pages + fresh, len(cached_pages) * self.page_size
+        # ref every device hit BEFORE popping fresh pages: a pop can evict
+        # refcount-0 reusable pages, including ones matched later in plan
+        for page, _, _ in plan:
+            if page is not None:
+                self._ref(page)
+        claimed: List[int] = []
+        restores: List[Tuple[int, int]] = []
+        for page, slot, h in plan:
+            if page is not None:
+                claimed.append(page)
+            else:
+                fresh = self._pop_fresh()
+                # promote back to the device tier: matchable immediately
+                # (the engine drains the copy before its next device step);
+                # no "stored" event — the block never left this worker
+                self.pages[fresh].block_hash = h
+                self.by_hash[h] = fresh
+                self.host_lru.move_to_end(self.host_by_hash[h])
+                restores.append((fresh, slot))
+                claimed.append(fresh)
+        for _ in range(need_total - len(claimed)):
+            claimed.append(self._pop_fresh())
+        self.pending_restore.extend(restores)
+        return Alloc(claimed, len(plan) * self.page_size, restores)
 
     def allocate_page(self) -> Optional[int]:
         """One more page for a growing sequence (decode)."""
@@ -198,13 +267,66 @@ class PageManager:
             page, _ = self.reusable.popitem(last=False)  # evict LRU reusable
             st = self.pages[page]
             if st.block_hash is not None:
-                del self.by_hash[st.block_hash]
-                self.events.append(KvEvent("removed", [st.block_hash]))
+                h = st.block_hash
+                del self.by_hash[h]
                 st.block_hash = None
+                slot = None
+                if self.host_pages > 0:
+                    if h in self.host_by_hash:
+                        # block already resident in the host tier (this page
+                        # was a restore) — no copy, just refresh LRU
+                        self.host_lru.move_to_end(self.host_by_hash[h])
+                        slot = self.host_by_hash[h]
+                    else:
+                        slot = self._host_slot()
+                        if slot is not None:
+                            self.host_by_hash[h] = slot
+                            self.host_lru[slot] = h
+                            self.pending_offload.append((page, slot))
+                if slot is None:
+                    self.events.append(KvEvent("removed", [h]))
+        # the page may carry a stale queued restore (its sequence released
+        # before any device step drained it) — a late copy would clobber
+        # the new owner's content
+        if self.pending_restore:
+            self.pending_restore = [(p, s) for p, s in self.pending_restore
+                                    if p != page]
         st = self.pages[page]
         assert st.refcount == 0
         st.refcount = 1
         return page
+
+    def _host_slot(self) -> Optional[int]:
+        """Claim a host-tier slot, evicting the LRU host block if full.
+        Slots referenced by queued copies are pinned (a reassignment before
+        the drain would corrupt the in-flight copy); returns None when the
+        whole tier is pinned. A "removed" event fires only when the evicted
+        block has no device copy either (it leaves the worker entirely)."""
+        if self.host_free:
+            return self.host_free.popleft()
+        busy = {s for _, s in self.pending_restore}
+        busy.update(s for _, s in self.pending_offload)
+        for slot in self.host_lru:  # LRU → MRU order
+            if slot not in busy:
+                old_h = self.host_lru.pop(slot)
+                del self.host_by_hash[old_h]
+                if old_h not in self.by_hash:
+                    self.events.append(KvEvent("removed", [old_h]))
+                return slot
+        return None
+
+    def drain_tier_ops(self) -> Tuple[List[Tuple[int, int]],
+                                      List[Tuple[int, int]]]:
+        """Pop queued (page, host_slot) tier copies: (offloads, restores).
+        The engine must run offloads before restores, and both before its
+        next device step."""
+        off, self.pending_offload = self.pending_offload, []
+        res, self.pending_restore = self.pending_restore, []
+        return off, res
+
+    def host_usage(self) -> float:
+        return len(self.host_by_hash) / self.host_pages if self.host_pages \
+            else 0.0
 
     def drain_events(self) -> List[KvEvent]:
         out, self.events = self.events, []
