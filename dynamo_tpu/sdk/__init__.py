@@ -1,0 +1,17 @@
+"""Serving SDK (reference deploy/dynamo/sdk, SURVEY §2.7): declarative
+service graphs — ``@service``, ``@dynamo_endpoint``, ``depends()``,
+``.link()``, ``@async_on_start`` — deployed via ``python -m
+dynamo_tpu.sdk.cli serve module:Entry`` or in-process with
+``deploy_inline``."""
+
+from .config import ServiceConfig
+from .runner import (DependencyHandle, InlineDeployment, ServiceWorker,
+                     deploy_inline)
+from .service import (DynamoService, api, async_on_start, depends,
+                      dynamo_endpoint, service)
+
+__all__ = [
+    "ServiceConfig", "DependencyHandle", "InlineDeployment", "ServiceWorker",
+    "deploy_inline", "DynamoService", "api", "async_on_start", "depends",
+    "dynamo_endpoint", "service",
+]

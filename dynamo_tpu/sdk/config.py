@@ -1,0 +1,58 @@
+"""Per-service configuration (reference sdk lib/config.py ServiceConfig:
+YAML file keyed by service name + ``DYNAMO_SERVICE_CONFIG`` env override,
+exploded into the service instance)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+ENV_KEY = "DYNAMO_SERVICE_CONFIG"
+
+
+class ServiceConfig:
+    """Singleton mapping ``{service_name: {key: value}}``."""
+
+    _instance: Optional["ServiceConfig"] = None
+
+    def __init__(self, data: Optional[Dict[str, Dict[str, Any]]] = None):
+        self.data: Dict[str, Dict[str, Any]] = data or {}
+
+    # ------------------------------------------------------------ loading
+
+    @classmethod
+    def get_instance(cls) -> "ServiceConfig":
+        if cls._instance is None:
+            cls._instance = cls.from_env()
+        return cls._instance
+
+    @classmethod
+    def set_instance(cls, cfg: "ServiceConfig") -> None:
+        cls._instance = cfg
+
+    @classmethod
+    def from_env(cls) -> "ServiceConfig":
+        raw = os.environ.get(ENV_KEY)
+        return cls(json.loads(raw)) if raw else cls()
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ServiceConfig":
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        if not isinstance(data, dict):
+            raise ValueError(f"service config must be a mapping: {path}")
+        return cls(data)
+
+    def to_env_value(self) -> str:
+        return json.dumps(self.data)
+
+    # ------------------------------------------------------------- access
+
+    def for_service(self, name: str) -> Dict[str, Any]:
+        return dict(self.data.get(name, {}))
+
+    def get(self, service: str, key: str, default: Any = None) -> Any:
+        return self.data.get(service, {}).get(key, default)

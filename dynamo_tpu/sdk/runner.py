@@ -1,0 +1,164 @@
+"""SDK runtime: wire and serve DynamoService instances.
+
+Per-worker path (reference cli/serve_dynamo.py:62-189): connect the
+DistributedRuntime, create the component, resolve ``depends()`` edges into
+live clients, run ``@async_on_start`` hooks, then serve every declared
+endpoint. ``deploy_inline`` runs a whole graph in one process/event loop
+(the reference sdk tests' local pipelines, sdk/tests/{pipeline,e2e}.py) —
+also the fast path for single-host serving without process isolation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..runtime.component import AsyncResponseStream, Client
+from ..runtime.engine import Context
+from ..runtime.runtime import DistributedRuntime
+from .config import ServiceConfig
+from .service import DynamoService
+
+log = logging.getLogger("dynamo_tpu.sdk")
+
+
+class DependencyHandle:
+    """Live client edge injected for each ``depends()`` attribute."""
+
+    def __init__(self, target: DynamoService, client: Client):
+        self.target = target
+        self.client = client
+
+    async def generate(self, request: Any, **kw) -> AsyncResponseStream:
+        return await self.client.generate(request, **kw)
+
+    async def round_robin(self, request: Any, **kw) -> AsyncResponseStream:
+        return await self.client.round_robin(request, **kw)
+
+    async def random(self, request: Any, **kw) -> AsyncResponseStream:
+        return await self.client.random(request, **kw)
+
+    async def direct(self, request: Any, instance_id: int,
+                     **kw) -> AsyncResponseStream:
+        return await self.client.direct(request, instance_id, **kw)
+
+    def instance_ids(self) -> List[int]:
+        return self.client.instance_ids()
+
+    async def wait_for_instances(self, timeout: float = 30.0) -> List[int]:
+        return await self.client.wait_for_instances(timeout)
+
+    async def collect_stats(self, timeout: float = 2.0) -> Dict[int, dict]:
+        return await self.client.collect_stats(timeout)
+
+
+class ServiceWorker:
+    """One running worker of a service (one process in `serve`, one task
+    group in `deploy_inline`)."""
+
+    def __init__(self, svc: DynamoService, drt: DistributedRuntime,
+                 config: Optional[ServiceConfig] = None):
+        self.svc = svc
+        self.drt = drt
+        self.config = config or ServiceConfig.get_instance()
+        self.instance: Any = None
+        self._handles: list = []
+        self._clients: List[Client] = []
+
+    async def start(self) -> None:
+        svc = self.svc
+        inst = object.__new__(svc.cls)  # construct without running __init__
+        # inject service config BEFORE __init__ so it can read overrides
+        inst.service_config = self.config.for_service(svc.name)
+        inst.dynamo_service = svc
+        inst.runtime = self.drt
+        init = getattr(svc.cls, "__init__", None)
+        if init and init is not object.__init__:
+            init(inst)
+        # resolve dependency edges
+        for attr, target in svc.depends_attrs.items():
+            ep = target.endpoints[0].name if target.endpoints else "generate"
+            address = f"{target.namespace}.{target.name}.{ep}"
+            client = await self.drt.namespace(target.namespace).component(
+                target.name).endpoint(ep).client()
+            self._clients.append(client)
+            inst.__dict__[f"__dep_{attr}"] = DependencyHandle(target, client)
+        self.instance = inst
+        component = self.drt.namespace(svc.namespace).component(svc.name)
+        await component.create_service()
+        for m in svc.on_start_methods:
+            await getattr(inst, m)()
+        for ep in svc.endpoints:
+            method = getattr(inst, ep.method)
+            handler = _adapt_handler(method)
+            stats = getattr(inst, "stats_handler", None)
+            h = await component.endpoint(ep.name).serve(
+                handler, stats_handler=stats)
+            self._handles.append(h)
+        log.info("service %s.%s serving %d endpoint(s)", svc.namespace,
+                 svc.name, len(self._handles))
+
+    async def stop(self) -> None:
+        for h in self._handles:
+            await h.stop()
+        for c in self._clients:
+            await c.close()
+        stop = getattr(self.instance, "on_stop", None)
+        if stop is not None:
+            res = stop()
+            if asyncio.iscoroutine(res):
+                await res
+
+
+def _adapt_handler(method):
+    """Endpoint methods may be ``async def m(self, request)`` or
+    ``async def m(self, request, context)``; the runtime always calls
+    handler(request, context)."""
+    import inspect
+
+    sig = inspect.signature(method)
+    takes_ctx = len(sig.parameters) >= 2
+
+    if takes_ctx:
+        return method
+
+    def handler(request, context: Context):
+        return method(request)
+
+    return handler
+
+
+class InlineDeployment:
+    """A whole service graph running in one process (tests / single host)."""
+
+    def __init__(self, drt: DistributedRuntime,
+                 workers: List[ServiceWorker]):
+        self.drt = drt
+        self.workers = workers
+
+    async def client(self, svc: DynamoService,
+                     endpoint: Optional[str] = None) -> Client:
+        ep = endpoint or (svc.endpoints[0].name if svc.endpoints
+                          else "generate")
+        return await self.drt.namespace(svc.namespace).component(
+            svc.name).endpoint(ep).client()
+
+    async def stop(self) -> None:
+        for w in self.workers:
+            await w.stop()
+
+
+async def deploy_inline(entry: DynamoService,
+                        drt: Optional[DistributedRuntime] = None,
+                        config: Optional[ServiceConfig] = None
+                        ) -> InlineDeployment:
+    """Deploy ``entry.graph()`` into one event loop. Services are started
+    dependency-first so ``wait_for_instances`` in on_start hooks resolves."""
+    drt = drt or await DistributedRuntime.detached()
+    workers: List[ServiceWorker] = []
+    for svc in entry.graph():
+        w = ServiceWorker(svc, drt, config)
+        await w.start()
+        workers.append(w)
+    return InlineDeployment(drt, workers)

@@ -1,0 +1,191 @@
+"""Service SDK: declarative distributed graphs over the runtime.
+
+Reference deploy/dynamo/sdk (BentoML-derived, SURVEY §2.7):
+``@service(dynamo={...}, resources={...}, workers=N)`` wraps a class into a
+:class:`DynamoService` (reference lib/service.py:67-241); ``@dynamo_endpoint``
+marks streaming endpoint methods (lib/decorators.py:26-101); ``depends(Svc)``
+declares runtime client edges (lib/dependency.py); ``A.link(B)`` activates
+deployment edges for a graph file (lib/service.py:173-177, used by
+examples/llm/graphs/*.py); ``@async_on_start`` hooks run before serving
+(cli/serve_dynamo.py:110-189).
+
+TPU-first re-design notes: services are plain asyncio classes served by the
+in-process runtime (no BentoML runner layer); one service worker = one
+process = (potentially) one SPMD program over its own mesh; resources
+declare ``tpu`` chips instead of ``gpu``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+log = logging.getLogger("dynamo_tpu.sdk")
+
+_ENDPOINT_ATTR = "__dynamo_endpoint__"
+_ON_START_ATTR = "__dynamo_on_start__"
+
+
+@dataclass
+class EndpointDef:
+    name: str
+    method: str          # attribute name on the class
+    is_default: bool = False  # first declared endpoint = the service's API
+
+
+def dynamo_endpoint(name: Optional[str] = None, **_kw):
+    """Mark an async-generator method as a served endpoint
+    (reference sdk lib/decorators.py ``@dynamo_endpoint``). Accepts and
+    ignores legacy typing kwargs for signature compatibility."""
+
+    def deco(fn):
+        setattr(fn, _ENDPOINT_ATTR, name or fn.__name__)
+        return fn
+
+    # bare usage: @dynamo_endpoint
+    if callable(name):
+        fn, name = name, None
+        return deco(fn)
+    return deco
+
+
+# reference sdk also exposes `api` as the bento-style alias
+api = dynamo_endpoint
+
+
+def async_on_start(fn):
+    """Mark an async method to run after runtime wiring, before serving
+    (reference ``@async_on_start``, cli/serve_dynamo.py:139)."""
+    setattr(fn, _ON_START_ATTR, True)
+    return fn
+
+
+class Depends:
+    """Declared dependency edge: resolves to a live client at runtime
+    (reference sdk lib/dependency.py). Use as a class attribute:
+
+        class Processor:
+            worker = depends(Worker)
+
+    Inside methods, ``self.worker`` is a :class:`DependencyHandle`.
+    """
+
+    def __init__(self, target: "DynamoService"):
+        if not isinstance(target, DynamoService):
+            raise TypeError("depends() takes a @service-decorated class")
+        self.target = target
+        self.attr: Optional[str] = None
+
+    def __set_name__(self, owner, name):
+        self.attr = name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        handle = obj.__dict__.get(f"__dep_{self.attr}")
+        if handle is None:
+            raise RuntimeError(
+                f"dependency {self.attr!r} not wired (service not started "
+                f"through the SDK runner)")
+        return handle
+
+
+def depends(target: "DynamoService") -> Depends:
+    return Depends(target)
+
+
+class DynamoService:
+    """A deployable service: user class + deployment metadata + edges."""
+
+    def __init__(self, cls: type, *, name: str, namespace: str,
+                 workers: int, resources: Dict[str, Any],
+                 dynamo_enabled: bool):
+        self.cls = cls
+        self.name = name
+        self.namespace = namespace
+        self.workers = workers
+        self.resources = resources or {}
+        self.dynamo_enabled = dynamo_enabled
+        self.links: List["DynamoService"] = []
+        self.endpoints: List[EndpointDef] = []
+        for attr, fn in inspect.getmembers(cls, inspect.isfunction):
+            ep = getattr(fn, _ENDPOINT_ATTR, None)
+            if ep:
+                self.endpoints.append(EndpointDef(name=ep, method=attr))
+        # declaration order, not alphabetic: re-sort by source line
+        self.endpoints.sort(
+            key=lambda e: getattr(getattr(cls, e.method), "__code__",
+                                  None).co_firstlineno
+            if hasattr(getattr(cls, e.method), "__code__") else 0)
+        if self.endpoints:
+            self.endpoints[0].is_default = True
+        self.on_start_methods = [
+            attr for attr, fn in inspect.getmembers(cls, inspect.isfunction)
+            if getattr(fn, _ON_START_ATTR, False)]
+        self.depends_attrs: Dict[str, DynamoService] = {
+            a: d.target for a, d in vars(cls).items()
+            if isinstance(d, Depends)}
+
+    # ------------------------------------------------------------- graph
+
+    def link(self, other: "DynamoService") -> "DynamoService":
+        """Activate a deployment edge self→other; returns ``other`` so
+        graphs chain: ``Frontend.link(Processor).link(Worker)``
+        (reference lib/service.py:173-177)."""
+        if other not in self.links:
+            self.links.append(other)
+        return other
+
+    def graph(self) -> List["DynamoService"]:
+        """All services reachable from this one via link + depends edges,
+        dependency-first order (reference LinkedServices semantics)."""
+        seen: Set[int] = set()
+        out: List[DynamoService] = []
+
+        def visit(svc: "DynamoService"):
+            if id(svc) in seen:
+                return
+            seen.add(id(svc))
+            for dep in svc.depends_attrs.values():
+                visit(dep)
+            for l in svc.links:
+                visit(l)
+            out.append(svc)
+
+        visit(self)
+        return out
+
+    # ---------------------------------------------------------- addressing
+
+    @property
+    def component_name(self) -> str:
+        return self.name
+
+    def endpoint_address(self, endpoint: Optional[str] = None) -> str:
+        ep = endpoint or (self.endpoints[0].name if self.endpoints
+                          else "generate")
+        return f"dyn://{self.namespace}.{self.name}.{ep}"
+
+    def __repr__(self) -> str:
+        return (f"<DynamoService {self.namespace}.{self.name} "
+                f"endpoints={[e.name for e in self.endpoints]}>")
+
+
+def service(dynamo: Optional[Dict[str, Any]] = None,
+            resources: Optional[Dict[str, Any]] = None,
+            workers: int = 1, name: Optional[str] = None, **_kw):
+    """Class decorator: ``@service(dynamo={"namespace": "ns"},
+    resources={"tpu": 1}, workers=2)`` (reference sdk lib/service.py
+    ``@service``)."""
+    dynamo = dynamo or {}
+
+    def deco(cls: type) -> DynamoService:
+        return DynamoService(
+            cls, name=name or cls.__name__,
+            namespace=dynamo.get("namespace", "dynamo"),
+            workers=workers, resources=resources or {},
+            dynamo_enabled=dynamo.get("enabled", True))
+
+    return deco

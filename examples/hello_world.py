@@ -1,0 +1,68 @@
+"""Three-stage SDK pipeline (reference examples/hello_world/hello_world.py:
+Frontend → Middle → Backend with ``depends()`` + streaming endpoints).
+
+Run:  python -m dynamo_tpu.sdk.cli serve examples.hello_world:Frontend
+Then: python examples/hello_world.py client   (from the repo root)
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.sdk import async_on_start, depends, dynamo_endpoint, service
+
+
+@service(dynamo={"namespace": "hello"})
+class Backend:
+    @dynamo_endpoint()
+    async def generate(self, req: str):
+        for word in ("hello", "world", req):
+            yield f"backend-{word}"
+
+
+@service(dynamo={"namespace": "hello"})
+class Middle:
+    backend = depends(Backend)
+
+    @dynamo_endpoint()
+    async def generate(self, req: str):
+        stream = await self.backend.round_robin(req)
+        async for env in stream:
+            yield f"middle-{env.data}"
+
+
+@service(dynamo={"namespace": "hello"})
+class Frontend:
+    middle = depends(Middle)
+
+    @async_on_start
+    async def wait_deps(self):
+        await self.middle.wait_for_instances()
+
+    @dynamo_endpoint()
+    async def generate(self, req: str):
+        stream = await self.middle.round_robin(req)
+        async for env in stream:
+            yield f"frontend-{env.data}"
+
+
+async def _client_main():
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    drt = await DistributedRuntime.attach()
+    client = await drt.namespace("hello").component(
+        "Frontend").endpoint("generate").client()
+    await client.wait_for_instances()
+    stream = await client.round_robin("demo")
+    async for env in stream:
+        print(env.data)
+    await client.close()
+    await drt.shutdown()
+
+
+if __name__ == "__main__":
+    import asyncio
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "client":
+        asyncio.run(_client_main())
+    else:
+        print(__doc__)
