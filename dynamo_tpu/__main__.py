@@ -1,0 +1,41 @@
+"""Top-level CLI dispatch: ``python -m dynamo_tpu <command>``.
+
+Commands mirror the reference's binaries (SURVEY §2.5):
+  run         dynamo-run: in=… out=… single-process serving
+  serve       SDK graph deployment (deploy/dynamo/sdk CLI)
+  llmctl      model registration CLI (launch/llmctl)
+  dcp-server  standalone control-plane server (etcd+NATS analog)
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    cmd, argv = sys.argv[1], sys.argv[2:]
+    if cmd == "run":
+        from .run import main as run_main
+
+        return run_main(argv)
+    if cmd in ("serve", "serve-worker"):
+        from .sdk.cli import main as sdk_main
+
+        return sdk_main([cmd] + argv)
+    if cmd == "llmctl":
+        from .llm.llmctl import main as llmctl_main
+
+        return llmctl_main(argv)
+    if cmd == "dcp-server":
+        from .runtime.dcp_server import main as dcp_main
+
+        return dcp_main(argv)
+    print(f"unknown command {cmd!r}\n{__doc__}")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

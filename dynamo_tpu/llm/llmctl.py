@@ -1,0 +1,68 @@
+"""`llmctl` — model registration CLI.
+
+Reference launch/llmctl/src/main.rs:26-80: writes/removes ``ModelEntry``
+records in the KV store; the frontend's model watcher reacts by
+(un)registering engines.
+
+    python -m dynamo_tpu llmctl http add chat-models <name> <dyn://endpoint>
+    python -m dynamo_tpu llmctl http remove chat-models <name>
+    python -m dynamo_tpu llmctl http list
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+from typing import List, Optional
+
+from ..runtime.dcp_client import DcpClient
+from .entry import ModelEntry, list_models, register_model, remove_model
+
+_KIND_TO_TYPE = {"chat-models": "chat", "completion-models": "completions",
+                 "completions-models": "completions", "models": "both"}
+
+
+async def amain(args) -> int:
+    address = args.dcp or os.environ.get("DYN_DCP_ADDRESS", "127.0.0.1:6650")
+    dcp = await DcpClient.connect(address)
+    try:
+        if args.verb == "add":
+            mtype = _KIND_TO_TYPE.get(args.kind, "chat")
+            await register_model(dcp, ModelEntry(
+                name=args.name, endpoint=args.endpoint, model_type=mtype))
+            print(f"added {mtype} model {args.name!r} -> {args.endpoint}")
+        elif args.verb == "remove":
+            mtype = _KIND_TO_TYPE.get(args.kind, "chat")
+            ok = await remove_model(dcp, args.name, mtype)
+            print(f"{'removed' if ok else 'not found:'} {args.name!r}")
+            return 0 if ok else 1
+        elif args.verb == "list":
+            for e in await list_models(dcp):
+                print(f"{e.model_type:12s} {e.name:40s} {e.endpoint}")
+    finally:
+        await dcp.close()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="llmctl")
+    ap.add_argument("--dcp", default=None, help="control-plane address")
+    sub = ap.add_subparsers(dest="plane", required=True)
+    http = sub.add_parser("http")
+    vsub = http.add_subparsers(dest="verb", required=True)
+    add = vsub.add_parser("add")
+    add.add_argument("kind", choices=list(_KIND_TO_TYPE))
+    add.add_argument("name")
+    add.add_argument("endpoint")
+    rm = vsub.add_parser("remove")
+    rm.add_argument("kind", choices=list(_KIND_TO_TYPE))
+    rm.add_argument("name")
+    vsub.add_parser("list")
+    args = ap.parse_args(argv)
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,370 @@
+"""`dynamo-run` — the single-command launcher: ``in=… out=…``.
+
+Reference launch/dynamo-run (SURVEY §2.5): one binary wiring an input
+frontend to an output engine:
+
+    python -m dynamo_tpu.run in=http out=jax --model-path /models/llama
+    python -m dynamo_tpu.run in=text out=echo_core
+    python -m dynamo_tpu.run in=batch:prompts.jsonl out=jax --model tiny
+    python -m dynamo_tpu.run in=dyn://ns.comp.generate out=jax ...  # worker
+    python -m dynamo_tpu.run in=http out=dyn                        # frontend
+
+Inputs (reference dynamo-run lib.rs Input):
+  http           OpenAI HTTP frontend (chat + completions + models + metrics)
+  text           interactive chat REPL
+  batch:<jsonl>  benchmark mode: per-request tokens_in/tokens_out/elapsed_ms
+                 + aggregate throughput (reference input/batch.rs:42-105)
+  dyn://path     worker mode: serve the engine behind the LLM pipeline on
+                 the distributed runtime + register the model for discovery
+                 (reference input/endpoint.rs:35-117)
+  none           construct the engine, idle until SIGINT (warmup/debug)
+
+Outputs (reference dynamo-run Output):
+  jax            the JAX paged-KV engine (this framework's vLLM analog)
+  echo_core      token-level echo fake engine (CI, no TPU)
+  echo_full      OpenAI-level echo fake engine
+  dyn[://path]   remote engines discovered from the control plane
+                 (in=http becomes the standalone frontend, components/http)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+import time
+from typing import Optional, Tuple
+
+log = logging.getLogger("dynamo_tpu.run")
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="dynamo-run", usage="%(prog)s in=<input> out=<engine> [flags]")
+    ap.add_argument("io", nargs="*", help="in=… and out=… positionals")
+    ap.add_argument("--model-path", help="local HF-style model directory")
+    ap.add_argument("--model-name", help="served model name")
+    ap.add_argument("--model", default=None,
+                    help="preset when no --model-path: tiny|1b|8b")
+    ap.add_argument("--http-port", type=int, default=8080)
+    ap.add_argument("--http-host", default="0.0.0.0")
+    ap.add_argument("--dcp", default=None, help="control-plane address "
+                    "(default: DYN_DCP_ADDRESS or embedded)")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--endpoint", default=None,
+                    help="override dyn:// endpoint path")
+    ap.add_argument("--context-length", type=int, default=None)
+    ap.add_argument("--kv-cache-block-size", type=int, default=None,
+                    help="tokens per KV page (reference flag name)")
+    ap.add_argument("--num-pages", type=int, default=None)
+    ap.add_argument("--max-batch-size", type=int, default=None)
+    ap.add_argument("--tensor-parallel-size", type=int, default=1)
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--max-tokens", type=int, default=128,
+                    help="text/batch mode generation cap")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    args.input, args.output = "http", "jax"
+    for tok in args.io:
+        if tok.startswith("in="):
+            args.input = tok[3:]
+        elif tok.startswith("out="):
+            args.output = tok[4:]
+        else:
+            ap.error(f"positional args must be in=…/out=…, got {tok!r}")
+    return args
+
+
+# ------------------------------------------------------------ engine build
+
+
+def build_model_config(args):
+    from .models.config import ModelConfig
+
+    if args.model_path:
+        return ModelConfig.from_local_path(args.model_path)
+    preset = args.model or "tiny"
+    if preset == "tiny":
+        return ModelConfig.tiny()
+    if preset == "1b":
+        return ModelConfig(vocab_size=128256, hidden_size=2048,
+                           intermediate_size=8192, num_layers=16,
+                           num_heads=32, num_kv_heads=8, head_dim=64,
+                           dtype="bfloat16")
+    if preset == "8b":
+        return ModelConfig.llama3_8b()
+    raise SystemExit(f"unknown --model preset {preset!r}")
+
+
+def build_mdc(args):
+    from .llm.model_card import ModelDeploymentCard
+
+    if args.model_path:
+        mdc = ModelDeploymentCard.from_local_path(
+            args.model_path, name=args.model_name)
+    else:
+        mdc = ModelDeploymentCard(name=args.model_name or
+                                  (args.model or "echo"))
+    if args.context_length:
+        mdc.context_length = args.context_length
+    if args.kv_cache_block_size:
+        mdc.kv_block_size = args.kv_cache_block_size
+    return mdc
+
+
+def build_engine(args) -> Tuple[object, object, bool]:
+    """Returns (core_or_full_engine, mdc, is_full_level)."""
+    from .engine.echo import EchoEngineCore, EchoEngineFull
+
+    mdc = build_mdc(args)
+    if args.output == "echo_core":
+        return EchoEngineCore(), mdc, False
+    if args.output == "echo_full":
+        return EchoEngineFull(), mdc, True
+    if args.output == "jax":
+        from .engine.jax_engine import EngineConfig, JaxEngine
+        from .models.loader import load_params
+
+        cfg = build_model_config(args)
+        ecfg = EngineConfig()
+        if args.model in (None, "tiny") and not args.model_path:
+            ecfg = EngineConfig(page_size=16, num_pages=256, max_batch=16,
+                                prefill_chunk=128, prefill_buckets=(128,),
+                                batch_buckets=(4, 16), page_buckets=(16,))
+        if args.kv_cache_block_size:
+            ecfg.page_size = args.kv_cache_block_size
+        if args.num_pages:
+            ecfg.num_pages = args.num_pages
+        if args.max_batch_size:
+            ecfg.max_batch = args.max_batch_size
+        mdc.kv_block_size = ecfg.page_size
+        params = None
+        mesh = None
+        if args.tensor_parallel_size > 1:
+            from .parallel.mesh import MeshSpec
+            mesh = MeshSpec(model=args.tensor_parallel_size).build()
+        if args.model_path:
+            try:
+                params = load_params(args.model_path, cfg)
+            except FileNotFoundError:
+                log.warning("no weights at %s; random init", args.model_path)
+        engine = JaxEngine(cfg, ecfg, params=params, seed=args.seed,
+                           mesh=mesh)
+        if not args.no_warmup:
+            engine.warmup(progress=True)
+        return engine, mdc, False
+    raise SystemExit(f"unknown out={args.output!r}")
+
+
+# -------------------------------------------------------------- input modes
+
+
+async def run_http(args) -> None:
+    from .llm.engines import LocalChatChain, LocalCompletionChain
+    from .llm.http.discovery import ModelWatcher
+    from .llm.http.service import HttpService, ModelManager
+
+    manager = ModelManager()
+    svc = HttpService(manager)
+    watcher = None
+    drt = None
+    if args.output.startswith("dyn"):
+        # standalone frontend: discover models from the control plane
+        # (reference components/http/src/main.rs + model watcher)
+        drt = await _attach(args)
+        watcher = ModelWatcher(drt, manager)
+        await watcher.start()
+    else:
+        engine, mdc, full = build_engine(args)
+        if full:
+            manager.add_chat_model(mdc.name, engine)
+        else:
+            pre = None
+            chat = LocalChatChain(mdc, engine)
+            comp = LocalCompletionChain(mdc, engine, chat.preprocessor)
+            manager.add_chat_model(mdc.name, chat)
+            manager.add_completions_model(mdc.name, comp)
+    await svc.start(args.http_host, args.http_port)
+    log.info("OpenAI frontend on %s:%d", args.http_host, args.http_port)
+    await _wait_for_signal()
+    await svc.stop()
+    if watcher:
+        await watcher.stop()
+    if drt:
+        await drt.shutdown()
+
+
+async def run_text(args) -> None:
+    from .llm.engines import LocalChatChain
+    from .runtime.engine import Context
+
+    engine, mdc, full = build_engine(args)
+    chain = engine if full else LocalChatChain(mdc, engine)
+    print(f"chat with {mdc.name} — empty line or ^D to exit", flush=True)
+    history = []
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            line = await loop.run_in_executor(None, lambda: input("> "))
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not line.strip():
+            break
+        history.append({"role": "user", "content": line})
+        req = {"model": mdc.name, "messages": history, "stream": True,
+               "max_tokens": args.max_tokens}
+        from .llm.protocols.openai import ChatCompletionRequest
+
+        from .llm.http.service import _chunk_dict
+
+        text = []
+        async for chunk in chain(ChatCompletionRequest(**req), Context()):
+            d = _chunk_dict(chunk)
+            if not isinstance(d, dict):
+                continue
+            for c in d.get("choices", []):
+                delta = (c.get("delta") or {}).get("content")
+                if delta:
+                    text.append(delta)
+                    print(delta, end="", flush=True)
+        print()
+        history.append({"role": "assistant", "content": "".join(text)})
+    if hasattr(engine, "stop"):
+        await engine.stop()
+
+
+async def run_batch(args, path: str) -> None:
+    """Benchmark mode (reference input/batch.rs:42-105): JSONL in
+    ({"text": …} or {"prompt": …}), JSONL out with per-request tokens_in/
+    tokens_out/elapsed_ms; aggregate printed at the end."""
+    from .llm.engines import LocalChatChain
+    from .llm.protocols.openai import ChatCompletionRequest
+    from .runtime.engine import Context
+
+    engine, mdc, full = build_engine(args)
+    chain = engine if full else LocalChatChain(mdc, engine)
+    entries = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+    results = []
+    t0 = time.monotonic()
+
+    async def one(i, entry):
+        text = entry.get("text") or entry.get("prompt") or ""
+        req = ChatCompletionRequest(
+            model=mdc.name, stream=True,
+            messages=[{"role": "user", "content": text}],
+            max_tokens=entry.get("max_tokens", args.max_tokens))
+        from .llm.http.service import _chunk_dict
+
+        start = time.monotonic()
+        n_out = 0
+        async for chunk in chain(req, Context()):
+            d = _chunk_dict(chunk)
+            if isinstance(d, dict) and d.get("choices"):
+                if (d["choices"][0].get("delta") or {}).get("content"):
+                    n_out += 1
+        elapsed = time.monotonic() - start
+        results.append({"index": i, "tokens_in": len(text.split()),
+                        "tokens_out": n_out,
+                        "elapsed_ms": round(elapsed * 1000, 1)})
+
+    await asyncio.gather(*(one(i, e) for i, e in enumerate(entries)))
+    wall = time.monotonic() - t0
+    for r in sorted(results, key=lambda r: r["index"]):
+        print(json.dumps(r))
+    total_out = sum(r["tokens_out"] for r in results)
+    print(json.dumps({"aggregate": {
+        "requests": len(results), "wall_s": round(wall, 3),
+        "output_tok_per_s": round(total_out / wall, 1) if wall else 0.0}}))
+    if hasattr(engine, "stop"):
+        await engine.stop()
+
+
+async def run_worker(args, path: str) -> None:
+    """``in=dyn://ns.comp[.ep]``: serve the engine as a discoverable model
+    worker (reference input/endpoint.rs worker mode)."""
+    from .llm.worker import serve_openai_model
+    from .runtime.component import EndpointAddress
+
+    engine, mdc, full = build_engine(args)
+    if full:
+        raise SystemExit("worker mode needs a token-level engine "
+                         "(out=jax or out=echo_core)")
+    addr = EndpointAddress.parse(path)
+    drt = await _attach(args)
+    handle = await serve_openai_model(
+        drt, mdc, engine, namespace=addr.namespace,
+        component=addr.component, endpoint=addr.endpoint,
+        stats_handler=getattr(engine, "stats", None))
+    log.info("worker serving %s", path)
+    await _wait_for_signal()
+    await handle.stop()
+    if hasattr(engine, "stop"):
+        await engine.stop()
+    await drt.shutdown()
+
+
+async def run_none(args) -> None:
+    engine, mdc, _ = build_engine(args)
+    log.info("engine %s ready (in=none); ^C to exit", mdc.name)
+    await _wait_for_signal()
+    if hasattr(engine, "stop"):
+        await engine.stop()
+
+
+# ----------------------------------------------------------------- helpers
+
+
+async def _attach(args):
+    from .runtime.runtime import DistributedRuntime
+
+    address = args.dcp or os.environ.get("DYN_DCP_ADDRESS")
+    if address:
+        return await DistributedRuntime.attach(address)
+    log.warning("no control plane configured; starting embedded DCP server")
+    return await DistributedRuntime.detached()
+
+
+async def _wait_for_signal() -> None:
+    ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, ev.set)
+        except NotImplementedError:
+            pass
+    await ev.wait()
+
+
+async def amain(args) -> int:
+    if args.input == "http":
+        await run_http(args)
+    elif args.input == "text":
+        await run_text(args)
+    elif args.input.startswith("batch:"):
+        await run_batch(args, args.input[len("batch:"):])
+    elif args.input.startswith("dyn://") or args.input.startswith("dyn"):
+        await run_worker(args, args.input)
+    elif args.input == "none":
+        await run_none(args)
+    else:
+        raise SystemExit(f"unknown in={args.input!r}")
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=os.environ.get("DYN_LOG", "INFO"))
+    return asyncio.run(amain(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

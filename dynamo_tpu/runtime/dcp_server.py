@@ -505,15 +505,16 @@ async def _amain(host: str, port: int) -> None:
     await asyncio.Event().wait()
 
 
-def main() -> None:
+def main(argv=None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(description="dynamo-tpu control-plane service")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6650)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     asyncio.run(_amain(args.host, args.port))
+    return 0
 
 
 if __name__ == "__main__":
