@@ -130,11 +130,18 @@ class RadixTree:
 
 
 class KvIndexer:
-    """Tokens-in, scores-out façade over the RadixTree."""
+    """Tokens-in, scores-out façade over the RadixTree. Uses the C++
+    index (native/radix_index.cpp via ctypes) when it builds, else this
+    module's Python tree (``backend='python'`` forces the fallback)."""
 
-    def __init__(self, block_size: int):
+    def __init__(self, block_size: int, backend: str = "auto"):
         self.block_size = block_size
-        self.tree = RadixTree()
+        if backend == "python":
+            self.tree = RadixTree()
+        else:
+            from .native_indexer import make_radix_tree
+
+            self.tree = make_radix_tree(prefer_native=(backend != "python"))
 
     def find_matches_for_request(self, token_ids: Sequence[int]
                                  ) -> OverlapScores:

@@ -1,0 +1,77 @@
+"""ctypes wrapper over the C++ radix index (native/radix_index.cpp) —
+drop-in for RadixTree (reference indexer.rs in Rust; SURVEY §7 hard part
+(d) calls for the indexer hot path in native code)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+from ...utils import native
+from .indexer import OverlapScores
+from .protocols import KvCacheEventWire
+
+_MAX_WORKERS = 4096  # find_matches out-buffer capacity
+
+
+class CppRadixTree:
+    """Same interface as indexer.RadixTree, backed by the C++ index."""
+
+    def __init__(self) -> None:
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._ptr = self._lib.dyn_radix_create()
+        self._ow = (ctypes.c_uint64 * _MAX_WORKERS)()
+        self._os = (ctypes.c_uint32 * _MAX_WORKERS)()
+
+    def __del__(self):
+        try:
+            if getattr(self, "_ptr", None):
+                self._lib.dyn_radix_destroy(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+    @staticmethod
+    def _arr(hashes: Sequence[int]):
+        import numpy as np
+
+        # numpy marshals the int list in C, ~10x faster than a ctypes
+        # array constructor per call on long chains
+        a = np.asarray(hashes, dtype=np.uint64)
+        return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(a)
+
+    def find_matches(self, block_hashes: Sequence[int]) -> OverlapScores:
+        keep, ptr, n = self._arr(block_hashes)
+        cnt = self._lib.dyn_radix_find_matches(
+            self._ptr, ptr, n, self._ow, self._os, _MAX_WORKERS)
+        return OverlapScores({int(self._ow[i]): int(self._os[i])
+                              for i in range(cnt)})
+
+    def apply_event(self, ev: KvCacheEventWire) -> None:
+        keep, ptr, n = self._arr(ev.block_hashes)
+        if ev.kind == "stored":
+            parent = ev.parent_hash
+            self._lib.dyn_radix_apply_stored(
+                self._ptr, ev.worker_id & (2**64 - 1),
+                (parent or 0) & (2**64 - 1), 1 if parent is not None else 0,
+                ptr, n)
+        elif ev.kind == "removed":
+            self._lib.dyn_radix_apply_removed(
+                self._ptr, ev.worker_id & (2**64 - 1), ptr, n)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._lib.dyn_radix_remove_worker(self._ptr, worker_id & (2**64 - 1))
+
+    def block_count(self) -> int:
+        return int(self._lib.dyn_radix_block_count(self._ptr))
+
+
+def make_radix_tree(prefer_native: bool = True):
+    """RadixTree factory: C++ when buildable, Python otherwise."""
+    if prefer_native and native.available():
+        return CppRadixTree()
+    from .indexer import RadixTree
+
+    return RadixTree()

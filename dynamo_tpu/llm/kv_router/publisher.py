@@ -62,3 +62,82 @@ class KvEventPublisher:
         while True:
             await asyncio.sleep(self.interval)
             await self.flush()
+
+
+class NativeEventBridge:
+    """Drains the C ABI KV-event shim (native/kv_event_shim.cpp — the
+    reference lib/bindings/c surface loaded by external native engines via
+    dlopen/ctypes) and republishes onto the bus subject. One bridge per
+    worker process hosting a native engine."""
+
+    RECORD_HEADER = 21  # kind u8 + event_id u64 + parent u64 + nblocks u32
+    NO_PARENT = 2**64 - 1
+
+    def __init__(self, dcp: DcpClient, namespace: str, component: str,
+                 worker_id: int, interval: float = 0.25,
+                 buf_size: int = 1 << 20):
+        import ctypes
+
+        from ...utils import native
+
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._buf = (ctypes.c_uint8 * buf_size)()
+        self._buf_size = buf_size
+        self.dcp = dcp
+        self.subject = f"{namespace}.{component}.{KV_EVENT_SUBJECT}"
+        self.worker_id = worker_id
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+
+    def init_shim(self, namespace: str, component: str,
+                  kv_block_size: int) -> None:
+        self._lib.dynamo_llm_init(namespace.encode(), component.encode(),
+                                  self.worker_id, kv_block_size)
+
+    def drain(self) -> list:
+        """Parse drained shim bytes into KvCacheEventWire records."""
+        import struct
+
+        n = self._lib.dynamo_kv_events_drain(self._buf, self._buf_size)
+        events, off = [], 0
+        raw = bytes(self._buf[:n])
+        while off + self.RECORD_HEADER <= n:
+            kind_b, event_id, parent, nb = struct.unpack_from(
+                "<BQQI", raw, off)
+            off += self.RECORD_HEADER
+            hashes = list(struct.unpack_from(f"<{nb}Q", raw, off))
+            off += 8 * nb
+            events.append(KvCacheEventWire(
+                worker_id=self.worker_id,
+                kind="stored" if kind_b == 1 else "removed",
+                block_hashes=hashes,
+                parent_hash=None if parent == self.NO_PARENT else parent))
+        return events
+
+    async def flush(self) -> None:
+        events = self.drain()
+        if not events:
+            return
+        try:
+            await self.dcp.publish(self.subject,
+                                   pack([e.to_dict() for e in events]))
+        except Exception:
+            log.exception("native kv event publish failed")
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+        await self.flush()
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            await self.flush()

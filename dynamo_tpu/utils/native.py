@@ -1,0 +1,97 @@
+"""Loader for the native C++ runtime library (native/*.cpp).
+
+Builds ``native/build/libdynamo_native.so`` on first use (g++, cached by
+mtime) and exposes it via ctypes. Every consumer has a pure-Python
+fallback, so a missing toolchain degrades gracefully (reference layering:
+the Rust/C bits are performance substrate, not features).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger("dynamo_tpu.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libdynamo_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for f in os.listdir(_NATIVE_DIR):
+        if f.endswith((".cpp", ".h")):
+            if os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > lib_mtime:
+                return True
+    return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.dyn_radix_create.restype = ctypes.c_void_p
+    lib.dyn_radix_destroy.argtypes = [ctypes.c_void_p]
+    lib.dyn_radix_apply_stored.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        u64p, ctypes.c_size_t]
+    lib.dyn_radix_apply_removed.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, u64p, ctypes.c_size_t]
+    lib.dyn_radix_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.dyn_radix_find_matches.restype = ctypes.c_size_t
+    lib.dyn_radix_find_matches.argtypes = [
+        ctypes.c_void_p, u64p, ctypes.c_size_t, u64p, u32p, ctypes.c_size_t]
+    lib.dyn_radix_block_count.restype = ctypes.c_size_t
+    lib.dyn_radix_block_count.argtypes = [ctypes.c_void_p]
+    lib.dynamo_llm_init.restype = ctypes.c_int32
+    lib.dynamo_llm_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_int64, ctypes.c_uint32]
+    lib.dynamo_kv_event_publish_stored.restype = ctypes.c_int32
+    lib.dynamo_kv_event_publish_stored.argtypes = [
+        ctypes.c_uint64, u32p, ctypes.POINTER(ctypes.c_size_t), u64p,
+        ctypes.c_size_t, u64p, ctypes.c_uint64]
+    lib.dynamo_kv_event_publish_removed.restype = ctypes.c_int32
+    lib.dynamo_kv_event_publish_removed.argtypes = [
+        ctypes.c_uint64, u64p, ctypes.c_size_t]
+    lib.dynamo_kv_events_drain.restype = ctypes.c_size_t
+    lib.dynamo_kv_events_drain.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it if needed; None when unavailable
+    (no compiler / build failure / DYN_DISABLE_NATIVE=1)."""
+    global _lib, _tried
+    if os.environ.get("DYN_DISABLE_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if _needs_build():
+                log.info("building native library in %s", _NATIVE_DIR)
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+            _lib = lib
+        except Exception as e:  # noqa: BLE001 — fall back to pure Python
+            log.warning("native library unavailable (%s); using Python "
+                        "fallbacks", e)
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

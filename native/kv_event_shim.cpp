@@ -1,0 +1,141 @@
+// C ABI KV-event shim (reference lib/bindings/c/src/lib.rs:52-297:
+// dynamo_llm_init / dynamo_kv_event_publish_stored / _removed — a C ABI
+// loaded by engine processes to publish KV cache events without linking
+// the runtime).
+//
+// TPU re-design: external native engines call the same C ABI; events land
+// in an in-process ring buffer, and the host bridge
+// (dynamo_tpu/llm/kv_router/publisher.py NativeEventBridge) drains it via
+// ctypes and forwards onto the distributed event bus. This keeps the ABI
+// engine-facing (no network client in the shim) while the bus stays the
+// single event plane.
+//
+// Wire layout per event (little-endian, matching the Python side's
+// struct parsing):
+//   u8  kind        (1 = stored, 2 = removed)
+//   u64 event_id
+//   u64 parent_hash (stored only; ~0 = none)
+//   u32 num_blocks
+//   u64 block_hash * num_blocks
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct ShimState {
+  std::string ns, component;
+  int64_t worker_id = 0;
+  uint32_t kv_block_size = 0;
+  bool initialized = false;
+  std::vector<uint8_t> buf;
+  std::mutex mu;
+};
+
+ShimState g_state;
+
+void append_u8(std::vector<uint8_t> &b, uint8_t v) { b.push_back(v); }
+void append_u32(std::vector<uint8_t> &b, uint32_t v) {
+  uint8_t tmp[4];
+  std::memcpy(tmp, &v, 4);
+  b.insert(b.end(), tmp, tmp + 4);
+}
+void append_u64(std::vector<uint8_t> &b, uint64_t v) {
+  uint8_t tmp[8];
+  std::memcpy(tmp, &v, 8);
+  b.insert(b.end(), tmp, tmp + 8);
+}
+
+constexpr uint64_t kNoParent = ~0ULL;
+
+}  // namespace
+
+extern "C" {
+
+// Reference signature: dynamo_llm_init(namespace, component, worker_id,
+// kv_block_size) — lib/bindings/c/src/lib.rs:52.
+int32_t dynamo_llm_init(const char *ns, const char *component,
+                        int64_t worker_id, uint32_t kv_block_size) {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  g_state.ns = ns ? ns : "";
+  g_state.component = component ? component : "";
+  g_state.worker_id = worker_id;
+  g_state.kv_block_size = kv_block_size;
+  g_state.initialized = true;
+  return 0;
+}
+
+int32_t dynamo_llm_shutdown() {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  g_state.initialized = false;
+  g_state.buf.clear();
+  return 0;
+}
+
+// Reference: dynamo_kv_event_publish_stored(event_id, token_ids,
+// num_block_tokens, block_ids, num_blocks, parent_hash, lora_id) —
+// lib/bindings/c/src/lib.rs:260. block_ids carry the engine's chained
+// block hashes (the identity used across engine/router/event planes).
+int32_t dynamo_kv_event_publish_stored(uint64_t event_id,
+                                       const uint32_t * /*token_ids*/,
+                                       const uintptr_t * /*num_block_tokens*/,
+                                       const uint64_t *block_ids,
+                                       uintptr_t num_blocks,
+                                       const uint64_t *parent_hash,
+                                       uint64_t /*lora_id*/) {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  if (!g_state.initialized) return -1;
+  append_u8(g_state.buf, 1);
+  append_u64(g_state.buf, event_id);
+  append_u64(g_state.buf, parent_hash ? *parent_hash : kNoParent);
+  append_u32(g_state.buf, static_cast<uint32_t>(num_blocks));
+  for (uintptr_t i = 0; i < num_blocks; ++i)
+    append_u64(g_state.buf, block_ids[i]);
+  return 0;
+}
+
+int32_t dynamo_kv_event_publish_removed(uint64_t event_id,
+                                        const uint64_t *block_ids,
+                                        uintptr_t num_blocks) {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  if (!g_state.initialized) return -1;
+  append_u8(g_state.buf, 2);
+  append_u64(g_state.buf, event_id);
+  append_u64(g_state.buf, kNoParent);
+  append_u32(g_state.buf, static_cast<uint32_t>(num_blocks));
+  for (uintptr_t i = 0; i < num_blocks; ++i)
+    append_u64(g_state.buf, block_ids[i]);
+  return 0;
+}
+
+// Host-bridge drain: copies up to `cap` bytes of whole events into `out`,
+// removes them from the buffer, returns bytes written.
+uintptr_t dynamo_kv_events_drain(uint8_t *out, uintptr_t cap) {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  uintptr_t n = g_state.buf.size() < cap ? g_state.buf.size() : cap;
+  if (n == 0) return 0;
+  // only cut on event boundaries: walk records until the next would
+  // exceed n
+  uintptr_t end = 0;
+  while (end < n) {
+    if (end + 21 > g_state.buf.size()) break;  // fixed header = 21 bytes
+    uint32_t nb;
+    std::memcpy(&nb, g_state.buf.data() + end + 17, 4);
+    uintptr_t rec = 1 + 8 + 8 + 4 + 8ULL * nb;
+    if (end + rec > n) break;
+    end += rec;
+  }
+  std::memcpy(out, g_state.buf.data(), end);
+  g_state.buf.erase(g_state.buf.begin(), g_state.buf.begin() + end);
+  return end;
+}
+
+int64_t dynamo_llm_worker_id() {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  return g_state.worker_id;
+}
+
+}  // extern "C"

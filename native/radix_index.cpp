@@ -1,0 +1,162 @@
+// Native radix/prefix index over chained KV block hashes.
+//
+// The C++ hot path for the KV-aware router (reference
+// lib/llm/src/kv_router/indexer.rs — Rust RadixTree with per-worker
+// hash→node lookup; SURVEY §7 hard part (d): "making the radix
+// indexer/scheduler fast in Python — port to C++ extension if needed").
+// Semantics mirror dynamo_tpu/llm/kv_router/indexer.py exactly; the
+// Python KvIndexer picks this backend via ctypes when the shared library
+// builds (dynamo_tpu/utils/native.py).
+//
+// Thread model: single writer (the router's event loop), matching the
+// reference's indexer-confined-to-one-runtime design (indexer.rs:37,499).
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Node {
+  uint64_t hash;
+  Node *parent;
+  std::unordered_map<uint64_t, Node *> children;
+  std::unordered_set<uint64_t> workers;
+
+  Node(uint64_t h, Node *p) : hash(h), parent(p) {}
+};
+
+struct Index {
+  Node root;
+  // worker id → (block hash → node): O(1) Removed / worker eviction
+  std::unordered_map<uint64_t, std::unordered_map<uint64_t, Node *>> lookup;
+
+  Index() : root(0, nullptr) {}
+};
+
+void delete_subtree(Node *n) {
+  for (auto &kv : n->children) delete_subtree(kv.second);
+  delete n;
+}
+
+void maybe_prune(Index *ix, Node *node) {
+  while (node != &ix->root && node->workers.empty() &&
+         node->children.empty() && node->parent != nullptr) {
+    Node *parent = node->parent;
+    parent->children.erase(node->hash);
+    delete node;
+    node = parent;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void *dyn_radix_create() { return new Index(); }
+
+void dyn_radix_destroy(void *p) {
+  Index *ix = static_cast<Index *>(p);
+  for (auto &kv : ix->root.children) delete_subtree(kv.second);
+  delete ix;
+}
+
+void dyn_radix_apply_stored(void *p, uint64_t worker, uint64_t parent_hash,
+                            int has_parent, const uint64_t *hashes,
+                            size_t n) {
+  Index *ix = static_cast<Index *>(p);
+  auto &wl = ix->lookup[worker];
+  Node *node = &ix->root;
+  if (has_parent) {
+    auto it = wl.find(parent_hash);
+    if (it != wl.end()) node = it->second;
+  }
+  for (size_t i = 0; i < n; ++i) {
+    uint64_t h = hashes[i];
+    auto have = wl.find(h);
+    if (have != wl.end()) {  // worker already holds this block
+      node = have->second;
+      continue;
+    }
+    Node *child;
+    auto cit = node->children.find(h);
+    if (cit != node->children.end()) {
+      child = cit->second;
+    } else {
+      child = new Node(h, node);
+      node->children.emplace(h, child);
+    }
+    child->workers.insert(worker);
+    wl.emplace(h, child);
+    node = child;
+  }
+}
+
+void dyn_radix_apply_removed(void *p, uint64_t worker, const uint64_t *hashes,
+                             size_t n) {
+  Index *ix = static_cast<Index *>(p);
+  auto lit = ix->lookup.find(worker);
+  if (lit == ix->lookup.end()) return;
+  auto &wl = lit->second;
+  for (size_t i = 0; i < n; ++i) {
+    auto it = wl.find(hashes[i]);
+    if (it == wl.end()) continue;
+    Node *node = it->second;
+    wl.erase(it);
+    node->workers.erase(worker);
+    maybe_prune(ix, node);
+  }
+}
+
+void dyn_radix_remove_worker(void *p, uint64_t worker) {
+  Index *ix = static_cast<Index *>(p);
+  auto lit = ix->lookup.find(worker);
+  if (lit == ix->lookup.end()) return;
+  for (auto &kv : lit->second) {
+    kv.second->workers.erase(worker);
+    maybe_prune(ix, kv.second);
+  }
+  ix->lookup.erase(lit);
+}
+
+// Walk the chain from the root accumulating per-worker contiguous match
+// counts. Writes up to `cap` (worker, score) pairs; returns the number
+// written (reference indexer.rs find_matches → OverlapScores).
+size_t dyn_radix_find_matches(void *p, const uint64_t *hashes, size_t n,
+                              uint64_t *out_workers, uint32_t *out_scores,
+                              size_t cap) {
+  Index *ix = static_cast<Index *>(p);
+  std::unordered_map<uint64_t, uint32_t> scores;
+  Node *node = &ix->root;
+  for (size_t i = 0; i < n; ++i) {
+    auto it = node->children.find(hashes[i]);
+    if (it == node->children.end()) break;
+    node = it->second;
+    for (uint64_t w : node->workers) ++scores[w];
+  }
+  size_t out = 0;
+  for (auto &kv : scores) {
+    if (out >= cap) break;
+    out_workers[out] = kv.first;
+    out_scores[out] = kv.second;
+    ++out;
+  }
+  return out;
+}
+
+size_t dyn_radix_block_count(void *p) {
+  Index *ix = static_cast<Index *>(p);
+  size_t n = 0;
+  std::vector<Node *> stack{&ix->root};
+  while (!stack.empty()) {
+    Node *cur = stack.back();
+    stack.pop_back();
+    n += cur->children.size();
+    for (auto &kv : cur->children) stack.push_back(kv.second);
+  }
+  return n;
+}
+
+}  // extern "C"
