@@ -1,0 +1,8 @@
+"""Metrics plane (reference components/metrics): namespace-wide
+aggregator scraping ForwardPassMetrics + kv-hit-rate events into
+Prometheus text, plus a mock worker for engine-less testing."""
+
+from .component import MetricsAggregator, serve_metrics
+from .mock_worker import MockWorker
+
+__all__ = ["MetricsAggregator", "serve_metrics", "MockWorker"]

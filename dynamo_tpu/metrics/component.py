@@ -1,0 +1,209 @@
+"""Namespace-wide metrics aggregator component.
+
+Reference components/metrics (src/main.rs:24-46 + lib.rs, ~1,000 LoC):
+scrapes worker ForwardPassMetrics over the service-stats plane, subscribes
+``kv-hit-rate`` events from the router, and exposes everything as
+Prometheus text for Grafana (deploy/metrics/grafana.json).
+
+Gauges mirror the reference's aggregator: per-worker slots/blocks/waiting/
+cache-usage plus namespace aggregates (avg/min/max), and hit-rate counters
+(isl blocks vs overlap blocks per routed request).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from ..llm.kv_router.protocols import KV_HIT_RATE_SUBJECT, ForwardPassMetrics
+from ..runtime.component import Client, EndpointAddress
+from ..runtime.dcp_client import unpack
+from ..runtime.runtime import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.metrics")
+
+
+class MetricsAggregator:
+    """Scrape + subscribe + render (one per namespace)."""
+
+    def __init__(self, drt: DistributedRuntime, namespace: str,
+                 component: str, endpoint: str = "generate_tokens",
+                 interval: float = 2.0):
+        self.drt = drt
+        self.namespace = namespace
+        self.address = EndpointAddress(namespace, component, endpoint)
+        self.interval = interval
+        self.worker_metrics: Dict[int, ForwardPassMetrics] = {}
+        self.hit_rate_isl_blocks = 0
+        self.hit_rate_overlap_blocks = 0
+        self.hit_rate_events = 0
+        self._client: Optional[Client] = None
+        self._task: Optional[asyncio.Task] = None
+        self._sid: Optional[int] = None
+
+    async def start(self) -> None:
+        self._client = await self.drt.namespace(
+            self.address.namespace).component(
+            self.address.component).endpoint(self.address.endpoint).client()
+        self._sid = await self.drt.dcp.subscribe(
+            f"{self.namespace}.{KV_HIT_RATE_SUBJECT}", self._on_hit_rate)
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._sid is not None:
+            try:
+                await self.drt.dcp.unsubscribe(self._sid)
+            except Exception:
+                pass
+        if self._client:
+            await self._client.close()
+
+    async def _on_hit_rate(self, msg) -> None:
+        ev = unpack(msg.payload)
+        self.hit_rate_events += 1
+        self.hit_rate_isl_blocks += int(ev.get("isl_blocks", 0))
+        self.hit_rate_overlap_blocks += int(ev.get("overlap_blocks", 0))
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.scrape_once()
+            except Exception:
+                log.exception("metrics scrape failed")
+            await asyncio.sleep(self.interval)
+
+    async def scrape_once(self) -> None:
+        stats = await self._client.collect_stats()
+        live = set()
+        for instance_id, payload in stats.items():
+            data = payload.get("data") or {}
+            self.worker_metrics[instance_id] = ForwardPassMetrics.from_dict(
+                data)
+            live.add(instance_id)
+        # drop metrics of departed workers (lease expiry)
+        for wid in list(self.worker_metrics):
+            if wid not in live and wid not in self._client.instances:
+                del self.worker_metrics[wid]
+
+    # ------------------------------------------------------------- render
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (reference lib.rs gauges +
+        deploy/metrics Grafana dashboard feed)."""
+        ns = self.namespace
+        lines = []
+
+        def gauge(name, help_, rows):
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.extend(rows)
+
+        per_worker = [
+            ("dyn_worker_request_active_slots", "active request slots",
+             lambda m: m.request_active_slots),
+            ("dyn_worker_request_total_slots", "total request slots",
+             lambda m: m.request_total_slots),
+            ("dyn_worker_kv_active_blocks", "active KV blocks",
+             lambda m: m.kv_active_blocks),
+            ("dyn_worker_kv_total_blocks", "total KV blocks",
+             lambda m: m.kv_total_blocks),
+            ("dyn_worker_requests_waiting", "queued requests",
+             lambda m: m.num_requests_waiting),
+            ("dyn_worker_cache_usage_perc", "KV cache usage fraction",
+             lambda m: m.gpu_cache_usage_perc),
+            ("dyn_worker_prefix_cache_hit_rate", "engine prefix hit rate",
+             lambda m: m.gpu_prefix_cache_hit_rate),
+        ]
+        for name, help_, get in per_worker:
+            rows = [
+                f'{name}{{namespace="{ns}",worker="{wid:x}"}} {get(m)}'
+                for wid, m in sorted(self.worker_metrics.items())]
+            gauge(name, help_, rows)
+        usages = [m.gpu_cache_usage_perc
+                  for m in self.worker_metrics.values()]
+        if usages:
+            gauge("dyn_namespace_cache_usage_avg", "mean cache usage",
+                  [f'dyn_namespace_cache_usage_avg{{namespace="{ns}"}} '
+                   f'{sum(usages)/len(usages)}'])
+        lines.append("# HELP dyn_kv_hit_rate_isl_blocks routed prompt "
+                     "blocks total")
+        lines.append("# TYPE dyn_kv_hit_rate_isl_blocks counter")
+        lines.append(f'dyn_kv_hit_rate_isl_blocks{{namespace="{ns}"}} '
+                     f'{self.hit_rate_isl_blocks}')
+        lines.append("# HELP dyn_kv_hit_rate_overlap_blocks routed prompt "
+                     "blocks served from cache")
+        lines.append("# TYPE dyn_kv_hit_rate_overlap_blocks counter")
+        lines.append(f'dyn_kv_hit_rate_overlap_blocks{{namespace="{ns}"}} '
+                     f'{self.hit_rate_overlap_blocks}')
+        lines.append("# HELP dyn_kv_hit_rate_events routing decisions seen")
+        lines.append("# TYPE dyn_kv_hit_rate_events counter")
+        lines.append(f'dyn_kv_hit_rate_events{{namespace="{ns}"}} '
+                     f'{self.hit_rate_events}')
+        return "\n".join(lines) + "\n"
+
+
+async def serve_metrics(drt: DistributedRuntime, namespace: str,
+                        component: str, *, endpoint: str = "generate_tokens",
+                        host: str = "0.0.0.0", port: int = 9091,
+                        interval: float = 2.0):
+    """Run the aggregator + a /metrics HTTP endpoint. Returns
+    (aggregator, site_runner) — call ``runner.cleanup()`` +
+    ``agg.stop()`` to shut down."""
+    from aiohttp import web
+
+    agg = MetricsAggregator(drt, namespace, component, endpoint, interval)
+    await agg.start()
+
+    async def metrics_handler(_request):
+        return web.Response(text=agg.render_prometheus(),
+                            content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics_handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    log.info("metrics aggregator on %s:%d/metrics", host, port)
+    return agg, runner
+
+
+def main(argv=None) -> int:
+    """Standalone aggregator process (reference components/metrics
+    src/main.rs)."""
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(prog="dynamo-metrics")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", required=True)
+    ap.add_argument("--endpoint", default="generate_tokens")
+    ap.add_argument("--port", type=int, default=9091)
+    ap.add_argument("--dcp", default=None)
+    args = ap.parse_args(argv)
+
+    async def amain():
+        drt = await DistributedRuntime.attach(
+            args.dcp or os.environ.get("DYN_DCP_ADDRESS"))
+        agg, runner = await serve_metrics(
+            drt, args.namespace, args.component,
+            endpoint=args.endpoint, port=args.port)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await agg.stop()
+            await runner.cleanup()
+            await drt.shutdown()
+
+    import logging as _logging
+
+    _logging.basicConfig(level="INFO")
+    asyncio.run(amain())
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Mock worker for the metrics plane (reference
+components/metrics/src/bin/mock_worker.rs: publishes fake
+ForwardPassMetrics stats + KVHitRateEvents so the aggregator is testable
+with no engine)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Optional
+
+from ..llm.kv_router.protocols import (KV_HIT_RATE_SUBJECT,
+                                       ForwardPassMetrics)
+from ..runtime.dcp_client import pack
+from ..runtime.runtime import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.metrics.mock")
+
+
+class MockWorker:
+    """Serves a stats-only endpoint with synthetic ForwardPassMetrics and
+    emits synthetic hit-rate events."""
+
+    def __init__(self, drt: DistributedRuntime, namespace: str = "dynamo",
+                 component: str = "mock", endpoint: str = "generate_tokens",
+                 seed: int = 0, hit_rate_interval: float = 0.5):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.endpoint = endpoint
+        self.rng = random.Random(seed)
+        self.hit_rate_interval = hit_rate_interval
+        self._handle = None
+        self._task: Optional[asyncio.Task] = None
+
+    def _stats(self) -> dict:
+        return ForwardPassMetrics(
+            request_active_slots=self.rng.randint(0, 16),
+            request_total_slots=16,
+            kv_active_blocks=self.rng.randint(0, 512),
+            kv_total_blocks=512,
+            num_requests_waiting=self.rng.randint(0, 4),
+            gpu_cache_usage_perc=self.rng.random(),
+            gpu_prefix_cache_hit_rate=self.rng.random(),
+        ).to_dict()
+
+    async def start(self) -> None:
+        async def handler(request, context):
+            yield {"echo": request}
+
+        comp = self.drt.namespace(self.namespace).component(self.component)
+        await comp.create_service()
+        self._handle = await comp.endpoint(self.endpoint).serve(
+            handler, stats_handler=self._stats)
+        self._task = asyncio.create_task(self._hit_rate_loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._handle:
+            await self._handle.stop()
+
+    async def _hit_rate_loop(self) -> None:
+        while True:
+            isl = self.rng.randint(8, 64)
+            await self.drt.dcp.publish(
+                f"{self.namespace}.{KV_HIT_RATE_SUBJECT}",
+                pack({"worker_id": self.drt.instance_id, "isl_blocks": isl,
+                      "overlap_blocks": self.rng.randint(0, isl)}))
+            await asyncio.sleep(self.hit_rate_interval)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(prog="dynamo-mock-worker")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="mock")
+    ap.add_argument("--dcp", default=None)
+    args = ap.parse_args(argv)
+
+    async def amain():
+        drt = await DistributedRuntime.attach(
+            args.dcp or os.environ.get("DYN_DCP_ADDRESS"))
+        w = MockWorker(drt, args.namespace, args.component)
+        await w.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await w.stop()
+            await drt.shutdown()
+
+    logging.basicConfig(level="INFO")
+    asyncio.run(amain())
+    return 0
+
+
+if __name__ == "__main__":
+    main()

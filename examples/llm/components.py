@@ -1,0 +1,309 @@
+"""The flagship LLM serving components (reference examples/llm/components/:
+frontend.py, processor.py, kv_router.py, worker.py, prefill_worker.py —
+SURVEY §2.9). Composed into deployment graphs by ``graphs/*.py``.
+
+Service configs (YAML → ServiceConfig) select the model; defaults are the
+CI-testable tiny model + byte tokenizer, exactly like the reference's
+echo-engine trick but with the real JAX engine."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.protocols.openai import (ChatCompletionRequest,
+                                             CompletionRequest)
+from dynamo_tpu.sdk import async_on_start, depends, dynamo_endpoint, service
+
+log = logging.getLogger("examples.llm")
+
+NAMESPACE = "dynamo"
+WORKER_COMPONENT = "TpuWorker"
+
+
+def _build_engine(cfg: dict):
+    """JaxEngine + ModelDeploymentCard from a service config dict."""
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+
+    model = cfg.get("model", "tiny")
+    if model == "tiny":
+        mc = ModelConfig.tiny()
+        ecfg = EngineConfig(page_size=cfg.get("kv_block_size", 8),
+                            num_pages=cfg.get("num_pages", 128),
+                            max_batch=8, prefill_chunk=64,
+                            prefill_buckets=(64,), batch_buckets=(8,),
+                            page_buckets=(16,),
+                            host_pages=cfg.get("host_pages", 0))
+        mdc = ModelDeploymentCard(name=cfg.get("served_model_name", "tiny"),
+                                  kv_block_size=ecfg.page_size)
+    else:
+        mc = ModelConfig.from_local_path(model)
+        ecfg = EngineConfig(page_size=cfg.get("kv_block_size", 64),
+                            num_pages=cfg.get("num_pages", 2048),
+                            max_batch=cfg.get("max_batch", 32),
+                            host_pages=cfg.get("host_pages", 0))
+        mdc = ModelDeploymentCard.from_local_path(
+            model, name=cfg.get("served_model_name"))
+        mdc.kv_block_size = ecfg.page_size
+    engine = JaxEngine(mc, ecfg, seed=cfg.get("seed", 0))
+    if cfg.get("warmup", False):
+        engine.warmup()
+    return engine, mdc
+
+
+def _mdc_from_config(cfg: dict) -> ModelDeploymentCard:
+    model = cfg.get("model", "tiny")
+    if model == "tiny":
+        return ModelDeploymentCard(name=cfg.get("served_model_name", "tiny"),
+                                   kv_block_size=cfg.get("kv_block_size", 8))
+    mdc = ModelDeploymentCard.from_local_path(
+        model, name=cfg.get("served_model_name"))
+    mdc.kv_block_size = cfg.get("kv_block_size", 64)
+    return mdc
+
+
+# ---------------------------------------------------------------- workers
+
+
+@service(dynamo={"namespace": NAMESPACE}, resources={"tpu": 1},
+         name=WORKER_COMPONENT)
+class TpuWorker:
+    """Decode(+local prefill) worker (reference components/worker.py:
+    engine + KV event/metrics publishing behind a direct()-routable
+    token-level endpoint). With ``disagg: true`` the engine is wrapped by
+    the conditional-disagg decode plane (remote prefill over the queue +
+    KV page transfer)."""
+
+    def __init__(self):
+        self.engine, self.mdc = _build_engine(self.service_config)
+        self.stats_handler = self.engine.stats
+        self.serving_engine = self.engine
+        self.publisher = None
+        self.disagg = None
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+
+        drt = self.runtime
+        await self.mdc.publish(drt.dcp)
+        self.publisher = KvEventPublisher(
+            drt.dcp, NAMESPACE, WORKER_COMPONENT, drt.instance_id,
+            self.engine)
+        self.publisher.start()
+        if self.service_config.get("disagg"):
+            from dynamo_tpu.llm.disagg.decode import build_disagg_decode
+
+            self.disagg = await build_disagg_decode(
+                drt, self.engine, namespace=NAMESPACE, model=self.mdc.name)
+            self.serving_engine = self.disagg
+
+    @dynamo_endpoint()
+    async def generate_tokens(self, request, context):
+        from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+
+        pre = PreprocessedRequest.from_dict(request)
+        async for out in self.serving_engine.generate(pre, context):
+            yield out.to_dict()
+
+    async def on_stop(self):
+        if self.publisher:
+            await self.publisher.stop()
+        await self.engine.stop()
+
+
+@service(dynamo={"namespace": NAMESPACE}, resources={"tpu": 1})
+class PrefillWorker:
+    """Dedicated prefill worker (reference components/prefill_worker.py):
+    pulls the shared prefill queue, computes prompt KV + first token, and
+    pushes KV pages to the requesting decode engine. Elastic: any number
+    may pull the same queue."""
+
+    def __init__(self):
+        self.engine, self.mdc = _build_engine(self.service_config)
+        self.worker = None
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.llm.disagg.prefill_worker import PrefillWorker as PW
+
+        self.worker = PW(self.runtime, self.engine, namespace=NAMESPACE)
+        self.worker.start()
+
+    @dynamo_endpoint()
+    async def mock(self, request, context):
+        # health probe (reference prefill_worker.py:139-141 mock endpoint)
+        yield {"completed": self.worker.completed if self.worker else 0,
+               "failed": self.worker.failed if self.worker else 0}
+
+    async def on_stop(self):
+        if self.worker:
+            await self.worker.stop()
+        await self.engine.stop()
+
+
+# ----------------------------------------------------------------- router
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class Router:
+    """KV-aware router service (reference components/kv_router.py): hosts
+    the radix indexer + cost scheduler; ``generate`` maps token_ids →
+    (worker_id, overlap_blocks)."""
+
+    def __init__(self):
+        self.router = None
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.llm.kv_router.router import KvRouter
+
+        cfg = self.service_config
+        self.router = KvRouter(
+            self.runtime, NAMESPACE, WORKER_COMPONENT,
+            block_size=cfg.get("kv_block_size", 8),
+            scrape_interval=cfg.get("scrape_interval", 0.5))
+        await self.router.start()
+
+    @dynamo_endpoint()
+    async def generate(self, request, context):
+        token_ids = request["token_ids"]
+        worker_id = await self.router.schedule(token_ids)
+        yield {"worker_id": worker_id,
+               "overlap_blocks": self.router.overlap_for(token_ids,
+                                                         worker_id)}
+
+    async def on_stop(self):
+        if self.router:
+            await self.router.stop()
+
+
+class _RouterEdge:
+    """Adapts the Router service's endpoint to the in-process KvRouter
+    interface Processor expects (schedule/overlap_for)."""
+
+    def __init__(self, handle):
+        self.handle = handle
+        self._last = {}
+
+    async def schedule(self, token_ids):
+        stream = await self.handle.round_robin({"token_ids": list(token_ids)})
+        async for env in stream:
+            if env.data is not None:
+                self._last = env.data
+                return self._last["worker_id"]
+        raise RuntimeError("router returned no decision")
+
+
+# -------------------------------------------------------------- processors
+
+
+class _ProcessorImpl:
+    """Shared body for Processor/RoutedProcessor (reference
+    components/processor.py: tokenize → route → worker direct() →
+    detokenize → OpenAI chunks)."""
+
+    def _setup(self, worker_dep, router):
+        from dynamo_tpu.llm.processor import Processor as P
+
+        self.mdc = _mdc_from_config(self.service_config)
+        self.impl = P(self.mdc, worker_dep.client, router)
+
+    async def _generate(self, request, context):
+        if "messages" in request:
+            req = ChatCompletionRequest(**request)
+            agen = self.impl.chat(req, context)
+        else:
+            req = CompletionRequest(**request)
+            agen = self.impl.completion(req, context)
+        from dynamo_tpu.llm.http.service import _chunk_dict
+
+        async for chunk in agen:
+            d = _chunk_dict(chunk)
+            if d is not None:
+                yield d
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class Processor(_ProcessorImpl):
+    """Routerless processor: round-robin over workers (graphs/agg.py)."""
+
+    worker = depends(TpuWorker)
+
+    @async_on_start
+    async def boot(self):
+        await self.worker.wait_for_instances()
+        self._setup(self.worker, router=None)
+
+    @dynamo_endpoint()
+    async def generate(self, request, context):
+        async for d in self._generate(request, context):
+            yield d
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class RoutedProcessor(_ProcessorImpl):
+    """KV-routed processor (graphs/agg_router.py): asks the Router for the
+    best worker, then direct()-routes the token-level call."""
+
+    worker = depends(TpuWorker)
+    router = depends(Router)
+
+    @async_on_start
+    async def boot(self):
+        await self.worker.wait_for_instances()
+        await self.router.wait_for_instances()
+        self._setup(self.worker, _RouterEdge(self.router))
+
+    @dynamo_endpoint()
+    async def generate(self, request, context):
+        async for d in self._generate(request, context):
+            yield d
+
+
+# ---------------------------------------------------------------- frontend
+
+
+def _make_frontend(processor_service, name):
+    """Frontend factory bound to a specific processor implementation
+    (reference components/frontend.py spawns the http binary + llmctl
+    registration; here the OpenAI HttpService runs in-process and the
+    processor is the registered engine)."""
+
+    @service(dynamo={"namespace": NAMESPACE}, name=name)
+    class _Frontend:
+        processor = depends(processor_service)
+
+        @async_on_start
+        async def boot(self):
+            from dynamo_tpu.llm.engines import RemoteOpenAIEngine
+            from dynamo_tpu.llm.http.service import HttpService, ModelManager
+
+            await self.processor.wait_for_instances()
+            cfg = self.service_config
+            self.mdc = _mdc_from_config(cfg)
+            manager = ModelManager()
+            engine = RemoteOpenAIEngine(self.processor.client)
+            manager.add_chat_model(self.mdc.name, engine)
+            manager.add_completions_model(self.mdc.name, engine)
+            self.http = HttpService(manager)
+            self.port = cfg.get("port", 8080)
+            await self.http.start(cfg.get("host", "0.0.0.0"), self.port)
+            log.info("frontend %s on :%d (model %s)", name, self.port,
+                     self.mdc.name)
+
+        @dynamo_endpoint()
+        async def health(self, request, context):
+            yield {"ok": True, "port": self.port}
+
+        async def on_stop(self):
+            if getattr(self, "http", None):
+                await self.http.stop()
+
+    return _Frontend
+
+
+Frontend = _make_frontend(Processor, "Frontend")
+RoutedFrontend = _make_frontend(RoutedProcessor, "RoutedFrontend")

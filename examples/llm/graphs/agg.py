@@ -1,0 +1,10 @@
+"""Aggregated serving graph (reference examples/llm/graphs/agg.py):
+Frontend -> Processor -> TpuWorker, round-robin routing.
+
+    python -m dynamo_tpu serve examples.llm.graphs.agg:Frontend \
+        -f examples/llm/configs/agg.yaml
+"""
+
+from examples.llm.components import Frontend, Processor, TpuWorker
+
+Frontend.link(Processor).link(TpuWorker)

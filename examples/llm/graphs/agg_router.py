@@ -1,0 +1,9 @@
+"""KV-routed aggregated graph (reference examples/llm/graphs/agg_router.py):
+Frontend -> Processor -> Router -> TpuWorker with prefix-overlap + load
+cost routing."""
+
+from examples.llm.components import (RoutedFrontend, RoutedProcessor, Router,
+                                     TpuWorker)
+
+RoutedFrontend.link(RoutedProcessor).link(Router).link(TpuWorker)
+Frontend = RoutedFrontend  # serve entry alias

@@ -1,0 +1,8 @@
+"""Disaggregated graph (reference examples/llm/graphs/disagg.py):
+decode workers take requests; long prefills go through the shared queue to
+dedicated prefill workers, KV pages stream back over the transfer plane."""
+
+from examples.llm.components import (Frontend, PrefillWorker, Processor,
+                                     TpuWorker)
+
+Frontend.link(Processor).link(TpuWorker).link(PrefillWorker)

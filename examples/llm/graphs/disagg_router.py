@@ -1,0 +1,9 @@
+"""Disaggregated + KV-routed graph (reference
+examples/llm/graphs/disagg_router.py): the full flagship deployment."""
+
+from examples.llm.components import (PrefillWorker, RoutedFrontend,
+                                     RoutedProcessor, Router, TpuWorker)
+
+RoutedFrontend.link(RoutedProcessor).link(Router).link(TpuWorker) \
+    .link(PrefillWorker)
+Frontend = RoutedFrontend
