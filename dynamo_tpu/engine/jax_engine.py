@@ -40,8 +40,8 @@ from ..llm.protocols.common import (FINISH_CANCELLED, FINISH_EOS,
                                     FINISH_LENGTH, EngineOutput,
                                     PreprocessedRequest)
 from ..models.config import ModelConfig
-from ..models.llama import (DROP_SLOT, KVCacheSpec, init_kv_cache,
-                            init_params, make_step_fns)
+from ..models.llama import DROP_SLOT, KVCacheSpec
+from ..models.registry import get_model_module
 from ..runtime.engine import Context
 from .kv_manager import PageManager, chain_hashes
 from .sampling import SamplingBatch, sample_tokens
@@ -125,11 +125,12 @@ class JaxEngine:
                  = None, params=None, seed: int = 0, dtype=None, mesh=None):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
+        model = get_model_module(model_cfg)
         if params is None:
-            params = init_params(model_cfg, jax.random.PRNGKey(seed))
+            params = model.init_params(model_cfg, jax.random.PRNGKey(seed))
         self.params = params
         spec = KVCacheSpec(self.ecfg.num_pages, self.ecfg.page_size)
-        self.kv_k, self.kv_v = init_kv_cache(model_cfg, spec, dtype)
+        self.kv_k, self.kv_v = model.init_kv_cache(model_cfg, spec, dtype)
         self.mesh = mesh
         if mesh is not None and mesh.size > 1:
             from ..parallel.mesh import shard_kv_cache, shard_params
@@ -140,7 +141,7 @@ class JaxEngine:
         # GSPMD partitioning rule, so a mesh-sharded KV operand would be
         # replicated per step (or fail to partition)
         allow_pallas = mesh is None or mesh.size == 1
-        self.prefill_fn, self.decode_fn = make_step_fns(
+        self.prefill_fn, self.decode_fn = model.make_step_fns(
             model_cfg, allow_pallas=allow_pallas)
         self.pm = PageManager(self.ecfg.num_pages, self.ecfg.page_size,
                               host_pages=self.ecfg.host_pages)

@@ -32,7 +32,19 @@ class ModelConfig:
     # MoE (Mixtral-style); num_experts=0 → dense
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # MLA (DeepSeek-V2/V3 multi-head latent attention); kv_lora_rank>0
+    # switches the attention/KV-cache design (models/mla.py)
+    q_lora_rank: int = 0           # 0 = full-rank q projection
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    attn_bias: bool = False        # qkv projection bias (Qwen2-style)
     dtype: str = "bfloat16"
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
 
     @property
     def head_dim_(self) -> int:
@@ -64,6 +76,18 @@ class ModelConfig:
         if mt == "mixtral":
             c.num_experts = cfg.get("num_local_experts", 8)
             c.num_experts_per_tok = cfg.get("num_experts_per_tok", 2)
+        if mt in ("deepseek_v2", "deepseek_v3"):
+            c.model_type = mt
+            c.q_lora_rank = cfg.get("q_lora_rank") or 0
+            c.kv_lora_rank = cfg.get("kv_lora_rank", 512)
+            c.qk_nope_head_dim = cfg.get("qk_nope_head_dim", 128)
+            c.qk_rope_head_dim = cfg.get("qk_rope_head_dim", 64)
+            c.v_head_dim = cfg.get("v_head_dim", 128)
+            c.num_experts = cfg.get("n_routed_experts") or 0
+            c.num_experts_per_tok = cfg.get("num_experts_per_tok", 2)
+        if mt == "qwen2":
+            c.model_type = "llama"  # same decoder shape (GQA + SwiGLU)
+            c.attn_bias = True      # qwen2 keeps bias on q/k/v projections
         return c
 
     @classmethod

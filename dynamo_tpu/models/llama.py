@@ -100,6 +100,10 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
         "ln_mlp": norm_init(ks[8], L, D),
         "ln_final": norm_init(ks[8], D),
     }
+    if cfg.attn_bias:  # Qwen2-style q/k/v projection bias
+        p["bq"] = jnp.zeros((L, H * hd), dtype)
+        p["bk"] = jnp.zeros((L, KV * hd), dtype)
+        p["bv"] = jnp.zeros((L, KV * hd), dtype)
     if not cfg.tie_word_embeddings:
         p["lm_head"] = w_init(ks[9], D, V)
     if cfg.num_experts > 0:
@@ -120,8 +124,8 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def rope_freqs(cfg: ModelConfig) -> jax.Array:
-    hd = cfg.head_dim_
+def rope_freqs(cfg: ModelConfig, dim: Optional[int] = None) -> jax.Array:
+    hd = dim or cfg.head_dim_
     inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, jnp.float32) / hd))
     scaling = cfg.rope_scaling or {}
     if scaling.get("rope_type") == "llama3" or scaling.get("type") == "llama3":
@@ -283,18 +287,23 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     h = params["embed"][tokens]  # [B, T, D]
     safe_pos = jnp.maximum(positions, 0)
 
-    layer_params = {k: params[k] for k in
-                    ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                     "ln_attn", "ln_mlp")}
+    layer_keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                  "ln_attn", "ln_mlp"]
     if cfg.num_experts > 0:
-        layer_params["w_router"] = params["w_router"]
+        layer_keys.append("w_router")
+    if cfg.attn_bias:
+        layer_keys += ["bq", "bk", "bv"]
+    layer_params = {k: params[k] for k in layer_keys}
 
     def layer(h, xs):
         lp, k_layer, v_layer = xs
         x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
-        q = (x @ lp["wq"]).reshape(B, T, H, hd)
-        k = (x @ lp["wk"]).reshape(B, T, KV, hd)
-        v = (x @ lp["wv"]).reshape(B, T, KV, hd)
+        xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+        if cfg.attn_bias:
+            xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
+        q = xq.reshape(B, T, H, hd)
+        k = xk.reshape(B, T, KV, hd)
+        v = xv.reshape(B, T, KV, hd)
         q = apply_rope(q, safe_pos, inv_freq)
         k = apply_rope(k, safe_pos, inv_freq)
         k_layer = _scatter_pages(k_layer, k, flat_slots)
@@ -380,17 +389,22 @@ def reference_forward(params: Params, cfg: ModelConfig,
     pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     h = params["embed"][tokens]
 
-    layer_params = {k: params[k] for k in
-                    ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                     "ln_attn", "ln_mlp")}
+    layer_keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                  "ln_attn", "ln_mlp"]
     if cfg.num_experts > 0:
-        layer_params["w_router"] = params["w_router"]
+        layer_keys.append("w_router")
+    if cfg.attn_bias:
+        layer_keys += ["bq", "bk", "bv"]
+    layer_params = {k: params[k] for k in layer_keys}
 
     def layer(h, lp):
         x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
-        q = apply_rope((x @ lp["wq"]).reshape(B, T, H, hd), pos, inv_freq)
-        k = apply_rope((x @ lp["wk"]).reshape(B, T, KV, hd), pos, inv_freq)
-        v = (x @ lp["wv"]).reshape(B, T, KV, hd)
+        xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+        if cfg.attn_bias:
+            xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
+        q = apply_rope(xq.reshape(B, T, H, hd), pos, inv_freq)
+        k = apply_rope(xk.reshape(B, T, KV, hd), pos, inv_freq)
+        v = xv.reshape(B, T, KV, hd)
         qg = q.reshape(B, T, KV, H // KV, hd)
         scores = jnp.einsum("btkgh,bskh->bkgts", qg.astype(jnp.float32),
                             k.astype(jnp.float32)) * scale

@@ -1,0 +1,306 @@
+"""DeepSeek-style MLA (multi-head latent attention) with a paged latent
+KV cache — the second model family (BASELINE scale-out config: MLA
+workers; the reference serves DeepSeek models through its engines).
+
+TPU-first design points:
+
+- the KV cache stores ONLY the rank-r latent ``c_kv`` plus the shared
+  rope key ``k_rope`` per token — cache bytes/token shrink by ~an order
+  of magnitude vs GQA, so the same HBM pool holds proportionally more
+  context (paged pools [L, pages, 1, ps, r] and [L, pages, 1, ps, dr],
+  shape-compatible with the engine's generic page machinery);
+- decode uses the absorbed form: W_UK is folded into the query
+  (q_lat = q_nope · W_UK) and W_UV into the output, so attention runs
+  entirely in latent space — two big MXU einsums per layer instead of
+  materializing per-head K/V;
+- prefill/decode share one program exactly like models/llama.py (scatter
+  new latents into pages, gather the page table, masked attention).
+
+Weight layout follows the DeepSeek-V2 architecture (q LoRA optional,
+kv LoRA + decoupled rope head); MoE layers reuse the Mixtral-style
+dense-over-experts MLP from models/llama.py.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .config import ModelConfig
+from .llama import (DROP_SLOT, KVCacheSpec, _mlp, _moe_mlp, apply_rope,
+                    logits_at, rms_norm, rope_freqs)
+
+Params = Dict[str, jax.Array]
+
+
+# ---------------------------------------------------------------- KV cache
+
+
+def cache_shapes(cfg: ModelConfig, spec: KVCacheSpec):
+    """(latent pool shape, rope pool shape): KV-head axis fixed at 1 so
+    the engine's page gather/scatter/transfer stay shape-agnostic."""
+    latent = (cfg.num_layers, spec.num_pages, 1, spec.page_size,
+              cfg.kv_lora_rank)
+    rope = (cfg.num_layers, spec.num_pages, 1, spec.page_size,
+            cfg.qk_rope_head_dim)
+    return latent, rope
+
+
+def init_kv_cache(cfg: ModelConfig, spec: KVCacheSpec,
+                  dtype=None) -> Tuple[jax.Array, jax.Array]:
+    dtype = dtype or cfg.jax_dtype
+    lat, rope = cache_shapes(cfg, spec)
+    return jnp.zeros(lat, dtype), jnp.zeros(rope, dtype)
+
+
+# ------------------------------------------------------------------ params
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
+    dtype = dtype or cfg.jax_dtype
+    D, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H = cfg.num_heads
+    r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+    V = cfg.vocab_size
+    ks = jax.random.split(key, 14)
+
+    def w_init(k, *shape):
+        scale = 1.0 / math.sqrt(shape[-2]) if len(shape) > 1 else 0.02
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    p: Params = {
+        "embed": w_init(ks[0], V, D),
+        # kv path: x → [c_kv (r) | k_rope (dr)]; c_kv normed before up-proj
+        "w_dkv": w_init(ks[1], L, D, r + dr),
+        "kv_norm": jnp.ones((L, r), dtype),
+        "w_uk": w_init(ks[2], L, r, H * dn),
+        "w_uv": w_init(ks[3], L, r, H * dv),
+        "w_o": w_init(ks[4], L, H * dv, D),
+        "w_gate": w_init(ks[5], L, D, I),
+        "w_up": w_init(ks[6], L, D, I),
+        "w_down": w_init(ks[7], L, I, D),
+        "ln_attn": jnp.ones((L, D), dtype),
+        "ln_mlp": jnp.ones((L, D), dtype),
+        "ln_final": jnp.ones((D,), dtype),
+    }
+    if cfg.q_lora_rank > 0:
+        rq = cfg.q_lora_rank
+        p["w_dq"] = w_init(ks[8], L, D, rq)
+        p["q_norm"] = jnp.ones((L, rq), dtype)
+        p["w_uq"] = w_init(ks[9], L, rq, H * (dn + dr))
+    else:
+        p["w_q"] = w_init(ks[9], L, D, H * (dn + dr))
+    if not cfg.tie_word_embeddings:
+        p["lm_head"] = w_init(ks[10], D, V)
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        p["w_router"] = w_init(ks[11], L, D, E)
+        p["w_gate"] = w_init(ks[5], L, E, D, I)
+        p["w_up"] = w_init(ks[6], L, E, D, I)
+        p["w_down"] = w_init(ks[7], L, E, I, D)
+    return p
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _scatter_rows(cache_layer: jax.Array, new: jax.Array,
+                  flat_slots: jax.Array) -> jax.Array:
+    """cache_layer: [pages, 1, ps, d]; new: [B, T, d]; flat_slots [B, T]
+    (page*ps + off; DROP_SLOT pads)."""
+    _, _, ps, d = cache_layer.shape
+    idx = flat_slots.reshape(-1)
+    pages, offs = idx // ps, idx % ps
+    rows = new.reshape(-1, d).astype(cache_layer.dtype)
+    return cache_layer.at[pages, 0, offs].set(rows, mode="drop")
+
+
+def _mla_attention(q_lat, q_rope, c_pages, r_pages, page_table,
+                   q_positions, scale):
+    """Latent-space paged attention.
+
+    q_lat: [B, T, H, r] (absorbed queries); q_rope: [B, T, H, dr];
+    c_pages: [pages, 1, ps, r]; r_pages: [pages, 1, ps, dr];
+    page_table: [B, P]; q_positions: [B, T]. Returns [B, T, H, r]
+    (latent-space context, to be up-projected by W_UV)."""
+    B, T, H, r = q_lat.shape
+    _, _, ps, dr = r_pages.shape
+    P = page_table.shape[1]
+    S = P * ps
+
+    c = c_pages[page_table].reshape(B, S, r)  # [B, P, 1, ps, r] → [B, S, r]
+    kr = r_pages[page_table].reshape(B, S, dr)
+    scores = (jnp.einsum("bthr,bsr->bhts", q_lat.astype(jnp.float32),
+                         c.astype(jnp.float32))
+              + jnp.einsum("bthd,bsd->bhts", q_rope.astype(jnp.float32),
+                           kr.astype(jnp.float32))) * scale
+    mask = (jnp.arange(S)[None, None, :] <= q_positions[:, :, None])
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bsr->bthr", probs, c.astype(jnp.float32))
+    return out
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            positions: jax.Array, kv_lat: jax.Array, kv_rope: jax.Array,
+            page_table: jax.Array, flat_slots: jax.Array,
+            allow_pallas: bool = True,
+            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Same signature/contract as llama.forward; (kv_k, kv_v) ≡
+    (latent pool, rope pool)."""
+    del allow_pallas  # latent attention is XLA-einsum based throughout
+    inv_freq = rope_freqs(cfg, dim=cfg.qk_rope_head_dim)
+    H = cfg.num_heads
+    r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+    scale = 1.0 / math.sqrt(dn + dr)
+    B, T = tokens.shape
+
+    h = params["embed"][tokens]
+    safe_pos = jnp.maximum(positions, 0)
+
+    layer_keys = ["w_dkv", "kv_norm", "w_uk", "w_uv", "w_o", "w_gate",
+                  "w_up", "w_down", "ln_attn", "ln_mlp"]
+    layer_keys += (["w_dq", "q_norm", "w_uq"] if cfg.q_lora_rank > 0
+                   else ["w_q"])
+    if cfg.num_experts > 0:
+        layer_keys.append("w_router")
+    layer_params = {k: params[k] for k in layer_keys}
+
+    def layer(h, xs):
+        lp, c_layer, r_layer = xs
+        x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+        # queries
+        if cfg.q_lora_rank > 0:
+            q_all = rms_norm(x @ lp["w_dq"], lp["q_norm"],
+                             cfg.rms_norm_eps) @ lp["w_uq"]
+        else:
+            q_all = x @ lp["w_q"]
+        q_all = q_all.reshape(B, T, H, dn + dr)
+        q_nope, q_rope = q_all[..., :dn], q_all[..., dn:]
+        q_rope = apply_rope(q_rope, safe_pos, inv_freq)
+        # kv latent + shared rope key
+        ckr = x @ lp["w_dkv"]  # [B, T, r + dr]
+        c_kv = rms_norm(ckr[..., :r], lp["kv_norm"], cfg.rms_norm_eps)
+        k_rope = apply_rope(ckr[..., None, r:], safe_pos,
+                            inv_freq)[..., 0, :]  # single shared rope head
+        c_layer = _scatter_rows(c_layer, c_kv, flat_slots)
+        r_layer = _scatter_rows(r_layer, k_rope, flat_slots)
+        # absorbed attention: q_lat = q_nope · W_UK (per head)
+        w_uk = lp["w_uk"].reshape(r, H, dn)
+        q_lat = jnp.einsum("bthd,rhd->bthr", q_nope.astype(jnp.float32),
+                           w_uk.astype(jnp.float32))
+        out_lat = _mla_attention(q_lat, q_rope, c_layer, r_layer,
+                                 page_table, positions, scale)
+        # up-project latent context per head: out = out_lat · W_UV
+        w_uv = lp["w_uv"].reshape(r, H, dv)
+        out = jnp.einsum("bthr,rhd->bthd", out_lat,
+                         w_uv.astype(jnp.float32))
+        h = h + out.reshape(B, T, H * dv).astype(h.dtype) @ lp["w_o"]
+        x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+        if cfg.num_experts > 0:
+            h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
+                             lp["w_down"], cfg.num_experts_per_tok)
+        else:
+            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return h, (c_layer, r_layer)
+
+    h, (new_c, new_r) = lax.scan(layer, h, (layer_params, kv_lat, kv_rope))
+    h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+    return h, new_c, new_r
+
+
+def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True):
+    """Jitted (prefill_step, decode_step); same contract as llama."""
+    del allow_pallas
+
+    @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
+    def prefill_step(params, tokens, positions, kv_k, kv_v, page_table,
+                     flat_slots, last_idx):
+        h, k2, v2 = forward(params, cfg, tokens, positions, kv_k, kv_v,
+                            page_table, flat_slots)
+        return logits_at(params, cfg, h, last_idx), k2, v2
+
+    @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
+    def decode_step(params, tokens, positions, kv_k, kv_v, page_table,
+                    flat_slots):
+        h, k2, v2 = forward(params, cfg, tokens[:, None], positions[:, None],
+                            kv_k, kv_v, page_table, flat_slots[:, None])
+        return (logits_at(params, cfg, h,
+                          jnp.zeros(tokens.shape[0], jnp.int32)), k2, v2)
+
+    return prefill_step, decode_step
+
+
+# -------------------------------------------------- full-attention reference
+
+
+def reference_forward(params: Params, cfg: ModelConfig,
+                      tokens: jax.Array) -> jax.Array:
+    """Non-paged, non-absorbed MLA forward (materializes per-head K/V) —
+    the independent oracle for the paged/absorbed path."""
+    B, T = tokens.shape
+    inv_freq = rope_freqs(cfg, dim=cfg.qk_rope_head_dim)
+    H = cfg.num_heads
+    r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+    scale = 1.0 / math.sqrt(dn + dr)
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    h = params["embed"][tokens]
+
+    layer_keys = ["w_dkv", "kv_norm", "w_uk", "w_uv", "w_o", "w_gate",
+                  "w_up", "w_down", "ln_attn", "ln_mlp"]
+    layer_keys += (["w_dq", "q_norm", "w_uq"] if cfg.q_lora_rank > 0
+                   else ["w_q"])
+    if cfg.num_experts > 0:
+        layer_keys.append("w_router")
+    layer_params = {k: params[k] for k in layer_keys}
+
+    def layer(h, lp):
+        x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+        if cfg.q_lora_rank > 0:
+            q_all = rms_norm(x @ lp["w_dq"], lp["q_norm"],
+                             cfg.rms_norm_eps) @ lp["w_uq"]
+        else:
+            q_all = x @ lp["w_q"]
+        q_all = q_all.reshape(B, T, H, dn + dr)
+        q_nope, q_rope = q_all[..., :dn], q_all[..., dn:]
+        q_rope = apply_rope(q_rope, pos, inv_freq)
+        ckr = x @ lp["w_dkv"]
+        c_kv = rms_norm(ckr[..., :r], lp["kv_norm"], cfg.rms_norm_eps)
+        k_rope = apply_rope(ckr[..., None, r:], pos, inv_freq)[..., 0, :]
+        # materialized per-head keys/values (the non-absorbed form)
+        k_nope = jnp.einsum("btr,rhd->bthd", c_kv.astype(jnp.float32),
+                            lp["w_uk"].reshape(r, H, dn).astype(jnp.float32))
+        v = jnp.einsum("btr,rhd->bthd", c_kv.astype(jnp.float32),
+                       lp["w_uv"].reshape(r, H, dv).astype(jnp.float32))
+        scores = (jnp.einsum("bthd,bshd->bhts", q_nope.astype(jnp.float32),
+                             k_nope)
+                  + jnp.einsum("bthd,bsd->bhts",
+                               q_rope.astype(jnp.float32),
+                               k_rope.astype(jnp.float32))) * scale
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        h = h + out.reshape(B, T, H * dv).astype(h.dtype) @ lp["w_o"]
+        x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+        if cfg.num_experts > 0:
+            h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
+                             lp["w_down"], cfg.num_experts_per_tok)
+        else:
+            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return h, None
+
+    h, _ = lax.scan(layer, h, layer_params)
+    h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (h @ head).astype(jnp.float32)

@@ -1,0 +1,20 @@
+"""Model-family registry: ModelConfig → model module.
+
+The engine resolves init_params / init_kv_cache / make_step_fns through
+this table, so adding a family (reference: each engine adapter brings its
+own model zoo, lib/llm/src/engines/) is one module with the shared paged
+step-fn contract."""
+
+from __future__ import annotations
+
+from .config import ModelConfig
+
+
+def get_model_module(cfg: ModelConfig):
+    if cfg.is_mla:
+        from . import mla
+
+        return mla
+    from . import llama
+
+    return llama

@@ -80,6 +80,23 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
         "ln_final": P(None),
         "lm_head": P(None, "model"),
     }
+    if cfg.is_mla:
+        # MLA: heads live only in the up-projections — shard those over
+        # "model"; the rank-r latent path + tiny cache stay replicated
+        specs.update({
+            "w_dkv": P(None, None, None),
+            "kv_norm": P(None, None),
+            "w_uk": P(None, None, "model"),
+            "w_uv": P(None, None, "model"),
+            "w_o": P(None, "model", None),
+            "w_q": P(None, None, "model"),
+            "w_dq": P(None, None, None),
+            "q_norm": P(None, None),
+            "w_uq": P(None, None, "model"),
+        })
+    if cfg.attn_bias:
+        specs.update({"bq": P(None, "model"), "bk": P(None, "model"),
+                      "bv": P(None, "model")})
     if cfg.num_experts > 0:
         specs.update({
             "w_router": P(None, None, None),
@@ -108,12 +125,17 @@ def kv_cache_pspec(cfg: ModelConfig) -> P:
 
 def shard_params(params, cfg: ModelConfig, mesh: Mesh):
     specs = param_pspecs(cfg)
-    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
-            for k, v in params.items()}
+    return {k: jax.device_put(
+        v, NamedSharding(mesh, specs.get(k, P(*([None] * v.ndim)))))
+        for k, v in params.items()}
 
 
 def shard_kv_cache(kv_k, kv_v, cfg: ModelConfig, mesh: Mesh):
-    s = NamedSharding(mesh, kv_cache_pspec(cfg))
+    if cfg.is_mla:
+        # latent cache has a single shared "head" — replicate over TP
+        s = NamedSharding(mesh, P(None, None, None, None, None))
+    else:
+        s = NamedSharding(mesh, kv_cache_pspec(cfg))
     return jax.device_put(kv_k, s), jax.device_put(kv_v, s)
 
 

@@ -1,0 +1,156 @@
+"""MLA (DeepSeek-style multi-head latent attention) model family: the
+absorbed/paged path must match the materialized full-attention oracle, and
+the engine must serve it end-to-end through the registry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import mla
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import DROP_SLOT, KVCacheSpec
+
+
+def tiny_mla(**over):
+    base = dict(model_type="deepseek_v2", vocab_size=512, hidden_size=64,
+                intermediate_size=128, num_layers=2, num_heads=4,
+                num_kv_heads=4, kv_lora_rank=16, qk_nope_head_dim=16,
+                qk_rope_head_dim=8, v_head_dim=16, q_lora_rank=0,
+                rope_theta=10000.0, dtype="float32")
+    base.update(over)
+    return ModelConfig(**base)
+
+
+@pytest.mark.parametrize("q_lora", [0, 24])
+def test_mla_paged_prefill_matches_reference(q_lora):
+    cfg = tiny_mla(q_lora_rank=q_lora)
+    params = mla.init_params(cfg, jax.random.PRNGKey(0))
+    B, T, ps = 2, 16, 8
+    tokens = jnp.asarray(np.random.RandomState(0).randint(1, 500, (B, T)),
+                         jnp.int32)
+    ref = mla.reference_forward(params, cfg, tokens)
+
+    kv_c, kv_r = mla.init_kv_cache(cfg, KVCacheSpec(num_pages=8,
+                                                    page_size=ps))
+    prefill, _ = mla.make_step_fns(cfg)
+    table = np.zeros((B, 4), np.int32)
+    slots = np.zeros((B, T), np.int32)
+    for b in range(B):
+        table[b, :2] = [1 + 2 * b, 2 + 2 * b]
+        for t in range(T):
+            slots[b, t] = table[b, t // ps] * ps + t % ps
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32), (B, T))
+    logits, kv_c, kv_r = prefill(params, tokens, jnp.asarray(positions),
+                                 kv_c, kv_r, jnp.asarray(table),
+                                 jnp.asarray(slots),
+                                 jnp.full((B,), T - 1, np.int32))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref[:, -1]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mla_decode_matches_reference_continuation():
+    cfg = tiny_mla()
+    params = mla.init_params(cfg, jax.random.PRNGKey(1))
+    B, T, ps = 1, 8, 8
+    rng = np.random.RandomState(1)
+    tokens = rng.randint(1, 500, (B, T + 4)).astype(np.int32)
+    prefill, decode = mla.make_step_fns(cfg)
+    kv_c, kv_r = mla.init_kv_cache(cfg, KVCacheSpec(num_pages=8,
+                                                    page_size=ps))
+    table = np.asarray([[1, 2]], np.int32)
+    slots = np.asarray([[ps + t for t in range(T)]], np.int32)
+    positions = np.arange(T, dtype=np.int32)[None]
+    logits, kv_c, kv_r = prefill(
+        params, jnp.asarray(tokens[:, :T]), jnp.asarray(positions),
+        kv_c, kv_r, jnp.asarray(table), jnp.asarray(slots),
+        jnp.asarray([T - 1], np.int32))
+    # decode the next 4 (teacher-forced) tokens one at a time
+    for i in range(4):
+        pos = T + i
+        slot = np.asarray([table[0, pos // ps] * ps + pos % ps], np.int32)
+        logits, kv_c, kv_r = decode(
+            params, jnp.asarray(tokens[:, pos]),
+            jnp.asarray([pos], np.int32), kv_c, kv_r,
+            jnp.asarray(table), jnp.asarray(slot))
+    ref = mla.reference_forward(params, cfg, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref[:, -1]),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_mla_cache_is_compact():
+    """The latent cache must be far smaller than an equivalent GQA cache
+    (the point of MLA on HBM-bound decode)."""
+    cfg = tiny_mla()
+    spec = KVCacheSpec(num_pages=8, page_size=8)
+    lat, rope = mla.cache_shapes(cfg, spec)
+    mla_bytes = np.prod(lat) + np.prod(rope)
+    gqa_bytes = 2 * np.prod((cfg.num_layers, 8, cfg.num_kv_heads, 8,
+                             cfg.qk_nope_head_dim))
+    assert mla_bytes < gqa_bytes
+
+
+def test_engine_serves_mla(run_async):
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = tiny_mla()
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_batch=4,
+                        prefill_chunk=32, prefill_buckets=(32,),
+                        batch_buckets=(4,), page_buckets=(8,))
+    engine = JaxEngine(cfg, ecfg, seed=0)
+
+    async def scenario():
+        req = PreprocessedRequest(
+            token_ids=list(range(1, 20)), sampling=SamplingOptions(),
+            stop=StopConditions(max_tokens=8, ignore_eos=True),
+            eos_token_ids=[])
+        toks = []
+        async for out in engine.generate(req, Context()):
+            toks.extend(out.token_ids)
+            if out.finish_reason:
+                break
+        # determinism under greedy: same prompt, same continuation
+        toks2 = []
+        async for out in engine.generate(req, Context()):
+            toks2.extend(out.token_ids)
+            if out.finish_reason:
+                break
+        await engine.stop()
+        return toks, toks2
+
+    toks, toks2 = run_async(scenario())
+    assert len(toks) == 8 and toks == toks2
+
+
+def test_mla_tp_sharding_compiles():
+    """MLA params shard over the model axis and one prefill step executes
+    on an 8-device mesh."""
+    from dynamo_tpu.parallel.mesh import MeshSpec, shard_params
+
+    cfg = tiny_mla()
+    mesh = MeshSpec(model=2, data=4).build()
+    params = shard_params(mla.init_params(cfg, jax.random.PRNGKey(0)),
+                          cfg, mesh)
+    prefill, _ = mla.make_step_fns(cfg)
+    B, T, ps = 4, 8, 8
+    kv_c, kv_r = mla.init_kv_cache(cfg, KVCacheSpec(num_pages=16,
+                                                    page_size=ps))
+    from dynamo_tpu.parallel.mesh import shard_kv_cache
+
+    kv_c, kv_r = shard_kv_cache(kv_c, kv_r, cfg, mesh)
+    tokens = np.random.RandomState(0).randint(1, 500, (B, T)).astype(np.int32)
+    table = np.zeros((B, 2), np.int32)
+    slots = np.full((B, T), DROP_SLOT, np.int32)
+    for b in range(B):
+        table[b, 0] = 1 + b
+        slots[b] = [(1 + b) * ps + t for t in range(T)]
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32), (B, T))
+    logits, kv_c, kv_r = prefill(
+        params, jnp.asarray(tokens), jnp.asarray(positions), kv_c, kv_r,
+        jnp.asarray(table), jnp.asarray(slots),
+        jnp.full((B,), T - 1, np.int32))
+    assert np.isfinite(np.asarray(logits)).all()
