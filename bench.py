@@ -34,6 +34,8 @@ def parse_args():
     ap.add_argument("--cpu", action="store_true", help="CPU smoke mode")
     ap.add_argument("--model", default="1b", choices=["1b", "tiny"])
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--decode-steps", type=int, default=16,
+                    help="fused decode window (amortizes dispatch latency)")
     return ap.parse_args()
 
 
@@ -47,18 +49,21 @@ def build_engine(args):
         cfg = ModelConfig.tiny()
         ecfg = EngineConfig(page_size=16, num_pages=256, max_batch=16,
                             prefill_chunk=128, prefill_buckets=(128,),
-                            batch_buckets=(4, 16), page_buckets=(16,))
+                            batch_buckets=(4, 16), page_buckets=(16,),
+                            decode_steps=args.decode_steps)
     else:
         # Llama-3.2-1B-shaped: ~2.5 GB bf16 params + KV pool on one v5e chip
         cfg = ModelConfig(vocab_size=128256, hidden_size=2048,
                           intermediate_size=8192, num_layers=16,
                           num_heads=32, num_kv_heads=8, head_dim=64,
                           dtype="bfloat16")
-        # KV pool: 2048 pages x 64 tok = 128K cached tokens
-        # (2*16L*2048p*64t*8h*64d*2B ≈ 4.3 GB)
-        ecfg = EngineConfig(page_size=64, num_pages=2048, max_batch=32,
+        # KV pool: 1536 pages x 64 tok = 96K cached tokens (~3.2 GB);
+        # the fused decode window's scan carry double-buffers the pool in
+        # HBM, so pool + params + 2x pool must fit in 16G
+        ecfg = EngineConfig(page_size=64, num_pages=1536, max_batch=32,
                             prefill_chunk=1024, prefill_buckets=(1024,),
-                            batch_buckets=(8, 32), page_buckets=(32,))
+                            batch_buckets=(8, 32), page_buckets=(32,),
+                            decode_steps=args.decode_steps)
     print(f"devices: {jax.devices()}", file=sys.stderr)
     engine = JaxEngine(cfg, ecfg, seed=args.seed)
     return engine, cfg

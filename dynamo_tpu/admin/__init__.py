@@ -1,0 +1,5 @@
+"""Admin/control REST API (reference deploy/dynamo/api-server)."""
+
+from .api_server import AdminApiServer
+
+__all__ = ["AdminApiServer"]

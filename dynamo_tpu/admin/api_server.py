@@ -1,0 +1,160 @@
+"""REST admin/control API over the distributed runtime.
+
+Reference deploy/dynamo/api-server (Go, ~11k LoC: REST services for
+clusters/deployments/components backed by a DB + K8s): here the control
+plane's KV store IS the database, so the API server is a thin aiohttp app
+exposing what operators need — registered models, live endpoint instances,
+service records, model cards, and stored deployment specs (consumed by
+the deploy/kubernetes renderer or a future in-cluster controller).
+
+    python -m dynamo_tpu.admin.api_server --port 8800 --dcp 127.0.0.1:6650
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..llm.entry import MODEL_PREFIX, ModelEntry, register_model, remove_model
+from ..llm.model_card import MDC_PREFIX
+from ..runtime.component import INSTANCE_ROOT, EndpointInstance
+from ..runtime.dcp_client import pack, unpack
+from ..runtime.runtime import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.admin")
+
+DEPLOYMENT_PREFIX = "deployments/"
+
+
+class AdminApiServer:
+    def __init__(self, drt: DistributedRuntime):
+        self.drt = drt
+        self.app = web.Application()
+        r = self.app.router
+        r.add_get("/healthz", self._health)
+        r.add_get("/api/v1/models", self._models_list)
+        r.add_post("/api/v1/models", self._models_add)
+        r.add_delete("/api/v1/models/{mtype}/{name}", self._models_delete)
+        r.add_get("/api/v1/instances", self._instances)
+        r.add_get("/api/v1/services", self._services)
+        r.add_get("/api/v1/cards", self._cards)
+        r.add_get("/api/v1/deployments", self._deployments_list)
+        r.add_post("/api/v1/deployments", self._deployments_put)
+        r.add_get("/api/v1/deployments/{name}", self._deployments_get)
+        r.add_delete("/api/v1/deployments/{name}", self._deployments_delete)
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self, host: str = "0.0.0.0", port: int = 8800) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        await web.TCPSite(self._runner, host, port).start()
+        log.info("admin api on %s:%d", host, port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------ handlers
+
+    async def _health(self, _req):
+        return web.json_response({"ok": True,
+                                  "instance_id": self.drt.instance_id})
+
+    async def _models_list(self, _req):
+        items = await self.drt.dcp.kv_get_prefix(MODEL_PREFIX)
+        return web.json_response(
+            {"models": [unpack(i.value) for i in items]})
+
+    async def _models_add(self, req):
+        body = await req.json()
+        entry = ModelEntry(name=body["name"], endpoint=body["endpoint"],
+                           model_type=body.get("model_type", "chat"))
+        await register_model(self.drt.dcp, entry)
+        return web.json_response({"added": entry.to_dict()})
+
+    async def _models_delete(self, req):
+        ok = await remove_model(self.drt.dcp, req.match_info["name"],
+                                req.match_info["mtype"])
+        return web.json_response({"removed": ok},
+                                 status=200 if ok else 404)
+
+    async def _instances(self, _req):
+        items = await self.drt.dcp.kv_get_prefix(INSTANCE_ROOT)
+        out = []
+        for i in items:
+            try:
+                out.append(EndpointInstance.from_dict(unpack(i.value))
+                           .to_dict())
+            except Exception:
+                pass
+        return web.json_response({"instances": out})
+
+    async def _services(self, _req):
+        items = await self.drt.dcp.kv_get_prefix("services/")
+        return web.json_response(
+            {"services": [unpack(i.value) for i in items]})
+
+    async def _cards(self, _req):
+        items = await self.drt.dcp.kv_get_prefix(MDC_PREFIX)
+        return web.json_response(
+            {"cards": [unpack(i.value) for i in items]})
+
+    async def _deployments_list(self, _req):
+        items = await self.drt.dcp.kv_get_prefix(DEPLOYMENT_PREFIX)
+        return web.json_response(
+            {"deployments": [unpack(i.value) for i in items]})
+
+    async def _deployments_put(self, req):
+        spec = await req.json()
+        name = (spec.get("metadata") or {}).get("name")
+        if not name:
+            return web.json_response({"error": "metadata.name required"},
+                                     status=400)
+        await self.drt.dcp.kv_put(f"{DEPLOYMENT_PREFIX}{name}", pack(spec))
+        return web.json_response({"stored": name})
+
+    async def _deployments_get(self, req):
+        raw = await self.drt.dcp.kv_get(
+            f"{DEPLOYMENT_PREFIX}{req.match_info['name']}")
+        if raw is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(unpack(raw))
+
+    async def _deployments_delete(self, req):
+        ok = await self.drt.dcp.kv_delete(
+            f"{DEPLOYMENT_PREFIX}{req.match_info['name']}")
+        return web.json_response({"removed": ok},
+                                 status=200 if ok else 404)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(prog="dynamo-admin")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8800)
+    ap.add_argument("--dcp", default=None)
+    args = ap.parse_args(argv)
+
+    async def amain():
+        drt = await DistributedRuntime.attach(
+            args.dcp or os.environ.get("DYN_DCP_ADDRESS"))
+        srv = AdminApiServer(drt)
+        await srv.start(args.host, args.port)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await srv.stop()
+            await drt.shutdown()
+
+    logging.basicConfig(level="INFO")
+    asyncio.run(amain())
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,7 @@ from typing import AsyncIterator, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..llm.protocols.common import (FINISH_CANCELLED, FINISH_EOS,
                                     FINISH_LENGTH, EngineOutput,
@@ -60,6 +61,12 @@ class EngineConfig:
     # restore on prefix hits (reference kv/ V2 multi-tier storage +
     # docs/kv_cache_manager.md "+40% TTFT"); 0 disables the tier
     host_pages: int = 0
+    # fused decode window: run K decode+sample steps inside ONE jitted
+    # program (sampling stays on device; tokens cross to the host once per
+    # window). The serving loop is dispatch-latency-bound — per-step host
+    # round-trips dwarf the ~ms device compute — so K amortizes dispatch
+    # K-fold. Cancellation/stop conditions apply at window granularity.
+    decode_steps: int = 4
     # bucketing (static shapes under jit); keep these sets SMALL — every
     # (bucket combination) is one XLA compile, and warmup() pre-compiles
     # the full grid so serving never compiles mid-flight
@@ -143,6 +150,8 @@ class JaxEngine:
         allow_pallas = mesh is None or mesh.size == 1
         self.prefill_fn, self.decode_fn = model.make_step_fns(
             model_cfg, allow_pallas=allow_pallas)
+        self.decode_multi_fn = _make_decode_multi(
+            model, model_cfg, allow_pallas, self.ecfg.max_top_k)
         self.pm = PageManager(self.ecfg.num_pages, self.ecfg.page_size,
                               host_pages=self.ecfg.host_pages)
         # host-DRAM offload pools (same per-page layout as the HBM pool)
@@ -198,14 +207,24 @@ class JaxEngine:
                 n += 1
             for B in {ecfg.bucket_batch(b) for b in ecfg.batch_buckets}:
                 tableB = jnp.zeros((B, P), jnp.int32)
-                logits, self.kv_k, self.kv_v = self.decode_fn(
-                    self.params, jnp.zeros(B, jnp.int32),
-                    jnp.zeros(B, jnp.int32) - 1, self.kv_k, self.kv_v,
-                    tableB, jnp.full((B,), DROP_SLOT, jnp.int32))
-                sample_tokens(logits, jnp.zeros(B), jnp.zeros(B, jnp.int32),
-                              jnp.ones(B), jnp.zeros(B, jnp.uint32),
-                              jnp.zeros(B, jnp.int32),
-                              max_top_k=ecfg.max_top_k)
+                if ecfg.decode_steps > 1:
+                    toks, self.kv_k, self.kv_v = self.decode_multi_fn(
+                        self.params, jnp.zeros(B, jnp.int32),
+                        jnp.zeros(B, jnp.int32) - 1, self.kv_k, self.kv_v,
+                        tableB, jnp.zeros(B), jnp.zeros(B, jnp.int32),
+                        jnp.ones(B), jnp.zeros(B, jnp.uint32),
+                        jnp.zeros(B, jnp.int32),
+                        k_steps=ecfg.decode_steps)
+                else:
+                    logits, self.kv_k, self.kv_v = self.decode_fn(
+                        self.params, jnp.zeros(B, jnp.int32),
+                        jnp.zeros(B, jnp.int32) - 1, self.kv_k, self.kv_v,
+                        tableB, jnp.full((B,), DROP_SLOT, jnp.int32))
+                    sample_tokens(logits, jnp.zeros(B),
+                                  jnp.zeros(B, jnp.int32),
+                                  jnp.ones(B), jnp.zeros(B, jnp.uint32),
+                                  jnp.zeros(B, jnp.int32),
+                                  max_top_k=ecfg.max_top_k)
                 n += 1
                 if progress:
                     print(f"warmup: {n} programs, {time.monotonic()-t0:.0f}s",
@@ -416,13 +435,15 @@ class JaxEngine:
 
     def _decode_step(self) -> None:
         self._drain_kv_tier()
+        K = max(1, self.ecfg.decode_steps)
         batch = [s for s in self.running if s.finished is None]
         # submit_prefilled can push running past max_batch; overflow rows
         # simply wait a round (arrays below are sized ≤ max_batch)
         batch = batch[: self.ecfg.max_batch]
         if not batch:
             return
-        # cancellations + page growth (preempt newest on OOM)
+        # cancellations + page growth for the whole window (preempt newest
+        # on OOM)
         for seq in list(batch):
             if seq.context.stopped:
                 batch.remove(seq)
@@ -430,7 +451,7 @@ class JaxEngine:
                 self._release(seq)
                 self._finish(seq, FINISH_CANCELLED)
                 continue
-            if not self.pm.grow(seq.pages, len(seq.tokens) + 1):
+            if not self.pm.grow(seq.pages, len(seq.tokens) + K):
                 victim = max(self.running, key=lambda s: s.arrival)
                 log.warning("KV pool exhausted; preempting %s", victim.context.id)
                 if victim in batch:
@@ -441,7 +462,7 @@ class JaxEngine:
                 self.waiting.insert(0, victim)
                 if victim is seq:
                     continue
-                if not self.pm.grow(seq.pages, len(seq.tokens) + 1):
+                if not self.pm.grow(seq.pages, len(seq.tokens) + K):
                     batch.remove(seq)  # still no room; try next step
         if not batch:
             return
@@ -450,24 +471,48 @@ class JaxEngine:
         P = self.ecfg.bucket_pages(max(len(s.pages) for s in batch))
         tokens = np.zeros(B, np.int32)
         positions = np.full(B, -1, np.int32)
-        slots = np.full(B, DROP_SLOT, np.int32)
         table = np.zeros((B, P), np.int32)
         for i, seq in enumerate(batch):
             pos = len(seq.tokens) - 1  # position of last_token
-            page = seq.pages[pos // self.ecfg.page_size]
             tokens[i] = seq.last_token
             positions[i] = pos
-            slots[i] = page * self.ecfg.page_size + pos % self.ecfg.page_size
             table[i, :len(seq.pages)] = seq.pages
 
-        logits, self.kv_k, self.kv_v = self.decode_fn(
+        if K == 1:
+            slots = np.full(B, DROP_SLOT, np.int32)
+            for i, seq in enumerate(batch):
+                pos = len(seq.tokens) - 1
+                page = seq.pages[pos // self.ecfg.page_size]
+                slots[i] = (page * self.ecfg.page_size
+                            + pos % self.ecfg.page_size)
+            logits, self.kv_k, self.kv_v = self.decode_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots))
+            sampled = self._sample(batch, logits)
+            self.steps += 1
+            self.decode_tokens_total += len(batch)
+            for seq, tok in zip(batch, sampled):
+                self._append_token(seq, int(tok))
+            return
+
+        # fused window: K forward+sample steps in one dispatch
+        sb = SamplingBatch.build([s.req.sampling for s in batch], B)
+        steps = np.zeros(B, np.int32)
+        steps[:len(batch)] = [s.generated for s in batch]
+        toks, self.kv_k, self.kv_v = self.decode_multi_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots))
-        sampled = self._sample(batch, logits)
+            self.kv_k, self.kv_v, jnp.asarray(table),
+            jnp.asarray(sb.temperature), jnp.asarray(sb.top_k),
+            jnp.asarray(sb.top_p), jnp.asarray(sb.seeds),
+            jnp.asarray(steps), k_steps=K)
+        toks = np.asarray(toks)  # ONE host sync for the whole window
         self.steps += 1
-        self.decode_tokens_total += len(batch)
-        for seq, tok in zip(batch, sampled):
-            self._append_token(seq, int(tok))
+        for i, seq in enumerate(batch):
+            for j in range(K):
+                if seq.finished is not None or seq.context.stopped:
+                    break  # tokens past EOS/stop are discarded
+                self._append_token(seq, int(toks[i, j]))
+                self.decode_tokens_total += 1
 
     # ------------------------------------------------------------- helpers
 
@@ -696,6 +741,47 @@ class RemoteReservation:
         """Leading pages the prefill worker need not transfer (already
         valid on the decode side via prefix-cache hits)."""
         return self.cached_tokens // self.page_size
+
+
+def _make_decode_multi(model, cfg: ModelConfig, allow_pallas: bool,
+                       max_top_k: int):
+    """Fused K-step decode: forward → on-device sample → feed back, K
+    times inside one jitted program (lax.scan). One dispatch + one host
+    sync per K tokens — the decisive optimization when dispatch latency
+    (remote/tunneled chips, Python overhead) exceeds step compute."""
+    from ..models.llama import logits_at
+
+    @partial(jax.jit, static_argnames=("k_steps",),
+             donate_argnames=("kv_k", "kv_v"))
+    def decode_multi(params, tokens, positions, kv_k, kv_v, page_table,
+                     temperature, top_k, top_p, seeds, base_steps, *,
+                     k_steps: int):
+        B = tokens.shape[0]
+        ps = kv_k.shape[3]
+        P = page_table.shape[1]
+        rows = jnp.arange(B)
+
+        # UNROLLED (k_steps is static): an outer lax.scan would carry the
+        # whole KV pools and XLA double-buffers scan carries — stacked on
+        # the layer scan inside forward() that blows HBM. A straight-line
+        # K-step program lets XLA alias the pool updates in place.
+        tok, pos = tokens, positions
+        toks = []
+        for i in range(k_steps):
+            page = page_table[rows, jnp.clip(pos // ps, 0, P - 1)]
+            slot = jnp.where(pos >= 0, page * ps + pos % ps, DROP_SLOT)
+            h, kv_k, kv_v = model.forward(
+                params, cfg, tok[:, None], pos[:, None], kv_k, kv_v,
+                page_table, slot[:, None], allow_pallas=allow_pallas)
+            logits = logits_at(params, cfg, h, jnp.zeros(B, jnp.int32))
+            nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
+                                base_steps + i, max_top_k=max_top_k)
+            tok = jnp.where(pos >= 0, nxt, 0)
+            pos = jnp.where(pos >= 0, pos + 1, pos)
+            toks.append(tok)
+        return jnp.stack(toks, axis=1), kv_k, kv_v  # [B, k_steps]
+
+    return decode_multi
 
 
 @partial(jax.jit, donate_argnums=(0,))

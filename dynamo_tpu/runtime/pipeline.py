@@ -1,0 +1,125 @@
+"""Composable pipeline nodes over AsyncEngine.
+
+Reference lib/runtime/src/pipeline/ (~1,200 LoC: Source/Sink/Operator/
+ServiceFrontend/ServiceBackend node graph with SingleIn/ManyOut engine
+typedefs). The TPU build keeps the same composition algebra in asyncio
+terms: every stage is an AsyncEngine (``generate(request, context) →
+async-iterator``), an **Operator** transforms request downward and the
+response stream upward, and ``chain(...)`` folds operators onto a sink
+engine. The LLM chains (OpenAIPreprocessor → Backend → engine,
+llm/engines.py) are instances of this algebra; this module makes the node
+graph available to user pipelines and the SDK.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Awaitable, Callable, Optional, Tuple
+
+from .engine import Context
+
+Engine = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+class Operator:
+    """A bidirectional stage: lowers the request on the way down and
+    transforms the response stream on the way up (reference pipeline
+    Operator; OpenAIPreprocessor is the canonical instance)."""
+
+    async def lower(self, request: Any, context: Context) -> Any:
+        return request
+
+    def raise_stream(self, request: Any, lowered: Any,
+                     stream: AsyncIterator[Any],
+                     context: Context) -> AsyncIterator[Any]:
+        return stream
+
+
+class FnOperator(Operator):
+    """Operator from two functions: ``lower(request, ctx)`` and
+    ``raise_item(item, ctx)`` applied per response item."""
+
+    def __init__(self, lower_fn: Optional[Callable[[Any, Context],
+                                                   Awaitable[Any]]] = None,
+                 raise_fn: Optional[Callable[[Any, Context], Any]] = None):
+        self._lower = lower_fn
+        self._raise = raise_fn
+
+    async def lower(self, request: Any, context: Context) -> Any:
+        if self._lower is None:
+            return request
+        return await self._lower(request, context)
+
+    async def _gen(self, stream, context):
+        async for item in stream:
+            yield self._raise(item, context) if self._raise else item
+
+    def raise_stream(self, request, lowered, stream, context):
+        return self._gen(stream, context)
+
+
+class Stage:
+    """One operator applied on top of an inner engine; itself an engine."""
+
+    def __init__(self, op: Operator, inner: Engine):
+        self.op = op
+        self.inner = inner
+
+    def __call__(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        return self._run(request, context)
+
+    async def _run(self, request: Any, context: Context):
+        lowered = await self.op.lower(request, context)
+        stream = self.inner(lowered, context)
+        async for item in self.op.raise_stream(request, lowered, stream,
+                                               context):
+            yield item
+
+
+def chain(*ops: Operator, sink: Engine) -> Engine:
+    """Fold operators onto a sink engine:
+    ``chain(A, B, sink=engine)`` runs A.lower → B.lower → engine →
+    B.raise → A.raise (reference ServiceFrontend→…→ServiceBackend link)."""
+    engine: Engine = sink
+    for op in reversed(ops):
+        engine = Stage(op, engine)
+    return engine
+
+
+class SegmentSource:
+    """Serve a pipeline segment as a component endpoint: requests arrive
+    from the network, flow through the local chain, responses stream back
+    (reference SegmentSource/SegmentSink pair + Ingress). Usage:
+
+        handler = SegmentSource(chain(ops..., sink=engine))
+        await endpoint.serve(handler)
+    """
+
+    def __init__(self, engine: Engine):
+        self.engine = engine
+
+    def __call__(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        return self.engine(request, context)
+
+
+class RemoteSink:
+    """The matching sink: forwards to a remote endpoint's client
+    (reference SegmentSink — the network edge of a split pipeline)."""
+
+    def __init__(self, client, mode: str = "round_robin"):
+        self.client = client
+        self.mode = mode
+
+    def __call__(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        return self._run(request, context)
+
+    async def _run(self, request: Any, context: Context):
+        stream = await self.client.generate(request, mode=self.mode,
+                                            context=context)
+        try:
+            async for env in stream:
+                yield env
+        finally:
+            if context.killed:
+                await stream.kill()
+            elif context.stopped:
+                await stream.stop_generating()

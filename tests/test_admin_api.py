@@ -1,0 +1,74 @@
+"""Admin REST API (reference deploy/dynamo/api-server): models,
+instances, deployments CRUD over the control plane."""
+
+import socket
+
+from dynamo_tpu.admin import AdminApiServer
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_admin_api_crud(run_async):
+    port = _free_port()
+
+    async def scenario():
+        import aiohttp
+
+        drt = await DistributedRuntime.detached()
+        # something to observe: a served endpoint instance
+        async def handler(req, ctx):
+            yield req
+
+        comp = drt.namespace("ns").component("comp")
+        await comp.create_service()
+        handle = await comp.endpoint("generate").serve(handler)
+
+        srv = AdminApiServer(drt)
+        await srv.start("127.0.0.1", port)
+        base = f"http://127.0.0.1:{port}"
+        out = {}
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/healthz") as r:
+                out["health"] = await r.json()
+            async with s.post(f"{base}/api/v1/models", json={
+                    "name": "m1", "endpoint": "dyn://ns.comp.generate"}) as r:
+                assert r.status == 200
+            async with s.get(f"{base}/api/v1/models") as r:
+                out["models"] = await r.json()
+            async with s.get(f"{base}/api/v1/instances") as r:
+                out["instances"] = await r.json()
+            async with s.get(f"{base}/api/v1/services") as r:
+                out["services"] = await r.json()
+            dep = {"metadata": {"name": "d1"},
+                   "spec": {"graph": "examples.llm.graphs.agg:Frontend"}}
+            async with s.post(f"{base}/api/v1/deployments", json=dep) as r:
+                assert r.status == 200
+            async with s.get(f"{base}/api/v1/deployments/d1") as r:
+                out["dep"] = await r.json()
+            async with s.delete(f"{base}/api/v1/deployments/d1") as r:
+                assert r.status == 200
+            async with s.get(f"{base}/api/v1/deployments/d1") as r:
+                out["dep_gone"] = r.status
+            async with s.delete(f"{base}/api/v1/models/chat/m1") as r:
+                assert r.status == 200
+        await srv.stop()
+        await handle.stop()
+        await drt.shutdown()
+        return out
+
+    out = run_async(scenario())
+    assert out["health"]["ok"]
+    assert out["models"]["models"][0]["name"] == "m1"
+    assert any(i["component"] == "comp" for i in
+               out["instances"]["instances"])
+    assert any(s["component"] == "comp" for s in
+               out["services"]["services"])
+    assert out["dep"]["spec"]["graph"].startswith("examples.")
+    assert out["dep_gone"] == 404

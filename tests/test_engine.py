@@ -205,3 +205,49 @@ def test_engine_behind_full_llm_chain(run_async):
         await engine.stop()
 
     run_async(main())
+
+
+def test_multi_step_decode_matches_single_step(run_async):
+    """The fused K-step decode window must produce exactly the same
+    tokens as K single steps (greedy and seeded sampling)."""
+    import numpy as np
+
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = ModelConfig.tiny()
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 500, n).tolist() for n in (9, 21)]
+
+    async def gen_all(engine):
+        outs = []
+        for i, p in enumerate(prompts):
+            sampling = (SamplingOptions() if i == 0 else
+                        SamplingOptions(temperature=0.8, top_k=20, seed=42))
+            req = PreprocessedRequest(
+                token_ids=p, sampling=sampling,
+                stop=StopConditions(max_tokens=11, ignore_eos=True),
+                eos_token_ids=[])
+            toks = []
+            async for out in engine.generate(req, Context()):
+                toks.extend(out.token_ids)
+                if out.finish_reason:
+                    break
+            outs.append(toks)
+        await engine.stop()
+        return outs
+
+    results = {}
+    for k in (1, 4):
+        ecfg = EngineConfig(page_size=4, num_pages=64, max_batch=4,
+                            prefill_chunk=32, prefill_buckets=(32,),
+                            batch_buckets=(4,), page_buckets=(16,),
+                            decode_steps=k)
+        results[k] = run_async(gen_all(JaxEngine(cfg, ecfg, seed=0)))
+
+    assert results[1] == results[4]
+    assert all(len(t) == 11 for t in results[4])
