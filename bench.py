@@ -58,8 +58,7 @@ def build_engine(args):
                           num_heads=32, num_kv_heads=8, head_dim=64,
                           dtype="bfloat16")
         # KV pool: 1536 pages x 64 tok = 96K cached tokens (~3.2 GB);
-        # the fused decode window's scan carry double-buffers the pool in
-        # HBM, so pool + params + 2x pool must fit in 16G
+        # headroom for the decode window's pool gather transients
         ecfg = EngineConfig(page_size=64, num_pages=1536, max_batch=32,
                             prefill_chunk=1024, prefill_buckets=(1024,),
                             batch_buckets=(8, 32), page_buckets=(32,),

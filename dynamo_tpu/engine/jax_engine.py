@@ -150,8 +150,14 @@ class JaxEngine:
         allow_pallas = mesh is None or mesh.size == 1
         self.prefill_fn, self.decode_fn = model.make_step_fns(
             model_cfg, allow_pallas=allow_pallas)
-        self.decode_multi_fn = _make_decode_multi(
-            model, model_cfg, allow_pallas, self.ecfg.max_top_k)
+        if hasattr(model, "make_decode_window_fn"):
+            # model-provided fused window (read-only pool + window buffer:
+            # one pool copy in HBM; see llama.make_decode_window_fn)
+            self.decode_multi_fn = model.make_decode_window_fn(
+                model_cfg, allow_pallas, self.ecfg.max_top_k)
+        else:
+            self.decode_multi_fn = _make_decode_multi(
+                model, model_cfg, allow_pallas, self.ecfg.max_top_k)
         self.pm = PageManager(self.ecfg.num_pages, self.ecfg.page_size,
                               host_pages=self.ecfg.host_pages)
         # host-DRAM offload pools (same per-page layout as the HBM pool)

@@ -375,6 +375,144 @@ def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True):
     return prefill_step, decode_step
 
 
+# ------------------------------------------------- fused decode window
+
+
+def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
+                          max_top_k: int = 64):
+    """Fused K-step decode with a READ-ONLY pool: the pool is gathered but
+    never written inside the window; the K new tokens' K/V accumulate in a
+    small per-layer window buffer that attention reads alongside the pool,
+    and ONE scatter at the end commits the window into the pool. This
+    keeps peak HBM at ~one pool copy — an unrolled chain of full
+    forward() steps makes XLA hold several pool instances (each step's
+    scatter output is a new buffer) and OOMs large pools.
+
+    Signature matches engine._make_decode_multi's generic fallback."""
+    del allow_pallas  # window path is XLA-einsum based
+    from ..engine.sampling import sample_tokens
+
+    inv_freq = rope_freqs(cfg)
+    scale = 1.0 / math.sqrt(cfg.head_dim_)
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+
+    def _layer_keys():
+        keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                "ln_attn", "ln_mlp"]
+        if cfg.num_experts > 0:
+            keys.append("w_router")
+        if cfg.attn_bias:
+            keys += ["bq", "bk", "bv"]
+        return keys
+
+    @partial(jax.jit, static_argnames=("k_steps",),
+             donate_argnames=("kv_k", "kv_v"))
+    def decode_window(params, tokens, positions, kv_k, kv_v, page_table,
+                      temperature, top_k, top_p, seeds, base_steps, *,
+                      k_steps: int):
+        B = tokens.shape[0]
+        L = cfg.num_layers
+        ps = kv_k.shape[3]
+        start = positions  # [B] position of the first window token (-1 pad)
+        wdt = kv_k.dtype
+        wk = jnp.zeros((L, B, k_steps, KV, hd), wdt)
+        wv = jnp.zeros((L, B, k_steps, KV, hd), wdt)
+        layer_params = {k: params[k] for k in _layer_keys()}
+
+        def one_step(tok, pos, wk, wv, i):
+            h = params["embed"][tok][:, None]  # [B, 1, D]
+            safe_pos = jnp.maximum(pos, 0)[:, None]
+
+            def layer(h, xs):
+                lp, k_pool_l, v_pool_l, wk_l, wv_l = xs
+                x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+                xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+                if cfg.attn_bias:
+                    xq, xk, xv = (xq + lp["bq"], xk + lp["bk"],
+                                  xv + lp["bv"])
+                q = apply_rope(xq.reshape(B, 1, H, hd), safe_pos, inv_freq)
+                k = apply_rope(xk.reshape(B, 1, KV, hd), safe_pos, inv_freq)
+                v = xv.reshape(B, 1, KV, hd)
+                wk_l = wk_l.at[:, i].set(k[:, 0].astype(wdt))
+                wv_l = wv_l.at[:, i].set(v[:, 0].astype(wdt))
+                attn = _pool_window_attention(
+                    q, k_pool_l, v_pool_l, page_table, start, wk_l, wv_l,
+                    i, scale)
+                h = h + attn.reshape(B, 1, H * hd) @ lp["wo"]
+                x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+                if cfg.num_experts > 0:
+                    h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"],
+                                     lp["w_up"], lp["w_down"],
+                                     cfg.num_experts_per_tok)
+                else:
+                    h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+                return h, (wk_l, wv_l)
+
+            h, (wk, wv) = lax.scan(layer, h,
+                                   (layer_params, kv_k, kv_v, wk, wv))
+            h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+            logits = logits_at(params, cfg, h, jnp.zeros(B, jnp.int32))
+            return logits, wk, wv
+
+        tok, pos = tokens, positions
+        toks = []
+        for i in range(k_steps):
+            logits, wk, wv = one_step(tok, pos, wk, wv, i)
+            nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
+                                base_steps + i, max_top_k=max_top_k)
+            tok = jnp.where(pos >= 0, nxt, 0)
+            pos = jnp.where(pos >= 0, pos + 1, pos)
+            toks.append(tok)
+
+        # commit the window into the pool: one scatter per layer
+        wpos = start[:, None] + jnp.arange(k_steps)[None, :]  # [B, K]
+        page = page_table[jnp.arange(B)[:, None],
+                          jnp.clip(wpos // ps, 0, page_table.shape[1] - 1)]
+        flat = jnp.where(start[:, None] >= 0, page * ps + wpos % ps,
+                         DROP_SLOT)
+        kv_k = jax.vmap(_scatter_pages)(kv_k, wk, jnp.broadcast_to(
+            flat, (cfg.num_layers,) + flat.shape))
+        kv_v = jax.vmap(_scatter_pages)(kv_v, wv, jnp.broadcast_to(
+            flat, (cfg.num_layers,) + flat.shape))
+        return jnp.stack(toks, axis=1), kv_k, kv_v
+
+    return decode_window
+
+
+def _pool_window_attention(q, k_pool_l, v_pool_l, page_table, start,
+                           wk_l, wv_l, i: int, scale):
+    """Decode attention reading the (frozen) paged pool for positions
+    < start plus the in-flight window for positions start..start+i.
+
+    q: [B, 1, H, hd]; *_pool_l: [pages, KV, ps, hd]; wk_l/wv_l:
+    [B, K, KV, hd]; start: [B]; i: static step index."""
+    B, _, H, hd = q.shape
+    _, KV, ps, _ = k_pool_l.shape
+    K = wk_l.shape[1]
+    P = page_table.shape[1]
+    S = P * ps
+    G = H // KV
+
+    kp = k_pool_l[page_table].transpose(0, 1, 3, 2, 4).reshape(B, S, KV, hd)
+    vp = v_pool_l[page_table].transpose(0, 1, 3, 2, 4).reshape(B, S, KV, hd)
+    qg = q.reshape(B, 1, KV, G, hd).astype(jnp.float32)
+    sp = jnp.einsum("btkgh,bskh->bkgts", qg,
+                    kp.astype(jnp.float32)) * scale  # [B,KV,G,1,S]
+    sw = jnp.einsum("btkgh,bwkh->bkgtw", qg,
+                    wk_l.astype(jnp.float32)) * scale  # [B,KV,G,1,K]
+    mask_p = (jnp.arange(S)[None, :] < start[:, None])  # start<0 → all off
+    mask_w = (jnp.arange(K)[None, :] <= i) & (start[:, None] >= 0)
+    sp = jnp.where(mask_p[:, None, None, None, :], sp, -1e30)
+    sw = jnp.where(mask_w[:, None, None, None, :], sw, -1e30)
+    s = jnp.concatenate([sp, sw], axis=-1)
+    p = jax.nn.softmax(s, axis=-1)
+    pp, pw = p[..., :S], p[..., S:]
+    out = (jnp.einsum("bkgts,bskh->btkgh", pp, vp.astype(jnp.float32))
+           + jnp.einsum("bkgtw,bwkh->btkgh", pw,
+                        wv_l.astype(jnp.float32)))
+    return out.reshape(B, 1, H, hd).astype(q.dtype)
+
+
 # -------------------------------------------------- full-attention reference
 
 

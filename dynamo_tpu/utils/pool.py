@@ -1,0 +1,112 @@
+"""RAII object pool (reference lib/runtime/src/utils/pool.rs:23-241:
+``Pool<T: Returnable>`` whose ``PoolItem`` returns to the pool on Drop;
+the backbone of the reference's KV block reuse pool).
+
+asyncio re-design: ``acquire()`` awaits a free object; the returned
+``PoolItem`` is a context manager (sync or async) that returns the object
+on exit; ``SharedPoolItem`` keeps it out until the last clone drops."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Pool(Generic[T]):
+    def __init__(self, items: Optional[List[T]] = None,
+                 factory: Optional[Callable[[], T]] = None,
+                 max_size: Optional[int] = None):
+        self._free: asyncio.Queue = asyncio.Queue()
+        self._factory = factory
+        self._created = 0
+        self._max = max_size
+        for it in items or []:
+            self._free.put_nowait(it)
+            self._created += 1
+
+    @property
+    def available(self) -> int:
+        return self._free.qsize()
+
+    @property
+    def size(self) -> int:
+        return self._created
+
+    async def acquire(self) -> "PoolItem[T]":
+        """Awaits a free object; grows via the factory up to max_size."""
+        if (self._free.empty() and self._factory is not None
+                and (self._max is None or self._created < self._max)):
+            self._created += 1
+            return PoolItem(self, self._factory())
+        return PoolItem(self, await self._free.get())
+
+    def try_acquire(self) -> Optional["PoolItem[T]"]:
+        try:
+            return PoolItem(self, self._free.get_nowait())
+        except asyncio.QueueEmpty:
+            if self._factory is not None and (
+                    self._max is None or self._created < self._max):
+                self._created += 1
+                return PoolItem(self, self._factory())
+            return None
+
+    def _return(self, obj: T) -> None:
+        self._free.put_nowait(obj)
+
+
+class PoolItem(Generic[T]):
+    """Holds one pooled object; returns it on release/context exit
+    (the Drop-returns-to-pool semantics of the reference)."""
+
+    def __init__(self, pool: Pool[T], value: T):
+        self._pool: Optional[Pool[T]] = pool
+        self.value = value
+
+    def release(self) -> None:
+        if self._pool is not None:
+            pool, self._pool = self._pool, None
+            pool._return(self.value)
+
+    def share(self) -> "SharedPoolItem[T]":
+        item = SharedPoolItem(self._pool, self.value)
+        self._pool = None  # ownership moved
+        return item
+
+    def __enter__(self) -> T:
+        return self.value
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    async def __aenter__(self) -> T:
+        return self.value
+
+    async def __aexit__(self, *exc) -> None:
+        self.release()
+
+
+class SharedPoolItem(Generic[T]):
+    """Clone-counted pool item: returns to the pool when the last clone
+    is released (reference SharedPoolItem)."""
+
+    def __init__(self, pool: Optional[Pool[T]], value: T):
+        self._pool = pool
+        self.value = value
+        self._refs = [1]  # shared cell across clones
+
+    def clone(self) -> "SharedPoolItem[T]":
+        other = SharedPoolItem.__new__(SharedPoolItem)
+        other._pool = self._pool
+        other.value = self.value
+        other._refs = self._refs
+        self._refs[0] += 1
+        return other
+
+    def release(self) -> None:
+        if self._refs[0] <= 0:
+            return
+        self._refs[0] -= 1
+        if self._refs[0] == 0 and self._pool is not None:
+            self._pool._return(self.value)

@@ -1,0 +1,75 @@
+"""Soak/stress drive (reference lib/runtime/tests/soak.rs + python
+bindings soak.py): hammer the distributed serving path in one process for
+N seconds and report throughput + failure counts. Not collected by
+pytest's default run — invoke directly:
+
+    python tests/soak.py [--seconds 30] [--concurrency 32]
+"""
+
+import argparse
+import asyncio
+import os
+import random
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+async def main(seconds: float, concurrency: int) -> int:
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    drt = await DistributedRuntime.detached()
+
+    async def handler(request, context):
+        for i in range(int(request["n"])):
+            yield {"i": i, "payload": request["payload"]}
+
+    comp = drt.namespace("soak").component("svc")
+    await comp.create_service()
+    handle = await comp.endpoint("generate").serve(handler)
+    client = await comp.endpoint("generate").client()
+    await client.wait_for_instances()
+
+    stop_at = time.monotonic() + seconds
+    stats = {"requests": 0, "items": 0, "errors": 0}
+
+    async def worker(wid: int):
+        rng = random.Random(wid)
+        while time.monotonic() < stop_at:
+            n = rng.randint(1, 16)
+            payload = "x" * rng.randint(1, 4096)
+            try:
+                stream = await client.round_robin({"n": n,
+                                                   "payload": payload})
+                got = 0
+                async for env in stream:
+                    assert env.data["payload"] == payload
+                    got += 1
+                assert got == n, f"expected {n} items, got {got}"
+                stats["requests"] += 1
+                stats["items"] += got
+            except Exception as e:  # noqa: BLE001
+                stats["errors"] += 1
+                print(f"worker {wid}: {e!r}", file=sys.stderr)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(worker(i) for i in range(concurrency)))
+    wall = time.monotonic() - t0
+    await client.close()
+    await handle.stop()
+    await drt.shutdown()
+    print(f"soak: {stats['requests']} requests, {stats['items']} items, "
+          f"{stats['errors']} errors in {wall:.1f}s "
+          f"({stats['requests']/wall:.0f} req/s)")
+    return 1 if stats["errors"] else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=10)
+    ap.add_argument("--concurrency", type=int, default=32)
+    args = ap.parse_args()
+    sys.exit(asyncio.run(main(args.seconds, args.concurrency)))

@@ -314,3 +314,35 @@ def test_plain_subscriber_does_not_steal_requests(run_async):
         await server.stop()
 
     run_async(main())
+
+
+def test_object_pool(run_async):
+    """RAII object pool (reference utils/pool.rs): items return on
+    release, shared items on last clone, factory growth capped."""
+    from dynamo_tpu.utils.pool import Pool
+
+    async def scenario():
+        pool = Pool(items=["a", "b"], factory=lambda: "c", max_size=3)
+        i1 = await pool.acquire()
+        i2 = await pool.acquire()
+        i3 = await pool.acquire()  # factory-grown
+        assert pool.available == 0 and pool.size == 3
+        assert pool.try_acquire() is None  # capped
+        with i1 as v:
+            assert v == "a"
+        assert pool.available == 1  # context exit returned it
+        sh = i2.share()
+        cl = sh.clone()
+        sh.release()
+        assert pool.available == 1  # still held by the clone
+        cl.release()
+        assert pool.available == 2
+        cl.release()  # double release is a no-op
+        assert pool.available == 2
+        i3.release()
+        assert pool.available == 3
+        got = await pool.acquire()
+        got.release()
+        return True
+
+    assert run_async(scenario())
