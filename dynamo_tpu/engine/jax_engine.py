@@ -61,6 +61,7 @@ class EngineConfig:
     # restore on prefix hits (reference kv/ V2 multi-tier storage +
     # docs/kv_cache_manager.md "+40% TTFT"); 0 disables the tier
     host_pages: int = 0
+    max_prefill_batch: int = 8  # prompts packed per prefill dispatch
     # fused decode window: run K decode+sample steps inside ONE jitted
     # program (sampling stays on device; tokens cross to the host once per
     # window). The serving loop is dispatch-latency-bound — per-step host
@@ -202,15 +203,23 @@ class JaxEngine:
         page_buckets = [p for p in ecfg.page_buckets] or [8]
         t0 = time.monotonic()
         n = 0
+        prefill_bs = {ecfg.bucket_batch(1),
+                      ecfg.bucket_batch(ecfg.max_prefill_batch)}
         for P in page_buckets:
-            table = jnp.zeros((1, P), jnp.int32)
             for T in {ecfg.bucket_len(t) for t in ecfg.prefill_buckets}:
-                logits, self.kv_k, self.kv_v = self.prefill_fn(
-                    self.params, jnp.zeros((1, T), jnp.int32),
-                    jnp.zeros((1, T), jnp.int32) - 1, self.kv_k, self.kv_v,
-                    table, jnp.full((1, T), DROP_SLOT, jnp.int32),
-                    jnp.zeros((1,), jnp.int32))
-                n += 1
+                for PB in prefill_bs:
+                    logits, self.kv_k, self.kv_v = self.prefill_fn(
+                        self.params, jnp.zeros((PB, T), jnp.int32),
+                        jnp.zeros((PB, T), jnp.int32) - 1,
+                        self.kv_k, self.kv_v, jnp.zeros((PB, P), jnp.int32),
+                        jnp.full((PB, T), DROP_SLOT, jnp.int32),
+                        jnp.zeros((PB,), jnp.int32))
+                    sample_tokens(logits, jnp.zeros(PB),
+                                  jnp.zeros(PB, jnp.int32), jnp.ones(PB),
+                                  jnp.zeros(PB, jnp.uint32),
+                                  jnp.zeros(PB, jnp.int32),
+                                  max_top_k=ecfg.max_top_k)
+                    n += 1
             for B in {ecfg.bucket_batch(b) for b in ecfg.batch_buckets}:
                 tableB = jnp.zeros((B, P), jnp.int32)
                 if ecfg.decode_steps > 1:
@@ -382,54 +391,75 @@ class JaxEngine:
     # ------------------------------------------------------------- prefill
 
     def _prefill_step(self) -> None:
-        """One chunked-prefill step for the oldest prefilling sequence."""
+        """One chunked-prefill step over a BATCH of prefilling sequences
+        (each contributes its next chunk). Batching prompts into one
+        dispatch matters as much as the decode window when dispatch
+        latency dominates: N prompts cost one round trip, not N."""
         self._drain_kv_tier()
-        seq = self.prefilling[0]
-        if seq.context.stopped:
-            self.prefilling.pop(0)
-            self._release(seq)
-            self._finish(seq, FINISH_CANCELLED)
+        batch: List[Sequence] = []
+        for seq in list(self.prefilling):
+            if seq.context.stopped:
+                self.prefilling.remove(seq)
+                self._release(seq)
+                self._finish(seq, FINISH_CANCELLED)
+                continue
+            if seq.prefill_extent - seq.computed <= 0:
+                # resumed sequence fully covered by the prefix cache
+                self.prefilling.remove(seq)
+                seq.last_token = seq.tokens[-1]
+                self.running.append(seq)
+                continue
+            batch.append(seq)
+            if len(batch) >= self.ecfg.max_prefill_batch:
+                break
+        if not batch:
             return
-        extent = seq.prefill_extent
-        start = seq.computed
-        remaining = extent - start
-        if remaining <= 0:  # resumed sequence fully covered by prefix cache
-            self.prefilling.pop(0)
-            seq.last_token = seq.tokens[-1]
-            self.running.append(seq)
-            return
-        chunk = min(remaining, self.ecfg.prefill_chunk)
-        T = self.ecfg.bucket_len(chunk)
-        P = self.ecfg.bucket_pages(len(seq.pages))
 
-        tokens = np.zeros((1, T), np.int32)
-        positions = np.full((1, T), -1, np.int32)
-        slots = np.full((1, T), DROP_SLOT, np.int32)
-        tokens[0, :chunk] = seq.tokens[start:start + chunk]
-        positions[0, :chunk] = np.arange(start, start + chunk)
-        for t in range(chunk):
-            pos = start + t
-            page = seq.pages[pos // self.ecfg.page_size]
-            slots[0, t] = page * self.ecfg.page_size + pos % self.ecfg.page_size
-        table = np.zeros((1, P), np.int32)
-        table[0, :len(seq.pages)] = seq.pages
+        chunks = [min(s.prefill_extent - s.computed, self.ecfg.prefill_chunk)
+                  for s in batch]
+        B = self.ecfg.bucket_batch(len(batch))
+        T = self.ecfg.bucket_len(max(chunks))
+        P = self.ecfg.bucket_pages(max(len(s.pages) for s in batch))
+
+        tokens = np.zeros((B, T), np.int32)
+        positions = np.full((B, T), -1, np.int32)
+        slots = np.full((B, T), DROP_SLOT, np.int32)
+        table = np.zeros((B, P), np.int32)
+        last_idx = np.zeros(B, np.int32)
+        ps = self.ecfg.page_size
+        for i, (seq, chunk) in enumerate(zip(batch, chunks)):
+            start = seq.computed
+            tokens[i, :chunk] = seq.tokens[start:start + chunk]
+            positions[i, :chunk] = np.arange(start, start + chunk)
+            pages = np.asarray(seq.pages, np.int64)
+            pos = np.arange(start, start + chunk)
+            slots[i, :chunk] = pages[pos // ps] * ps + pos % ps
+            table[i, :len(seq.pages)] = seq.pages
+            last_idx[i] = chunk - 1
 
         logits, self.kv_k, self.kv_v = self.prefill_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots),
-            jnp.asarray([chunk - 1], np.int32))
-        seq.computed += chunk
-        self.prefill_tokens_total += chunk
+            jnp.asarray(last_idx))
         self.steps += 1
 
-        if seq.computed >= extent:
+        finishing: List[Tuple[int, Sequence]] = []
+        for i, (seq, chunk) in enumerate(zip(batch, chunks)):
+            seq.computed += chunk
+            self.prefill_tokens_total += chunk
+            if seq.computed >= seq.prefill_extent:
+                self.prefilling.remove(seq)
+                finishing.append((i, seq))
+        if not finishing:
+            return
+        # one sampling pass over the full bucket (avoids a fresh compile
+        # per finishing-count); unfinished rows' samples are discarded
+        sampled_all = self._sample(batch, logits)
+        sampled = [sampled_all[i] for i, _ in finishing]
+        for (i, seq), tok in zip(finishing, sampled):
             self._commit_full_pages(seq)
-            self.prefilling.pop(0)
             if seq.generated == 0:
-                # fresh prompt: sample the first token from the final
-                # chunk's logits
-                first = self._sample([seq], logits)[0]
-                self._append_token(seq, int(first))
+                self._append_token(seq, int(tok))
                 if seq.finished is None:
                     self.running.append(seq)
             else:

@@ -126,6 +126,11 @@ def build_engine(args) -> Tuple[object, object, bool]:
         return EchoEngineCore(), mdc, False
     if args.output == "echo_full":
         return EchoEngineFull(), mdc, True
+    if args.output.startswith(("pystr:", "pytok:")):
+        # user Python engines (reference engines/python.rs: pystr = full
+        # OpenAI level, pytok = token-level core behind the Backend)
+        kind, path = args.output.split(":", 1)
+        return _load_python_engine(path, kind), mdc, kind == "pystr"
     if args.output == "jax":
         from .engine.jax_engine import EngineConfig, JaxEngine
         from .models.loader import load_params
@@ -159,6 +164,45 @@ def build_engine(args) -> Tuple[object, object, bool]:
             engine.warmup(progress=True)
         return engine, mdc, False
     raise SystemExit(f"unknown out={args.output!r}")
+
+
+def _load_python_engine(path: str, kind: str):
+    """Load a user engine file (reference engines/python.rs:16-90 —
+    ``pystr:<file.py>`` / ``pytok:<file.py>``): the module must define
+    ``async def generate(request, context)`` (async generator). pystr
+    yields OpenAI chunk dicts; pytok yields EngineOutput-shaped dicts."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("dyn_user_engine", path)
+    if spec is None or spec.loader is None:
+        raise SystemExit(f"cannot load python engine from {path!r}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    gen = getattr(mod, "generate", None)
+    if gen is None:
+        raise SystemExit(f"{path} must define `async def generate(request, "
+                         f"context)`")
+
+    if kind == "pystr":
+        class _PyStrEngine:
+            def __call__(self, request, context):
+                payload = request.model_dump(exclude_none=True) \
+                    if hasattr(request, "model_dump") else request
+                return gen(payload, context)
+
+        return _PyStrEngine()
+
+    class _PyTokEngine:
+        async def generate(self, request, context):
+            from .llm.protocols.common import EngineOutput
+
+            payload = request.to_dict() if hasattr(request, "to_dict") \
+                else request
+            async for out in gen(payload, context):
+                yield out if isinstance(out, EngineOutput) \
+                    else EngineOutput.from_dict(out)
+
+    return _PyTokEngine()
 
 
 # -------------------------------------------------------------- input modes
