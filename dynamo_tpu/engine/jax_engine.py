@@ -89,6 +89,14 @@ class EngineConfig:
     def bucket_batch(self, n: int) -> int:
         return min(self._pick(self.batch_buckets, n), self.max_batch)
 
+    def prefill_bucket_batch(self, n: int) -> int:
+        """Prefill batches only use the two warmed buckets
+        (bucket_batch(1) and bucket_batch(max_prefill_batch)) so a
+        mid-serving prompt mix never triggers a fresh XLA compile."""
+        small = self.bucket_batch(1)
+        return small if n <= small else self.bucket_batch(
+            self.max_prefill_batch)
+
     def bucket_len(self, n: int) -> int:
         return min(self._pick(self.prefill_buckets, n), self.prefill_chunk)
 
@@ -417,7 +425,7 @@ class JaxEngine:
 
         chunks = [min(s.prefill_extent - s.computed, self.ecfg.prefill_chunk)
                   for s in batch]
-        B = self.ecfg.bucket_batch(len(batch))
+        B = self.ecfg.prefill_bucket_batch(len(batch))
         T = self.ecfg.bucket_len(max(chunks))
         P = self.ecfg.bucket_pages(max(len(s.pages) for s in batch))
 
@@ -453,9 +461,13 @@ class JaxEngine:
         if not finishing:
             return
         # one sampling pass over the full bucket (avoids a fresh compile
-        # per finishing-count); unfinished rows' samples are discarded
-        sampled_all = self._sample(batch, logits)
-        sampled = [sampled_all[i] for i, _ in finishing]
+        # per finishing-count); skipped entirely when every finishing row
+        # is a preemption-resume (their next token was already sampled)
+        if any(s.generated == 0 for _, s in finishing):
+            sampled_all = self._sample(batch, logits)
+            sampled = [sampled_all[i] for i, _ in finishing]
+        else:
+            sampled = [None] * len(finishing)
         for (i, seq), tok in zip(finishing, sampled):
             self._commit_full_pages(seq)
             if seq.generated == 0:
