@@ -65,12 +65,23 @@ def load_params(path: str, cfg: Optional[ModelConfig] = None,
     def stack(fmt: str, fn=linear) -> np.ndarray:
         return np.stack([fn(fmt.format(i)) for i in range(L)])
 
-    p["wq"] = stack("model.layers.{}.self_attn.q_proj.weight")
-    p["wk"] = stack("model.layers.{}.self_attn.k_proj.weight")
-    p["wv"] = stack("model.layers.{}.self_attn.v_proj.weight")
-    p["wo"] = stack("model.layers.{}.self_attn.o_proj.weight")
     p["ln_attn"] = stack("model.layers.{}.input_layernorm.weight", get)
     p["ln_mlp"] = stack("model.layers.{}.post_attention_layernorm.weight", get)
+    if cfg.is_mla:
+        _load_mla_attention(cfg, p, stack, linear, get)
+    else:
+        p["wq"] = stack("model.layers.{}.self_attn.q_proj.weight")
+        p["wk"] = stack("model.layers.{}.self_attn.k_proj.weight")
+        p["wv"] = stack("model.layers.{}.self_attn.v_proj.weight")
+        p["wo"] = stack("model.layers.{}.self_attn.o_proj.weight")
+        if cfg.attn_bias:  # Qwen2-style qkv bias
+            p["bq"] = stack("model.layers.{}.self_attn.q_proj.bias", get)
+            p["bk"] = stack("model.layers.{}.self_attn.k_proj.bias", get)
+            p["bv"] = stack("model.layers.{}.self_attn.v_proj.bias", get)
+    if cfg.num_experts > 0 and cfg.is_mla:
+        raise NotImplementedError(
+            "DeepSeek-MoE checkpoint loading (shared experts + dense-first "
+            "layers) is not wired yet; dense MLA and Mixtral MoE are")
     if cfg.num_experts > 0:
         E = cfg.num_experts
         p["w_router"] = stack(
@@ -92,3 +103,34 @@ def load_params(path: str, cfg: Optional[ModelConfig] = None,
         p["w_down"] = stack("model.layers.{}.mlp.down_proj.weight")
 
     return jax.tree.map(lambda a: jnp.asarray(a, dtype), p)
+
+
+def _load_mla_attention(cfg: ModelConfig, p: Dict[str, np.ndarray],
+                        stack, linear, get) -> None:
+    """DeepSeek-V2/V3 MLA attention weights → models/mla.py layout:
+    kv_a_proj_with_mqa → w_dkv ([D, r+dr]); kv_a_layernorm → kv_norm;
+    kv_b_proj ([H*(dn+dv), r] in HF) splits into w_uk [r, H*dn] and
+    w_uv [r, H*dv]; q path full-rank or LoRA (q_a/q_b + q_a_layernorm)."""
+    H = cfg.num_heads
+    r, dn, dv = cfg.kv_lora_rank, cfg.qk_nope_head_dim, cfg.v_head_dim
+    L = cfg.num_layers
+
+    p["w_dkv"] = stack("model.layers.{}.self_attn.kv_a_proj_with_mqa.weight")
+    p["kv_norm"] = stack("model.layers.{}.self_attn.kv_a_layernorm.weight",
+                         get)
+    uk, uv = [], []
+    for i in range(L):
+        b = linear(f"model.layers.{i}.self_attn.kv_b_proj.weight")
+        b = b.reshape(r, H, dn + dv)
+        uk.append(np.ascontiguousarray(b[:, :, :dn]).reshape(r, H * dn))
+        uv.append(np.ascontiguousarray(b[:, :, dn:]).reshape(r, H * dv))
+    p["w_uk"] = np.stack(uk)
+    p["w_uv"] = np.stack(uv)
+    p["w_o"] = stack("model.layers.{}.self_attn.o_proj.weight")
+    if cfg.q_lora_rank > 0:
+        p["w_dq"] = stack("model.layers.{}.self_attn.q_a_proj.weight")
+        p["q_norm"] = stack("model.layers.{}.self_attn.q_a_layernorm.weight",
+                            get)
+        p["w_uq"] = stack("model.layers.{}.self_attn.q_b_proj.weight")
+    else:
+        p["w_q"] = stack("model.layers.{}.self_attn.q_proj.weight")

@@ -66,6 +66,9 @@ def parse_args(argv=None):
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--max-tokens", type=int, default=128,
                     help="text/batch mode generation cap")
+    ap.add_argument("--profile-dir", default=os.environ.get(
+        "DYN_PROFILE_DIR"), help="capture a JAX/XLA profiler trace of the "
+        "serving session into this directory (view with xprof/tensorboard)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -390,6 +393,25 @@ async def _wait_for_signal() -> None:
 
 
 async def amain(args) -> int:
+    profiling = False
+    if args.profile_dir:
+        # tracing/profiling plane (reference keeps tracing-crate spans;
+        # on TPU the device story is the JAX profiler / XLA dumps)
+        import jax
+
+        jax.profiler.start_trace(args.profile_dir)
+        profiling = True
+    try:
+        return await _dispatch(args)
+    finally:
+        if profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", args.profile_dir)
+
+
+async def _dispatch(args) -> int:
     if args.input == "http":
         await run_http(args)
     elif args.input == "text":
