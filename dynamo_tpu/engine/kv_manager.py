@@ -179,7 +179,12 @@ class PageManager:
             break
         n_restore = sum(1 for p, _, _ in plan if p is None)
         need_fresh = need_total - (len(plan) - n_restore)
-        if need_fresh > self.available:
+        # device hits sitting in the reusable set count toward `available`
+        # but become unpoppable once ref'd below — exclude them, or the
+        # check passes and _pop_fresh runs dry mid-allocation
+        reusable_hits = sum(1 for p, _, _ in plan
+                            if p is not None and self.pages[p].refcount == 0)
+        if need_fresh > self.available - reusable_hits:
             return None
         # ref every device hit BEFORE popping fresh pages: a pop can evict
         # refcount-0 reusable pages, including ones matched later in plan

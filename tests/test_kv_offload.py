@@ -159,3 +159,24 @@ def test_engine_offload_end_to_end(host_pages, run_async):
         assert engine.offload_pages_total > 0
     else:
         assert engine.restore_pages_total == 0
+
+
+def test_alloc_accounting_with_reusable_prefix_hits():
+    """Regression: device prefix hits that are refcount-0 (reusable) must
+    not count as poppable capacity — previously the OOM check passed and
+    _pop_fresh raised on an empty pool mid-allocation."""
+    pm = PageManager(num_pages=5, page_size=2)  # 4 usable
+    prompt = list(range(8))  # 4 blocks
+    a = pm.allocate_sequence(prompt)
+    assert a is not None
+    _commit_all(pm, a.pages, prompt)
+    pm.release_sequence(a.pages)  # all 4 committed + reusable
+    # same prompt: 3 blocks reusable-hit (tail capped), needs 1 fresh;
+    # only the hit pages themselves are "available" → must refuse, not
+    # crash
+    b = pm.allocate_sequence(prompt + [99, 100])  # 5 blocks total
+    assert b is None or len(b.pages) == 5  # no KeyError either way
+    # and a plain repeat allocation still works
+    c = pm.allocate_sequence(prompt)
+    assert c is not None
+    assert c.cached_tokens == 6
