@@ -45,6 +45,8 @@ def parse_args():
                     help="host-DRAM offload tier size (multiturn scenario)")
     ap.add_argument("--users", type=int, default=16)
     ap.add_argument("--turns", type=int, default=4)
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="override engine max_batch (and batch buckets)")
     return ap.parse_args()
 
 
@@ -73,6 +75,9 @@ def build_engine(args):
                             batch_buckets=(8, 32), page_buckets=(32,),
                             decode_steps=args.decode_steps,
                             host_pages=args.host_pages)
+    if args.max_batch:
+        ecfg.max_batch = args.max_batch
+        ecfg.batch_buckets = (8, args.max_batch)
     if args.scenario == "multiturn":
         # size the HBM pool BELOW the conversation working set so turns
         # evict each other; the host tier is what keeps TTFT low

@@ -321,6 +321,9 @@ class JaxEngine:
                 continue
             try:
                 self._admit()
+                # prefill-priority (measured better than interleaving
+                # prefill+decode per iteration: TTFT and throughput both
+                # win when prompt batches drain at full cadence)
                 if self.prefilling:
                     await loop.run_in_executor(self._exec, self._prefill_step)
                 elif self.running:

@@ -31,7 +31,6 @@ page copies before its next device step (jax_engine._drain_kv_tier).
 
 from __future__ import annotations
 
-import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple

@@ -13,7 +13,7 @@ graph available to user pipelines and the SDK.
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, Awaitable, Callable, Optional, Tuple
+from typing import Any, AsyncIterator, Awaitable, Callable, Optional
 
 from .engine import Context
 
