@@ -118,6 +118,11 @@ class PageManager:
         self.host_lru: "OrderedDict[int, int]" = OrderedDict()  # slot → hash
         self.pending_offload: List[Tuple[int, int]] = []  # (page, host_slot)
         self.pending_restore: List[Tuple[int, int]] = []  # (page, host_slot)
+        # host slots planned for restore inside an in-progress
+        # allocate_sequence call: _pop_fresh→_host_slot evictions triggered
+        # by the same call must not reassign them (they reach
+        # pending_restore only when the call completes)
+        self._pinned_slots: set = set()
 
     # ------------------------------------------------------------- queries
 
@@ -190,23 +195,41 @@ class PageManager:
         for page, _, _ in plan:
             if page is not None:
                 self._ref(page)
+        # pin every planned restore slot for the whole call: an earlier
+        # plan entry's _pop_fresh can evict a device page into the host
+        # tier, and _host_slot must not hand it a slot a later entry still
+        # needs to read (silent KV corruption — ADVICE r1 high)
+        pinned = {slot for page, slot, _ in plan if page is None}
+        self._pinned_slots |= pinned
         claimed: List[int] = []
         restores: List[Tuple[int, int]] = []
-        for page, slot, h in plan:
-            if page is not None:
-                claimed.append(page)
-            else:
+        try:
+            for i, (page, slot, h) in enumerate(plan):
+                if page is not None:
+                    claimed.append(page)
+                    continue
+                # defensive re-check (pinning should make a vanished slot
+                # impossible): treat it as a miss — drop this and every
+                # later plan entry, recompute those blocks instead
+                if self.host_by_hash.get(h) != slot:
+                    for later, _, _ in plan[i:]:
+                        if later is not None:
+                            self.release_sequence([later])
+                    plan = plan[:i]
+                    break
                 fresh = self._pop_fresh()
                 # promote back to the device tier: matchable immediately
                 # (the engine drains the copy before its next device step);
                 # no "stored" event — the block never left this worker
                 self.pages[fresh].block_hash = h
                 self.by_hash[h] = fresh
-                self.host_lru.move_to_end(self.host_by_hash[h])
+                self.host_lru.move_to_end(slot)
                 restores.append((fresh, slot))
                 claimed.append(fresh)
-        for _ in range(need_total - len(claimed)):
-            claimed.append(self._pop_fresh())
+            for _ in range(need_total - len(claimed)):
+                claimed.append(self._pop_fresh())
+        finally:
+            self._pinned_slots -= pinned
         self.pending_restore.extend(restores)
         return Alloc(claimed, len(plan) * self.page_size, restores)
 
@@ -310,6 +333,7 @@ class PageManager:
             return self.host_free.popleft()
         busy = {s for _, s in self.pending_restore}
         busy.update(s for _, s in self.pending_offload)
+        busy.update(self._pinned_slots)
         for slot in self.host_lru:  # LRU → MRU order
             if slot not in busy:
                 old_h = self.host_lru.pop(slot)

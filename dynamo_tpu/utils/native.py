@@ -1,14 +1,16 @@
 """Loader for the native C++ runtime library (native/*.cpp).
 
 Builds ``native/build/libdynamo_native.so`` on first use (g++, cached by
-mtime) and exposes it via ctypes. Every consumer has a pure-Python
-fallback, so a missing toolchain degrades gracefully (reference layering:
-the Rust/C bits are performance substrate, not features).
+a sha256 over the sources — mtimes are meaningless after a fresh clone)
+and exposes it via ctypes. Every consumer has a pure-Python fallback, so
+a missing toolchain degrades gracefully (reference layering: the Rust/C
+bits are performance substrate, not features).
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
 import os
 import subprocess
@@ -26,15 +28,27 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+_STAMP_PATH = _LIB_PATH + ".srchash"
+
+
+def _src_hash() -> str:
+    h = hashlib.sha256()
+    for f in sorted(os.listdir(_NATIVE_DIR)):
+        if f.endswith((".cpp", ".h")) or f == "Makefile":
+            h.update(f.encode())
+            with open(os.path.join(_NATIVE_DIR, f), "rb") as fh:
+                h.update(fh.read())
+    return h.hexdigest()
+
+
 def _needs_build() -> bool:
     if not os.path.exists(_LIB_PATH):
         return True
-    lib_mtime = os.path.getmtime(_LIB_PATH)
-    for f in os.listdir(_NATIVE_DIR):
-        if f.endswith((".cpp", ".h")):
-            if os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > lib_mtime:
-                return True
-    return False
+    try:
+        with open(_STAMP_PATH) as fh:
+            return fh.read().strip() != _src_hash()
+    except OSError:
+        return True  # no stamp → binary of unknown provenance: rebuild
 
 
 def _declare(lib: ctypes.CDLL) -> None:
@@ -81,8 +95,12 @@ def load() -> Optional[ctypes.CDLL]:
         try:
             if _needs_build():
                 log.info("building native library in %s", _NATIVE_DIR)
-                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                               capture_output=True, timeout=120)
+                # -B: make's own mtime comparison is exactly what the hash
+                # stamp exists to replace — force the recompile
+                subprocess.run(["make", "-B", "-C", _NATIVE_DIR],
+                               check=True, capture_output=True, timeout=120)
+                with open(_STAMP_PATH, "w") as fh:
+                    fh.write(_src_hash())
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
             _lib = lib

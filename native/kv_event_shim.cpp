@@ -32,10 +32,43 @@ struct ShimState {
   uint32_t kv_block_size = 0;
   bool initialized = false;
   std::vector<uint8_t> buf;
+  uint64_t dropped = 0;  // whole events discarded at the high-water mark
   std::mutex mu;
 };
 
 ShimState g_state;
+
+// If no bridge is draining, the buffer must not grow without bound: above
+// the high-water mark the OLDEST whole events are discarded (the router
+// treats a lossy stream as stale-but-safe — a dropped "stored" only costs
+// a routing hit, a dropped "removed" is corrected at the next miss).
+// Dropping cuts down to the LOW-water mark so a saturated publisher pays
+// one front-erase memmove per ~2 MiB of events, not per event.
+constexpr uintptr_t kBufHighWater = 4ULL << 20;  // 4 MiB
+constexpr uintptr_t kBufLowWater = 2ULL << 20;   // 2 MiB
+
+// Size of the record starting at `off`, or 0 if truncated/corrupt.
+uintptr_t record_size(const std::vector<uint8_t> &buf, uintptr_t off) {
+  if (off + 21 > buf.size()) return 0;  // fixed header = 21 bytes
+  uint32_t nb;
+  std::memcpy(&nb, buf.data() + off + 17, 4);
+  uintptr_t rec = 1 + 8 + 8 + 4 + 8ULL * nb;
+  return off + rec <= buf.size() ? rec : 0;
+}
+
+// Caller holds g_state.mu.
+void enforce_high_water() {
+  if (g_state.buf.size() <= kBufHighWater) return;
+  uintptr_t cut = 0;
+  while (g_state.buf.size() - cut > kBufLowWater) {
+    uintptr_t rec = record_size(g_state.buf, cut);
+    if (rec == 0) break;
+    cut += rec;
+    ++g_state.dropped;
+  }
+  if (cut > 0)
+    g_state.buf.erase(g_state.buf.begin(), g_state.buf.begin() + cut);
+}
 
 void append_u8(std::vector<uint8_t> &b, uint8_t v) { b.push_back(v); }
 void append_u32(std::vector<uint8_t> &b, uint32_t v) {
@@ -94,6 +127,7 @@ int32_t dynamo_kv_event_publish_stored(uint64_t event_id,
   append_u32(g_state.buf, static_cast<uint32_t>(num_blocks));
   for (uintptr_t i = 0; i < num_blocks; ++i)
     append_u64(g_state.buf, block_ids[i]);
+  enforce_high_water();
   return 0;
 }
 
@@ -108,6 +142,7 @@ int32_t dynamo_kv_event_publish_removed(uint64_t event_id,
   append_u32(g_state.buf, static_cast<uint32_t>(num_blocks));
   for (uintptr_t i = 0; i < num_blocks; ++i)
     append_u64(g_state.buf, block_ids[i]);
+  enforce_high_water();
   return 0;
 }
 
@@ -121,16 +156,20 @@ uintptr_t dynamo_kv_events_drain(uint8_t *out, uintptr_t cap) {
   // exceed n
   uintptr_t end = 0;
   while (end < n) {
-    if (end + 21 > g_state.buf.size()) break;  // fixed header = 21 bytes
-    uint32_t nb;
-    std::memcpy(&nb, g_state.buf.data() + end + 17, 4);
-    uintptr_t rec = 1 + 8 + 8 + 4 + 8ULL * nb;
-    if (end + rec > n) break;
+    uintptr_t rec = record_size(g_state.buf, end);
+    if (rec == 0 || end + rec > n) break;
     end += rec;
   }
   std::memcpy(out, g_state.buf.data(), end);
   g_state.buf.erase(g_state.buf.begin(), g_state.buf.begin() + end);
   return end;
+}
+
+// Events discarded because nothing drained the shim before the buffer hit
+// its high-water mark (observability for the bridge to report).
+uint64_t dynamo_kv_events_dropped() {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  return g_state.dropped;
 }
 
 int64_t dynamo_llm_worker_id() {

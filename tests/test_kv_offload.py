@@ -161,6 +161,54 @@ def test_engine_offload_end_to_end(host_pages, run_async):
         assert engine.restore_pages_total == 0
 
 
+def test_restore_slots_pinned_against_midalloc_eviction():
+    """Regression (ADVICE r1 high): slots planned for restore must be
+    pinned for the whole allocate_sequence call. Previously they reached
+    pending_restore only at the end, so _pop_fresh→_host_slot evictions
+    fired by the same call's fresh-page pops could reassign them to new
+    offloads — the engine drains offloads before restores, so the restore
+    then copied the WRONG block into a page registered under the original
+    hash (silent KV corruption), or raised KeyError at host_by_hash[h]."""
+    pm = PageManager(num_pages=6, page_size=2, host_pages=2)  # 5 usable
+    a_prompt = list(range(4))           # blocks A0, A1
+    a = pm.allocate_sequence(a_prompt)
+    _commit_all(pm, a.pages, a_prompt)
+    pm.release_sequence(a.pages)
+    hold = pm.allocate_sequence([50, 51])          # keeps one page active
+    b_prompt = list(range(10, 14))
+    b = pm.allocate_sequence(b_prompt)             # pops remaining free
+    _commit_all(pm, b.pages, b_prompt)
+    pm.release_sequence(b.pages)
+    c_prompt = list(range(20, 24))
+    c = pm.allocate_sequence(c_prompt)   # free empty → evicts A's pages
+    off, _ = pm.drain_tier_ops()
+    assert len(off) == 2                 # A0, A1 offloaded; host tier FULL
+    _commit_all(pm, c.pages, c_prompt)
+    pm.release_sequence(c.pages)
+    pm.drain_events()
+
+    # A's prefix again (+2 tokens): both host slots are restore-planned;
+    # the 3 fresh-page pops evict committed pages (B, C) into the full
+    # host tier mid-call. Pinning must refuse them slots 0/1.
+    d = pm.allocate_sequence(a_prompt + [98, 99])
+    assert d is not None
+    assert len(d.restores) == 2
+    assert d.cached_tokens == 4
+    off, res = pm.drain_tier_ops()
+    assert sorted(s for _, s in res) == [0, 1]
+    # no slot may be both an offload target and a restore source
+    assert not ({s for _, s in off} & {s for _, s in res})
+    # the restored blocks still live in the host tier under their hashes
+    ha = chain_hashes(a_prompt, 2)
+    assert pm.host_by_hash[ha[0]] == d.restores[0][1]
+    assert pm.host_by_hash[ha[1]] == d.restores[1][1]
+    # evicted-without-a-slot blocks left the worker entirely → removed
+    removed = [e for e in pm.drain_events() if e.kind == "removed"]
+    assert removed, "pinned-out evictions must emit removed events"
+    assert pm._pinned_slots == set()     # pins released after the call
+    pm.release_sequence(hold.pages)
+
+
 def test_alloc_accounting_with_reusable_prefix_hits():
     """Regression: device prefix hits that are refcount-0 (reusable) must
     not count as poppable capacity — previously the OOM check passed and

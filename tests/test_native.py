@@ -117,6 +117,43 @@ def test_event_shim_roundtrip():
     lib.dynamo_llm_shutdown()
 
 
+def test_event_shim_high_water_drops_oldest():
+    """An undrained shim must not grow without bound (ADVICE r1): above
+    the 4 MiB high-water mark the oldest whole events are discarded and
+    counted, and the newest survive."""
+    lib = native.load()
+    lib.dynamo_kv_events_dropped.restype = ctypes.c_uint64
+    assert lib.dynamo_llm_init(b"ns", b"comp", 5, 64) == 0
+    base_dropped = lib.dynamo_kv_events_dropped()
+    n_blocks = 1024                       # ~8 KiB per event
+    blocks = (ctypes.c_uint64 * n_blocks)(*range(n_blocks))
+    n_events = 700                        # ~5.7 MiB total > 4 MiB cap
+    for eid in range(n_events):
+        assert lib.dynamo_kv_event_publish_stored(
+            eid, None, None, blocks, n_blocks, None, 0) == 0
+    dropped = lib.dynamo_kv_events_dropped() - base_dropped
+    assert dropped > 0
+    # drain everything that's left: newest event must have survived
+    from dynamo_tpu.llm.kv_router.publisher import NativeEventBridge
+
+    class FakeDcp:
+        async def publish(self, subject, payload):
+            pass
+
+    bridge = NativeEventBridge(FakeDcp(), "ns", "comp", worker_id=5)
+    events = []
+    while True:
+        batch = bridge.drain()
+        if not batch:
+            break
+        events.extend(batch)
+    assert len(events) == n_events - dropped
+    assert events[-1].block_hashes == list(range(n_blocks))
+    # total retained stays at/under the high-water mark (~8KiB records)
+    assert len(events) * n_blocks * 8 <= 4 * 1024 * 1024 + 8192 * 2
+    lib.dynamo_llm_shutdown()
+
+
 def test_kv_indexer_uses_native_backend():
     from dynamo_tpu.llm.kv_router.indexer import KvIndexer
     from dynamo_tpu.llm.kv_router.native_indexer import CppRadixTree
