@@ -39,16 +39,19 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # finite "masked" value: keeps exp() NaN-free
 
 
-def _decode_kernel(ps: int, scale: float,
-                   # scalar prefetch
+def _decode_kernel(ps: int, scale: float, return_stats: bool,
+                   # scalar prefetch (leading extras ignored: the layered
+                   # variant prefetches the layer index first)
                    pt_ref, len_ref,
-                   # blocks
-                   q_ref, k_ref, v_ref, o_ref,
-                   # scratch
-                   m_ref, l_ref, acc_ref):
+                   # blocks (leading dims squeezed by BlockSpec None-dims)
+                   q_ref, k_ref, v_ref, o_ref, *rest):
+    if return_stats:
+        m_out, l_out, m_ref, l_ref, acc_ref = rest
+    else:
+        m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     p = pl.program_id(1)
-    KV, group, hd = q_ref.shape[1:]
+    KV, group, hd = q_ref.shape
     H = KV * group
 
     @pl.when(p == 0)
@@ -61,9 +64,9 @@ def _decode_kernel(ps: int, scale: float,
 
     @pl.when(p * ps < length)  # trailing invalid pages: no compute
     def _():
-        q = q_ref[0].astype(jnp.float32)              # [KV, group, hd]
-        k = k_ref[0].astype(jnp.float32)              # [KV, ps, hd]
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[...].astype(jnp.float32)            # [KV, group, hd]
+        k = k_ref[...].astype(jnp.float32)            # [KV, ps, hd]
+        v = v_ref[...].astype(jnp.float32)
 
         # batched over the shared leading KV axis (MXU, no transposes)
         s = jax.lax.dot_general(
@@ -88,60 +91,117 @@ def _decode_kernel(ps: int, scale: float,
     @pl.when(p == pl.num_programs(1) - 1)
     def _():
         l = jnp.maximum(l_ref[:, :1], 1e-9)  # length-0 (padding) rows → 0
-        o_ref[0] = (acc_ref[...] / l).reshape(KV, group, hd).astype(
+        o_ref[...] = (acc_ref[...] / l).reshape(KV, group, hd).astype(
             o_ref.dtype)
+        if return_stats:
+            m_out[...] = m_ref[...]
+            l_out[...] = l_ref[...]
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _decode_kernel_layered(ps: int, scale: float, return_stats: bool,
+                           l_ref, pt_ref, len_ref, *refs):
+    # layered variant: the layer index rides as the first scalar-prefetch
+    # operand (consumed by the BlockSpec index maps); the body is identical
+    del l_ref
+    return _decode_kernel(ps, scale, return_stats, pt_ref, len_ref, *refs)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "return_stats"))
 def paged_attention_decode(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, page_table: jax.Array,
                            lengths: jax.Array, *, scale: float | None = None,
-                           interpret: bool = False) -> jax.Array:
+                           interpret: bool = False,
+                           return_stats: bool = False):
     """One decode step of paged GQA attention.
 
     q: [B, H, hd]; k_pages/v_pages: [num_pages, KV, ps, hd];
     page_table: [B, P] int32 (pad with 0 — page 0 is reserved);
     lengths: [B] int32 — tokens of context per row INCLUDING the one just
     written (rows with length 0 are padding and return zeros).
-    Returns [B, H, hd] in q.dtype.
+    Returns [B, H, hd] in q.dtype; with ``return_stats`` also the online-
+    softmax running stats (m, l) as float32 [B, H] so a caller can merge
+    this result with attention over extra keys outside the pool (the fused
+    decode window's in-flight buffer — models/llama.py
+    _pool_window_attention_pallas).
     """
+    # thin wrapper: a 4-D pool is the layered kernel with L=1 (the [None]
+    # reshape is metadata-only — no copy)
+    return paged_attention_decode_layered(
+        q, k_pages[None], v_pages[None], jnp.zeros((), jnp.int32),
+        page_table, lengths, scale=scale, interpret=interpret,
+        return_stats=return_stats)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "return_stats"))
+def paged_attention_decode_layered(q: jax.Array, k_pools: jax.Array,
+                                   v_pools: jax.Array, layer: jax.Array,
+                                   page_table: jax.Array,
+                                   lengths: jax.Array, *,
+                                   scale: float | None = None,
+                                   interpret: bool = False,
+                                   return_stats: bool = False):
+    """paged_attention_decode against ONE layer of the stacked pools.
+
+    k_pools/v_pools: [L, num_pages, KV, ps, hd]; ``layer`` a traced int32
+    scalar. The layer rides as a scalar-prefetch operand consumed only by
+    the BlockSpec index maps, so the kernel streams pages of that layer
+    straight out of the stacked pool — no [num_pages, ...] layer slice is
+    ever materialized. That matters because XLA materializes `pool[l]`
+    (≈200 MB/layer at serving sizes) when it feeds a pallas_call, and a
+    K-step fused decode window would pay that copy L·K times per window
+    (measured: ~30 ms/step at B=32 — 4x the whole model's weight
+    bandwidth); this variant makes the pool read O(live pages) as the
+    kernel intends."""
     B, H, hd = q.shape
-    _, KV, ps, _ = k_pages.shape
+    L, _, KV, ps, _ = k_pools.shape
     P = page_table.shape[1]
     group = H // KV
     if scale is None:
         scale = hd ** -0.5
     q4 = q.reshape(B, KV, group, hd)
 
-    def page_index(b, p, pt, ln):
-        # clamp invalid pages to the row's first page: identical consecutive
-        # block indices are not re-fetched by the pipeline
-        return (jnp.where(p * ps < ln[b], pt[b, p], pt[b, 0]), 0, 0, 0)
+    def page_index(b, p, l, pt, ln):
+        return (l[0], jnp.where(p * ps < ln[b], pt[b, p], pt[b, 0]),
+                0, 0, 0)
+
+    out_shape = [jax.ShapeDtypeStruct((B, KV, group, hd), q.dtype)]
+    out_specs = [pl.BlockSpec((None, KV, group, hd),
+                              lambda b, p, l, pt, ln: (b, 0, 0, 0))]
+    if return_stats:
+        out_shape += [jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
+                      jax.ShapeDtypeStruct((B, H, 128), jnp.float32)]
+        out_specs += [pl.BlockSpec((None, H, 128),
+                                   lambda b, p, l, pt, ln: (b, 0, 0))] * 2
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, P),
         in_specs=[
-            pl.BlockSpec((1, KV, group, hd),
-                         lambda b, p, pt, ln: (b, 0, 0, 0)),
-            pl.BlockSpec((1, KV, ps, hd), page_index),
-            pl.BlockSpec((1, KV, ps, hd), page_index),
+            pl.BlockSpec((None, KV, group, hd),
+                         lambda b, p, l, pt, ln: (b, 0, 0, 0)),
+            pl.BlockSpec((None, None, KV, ps, hd), page_index),
+            pl.BlockSpec((None, None, KV, ps, hd), page_index),
         ],
-        out_specs=pl.BlockSpec((1, KV, group, hd),
-                               lambda b, p, pt, ln: (b, 0, 0, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
-            pltpu.VMEM((H, 128), jnp.float32),  # running max
-            pltpu.VMEM((H, 128), jnp.float32),  # running sum
-            pltpu.VMEM((H, hd), jnp.float32),   # output accumulator
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, hd), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, ps, scale),
+    res = pl.pallas_call(
+        functools.partial(_decode_kernel_layered, ps, scale, return_stats),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KV, group, hd), q.dtype),
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q4, k_pages, v_pages)
-    return out.reshape(B, H, hd)
+    )(jnp.asarray(layer, jnp.int32).reshape(1),
+      page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q4, k_pools, v_pools)
+    out = res[0].reshape(B, H, hd)
+    if return_stats:
+        return out, res[1][:, :, 0], res[2][:, :, 0]
+    return out

@@ -251,3 +251,119 @@ def test_multi_step_decode_matches_single_step(run_async):
 
     assert results[1] == results[4]
     assert all(len(t) == 11 for t in results[4])
+
+
+def test_on_device_eos_stops_mid_window(run_async):
+    """On-device stop masking: pick a token the greedy run emits mid-window
+    and declare it EOS on a second run — generation must stop right after
+    emitting it, with no trailing tokens from the rest of the window (the
+    device freezes the row; the host discards nothing it shouldn't)."""
+    cfg = ModelConfig.tiny()
+    ecfg = EngineConfig(page_size=4, num_pages=64, max_batch=4,
+                        prefill_chunk=32, prefill_buckets=(32,),
+                        batch_buckets=(4,), page_buckets=(16,),
+                        decode_steps=4)
+    prompt = list(range(40, 60))
+
+    async def gen(engine, eos_ids, n):
+        req = PreprocessedRequest(
+            token_ids=prompt, sampling=SamplingOptions(),
+            stop=StopConditions(max_tokens=n), eos_token_ids=eos_ids)
+        toks, fin = [], None
+        async for out in engine.generate(req, Context()):
+            toks.extend(out.token_ids)
+            if out.finish_reason:
+                fin = out.finish_reason
+                break
+        await engine.stop()
+        return toks, fin
+
+    free, fin1 = run_async(gen(JaxEngine(cfg, ecfg, seed=0), [], 12))
+    assert fin1 == "length" and len(free) == 12
+    # make the 6th greedy token (lands mid-window for K=4) the stop token
+    eos = free[5]
+    cut = free[: free.index(eos) + 1]
+    got, fin2 = run_async(gen(JaxEngine(cfg, ecfg, seed=0), [eos], 12))
+    assert fin2 == "eos"
+    assert got == cut
+
+
+def test_pipeline_toggle_token_identity(run_async):
+    """pipeline_decode=False (dispatch+readback each window) and the
+    pipelined default must produce identical tokens — the device carry is
+    exact, not speculative."""
+    cfg = ModelConfig.tiny()
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 500, n).tolist() for n in (7, 18, 33)]
+
+    async def gen_all(engine):
+        async def one(p, i):
+            req = PreprocessedRequest(
+                token_ids=p,
+                sampling=SamplingOptions(temperature=0.7, top_k=12,
+                                         seed=100 + i),
+                stop=StopConditions(max_tokens=9, ignore_eos=True),
+                eos_token_ids=[])
+            toks = []
+            async for out in engine.generate(req, Context()):
+                toks.extend(out.token_ids)
+                if out.finish_reason:
+                    break
+            return toks
+        outs = await asyncio.gather(*(one(p, i)
+                                      for i, p in enumerate(prompts)))
+        await engine.stop()
+        return outs
+
+    results = {}
+    for pipe in (False, True):
+        ecfg = EngineConfig(page_size=4, num_pages=64, max_batch=4,
+                            prefill_chunk=32, prefill_buckets=(32,),
+                            batch_buckets=(4,), page_buckets=(16,),
+                            decode_steps=3, pipeline_decode=pipe)
+        results[pipe] = run_async(gen_all(JaxEngine(cfg, ecfg, seed=0)))
+
+    assert results[False] == results[True]
+    assert all(len(t) == 9 for t in results[True])
+
+
+def test_admission_clamped_to_warmed_grid(run_async):
+    """No mid-serving compile: prompts beyond the largest page bucket are
+    rejected at admission, and generation is cut at the grid capacity
+    instead of growing the page table past the warmed bucket."""
+    cfg = ModelConfig.tiny()
+    ecfg = EngineConfig(page_size=4, num_pages=64, max_batch=4,
+                        prefill_chunk=32, prefill_buckets=(32,),
+                        batch_buckets=(4,), page_buckets=(8,),
+                        decode_steps=4)
+
+    async def main():
+        engine = JaxEngine(cfg, ecfg, seed=0)
+        assert engine.cap_tokens == 32
+        # over-capacity prompt → error finish, no pages leaked
+        req = PreprocessedRequest(
+            token_ids=list(range(1, 41)), sampling=SamplingOptions(),
+            stop=StopConditions(max_tokens=4), eos_token_ids=[])
+        fin = None
+        async for out in engine.generate(req, Context()):
+            if out.finish_reason:
+                fin = out.finish_reason
+                break
+        assert fin == "error"
+        # near-capacity prompt: generation cut at cap_tokens, not max_tokens
+        req2 = PreprocessedRequest(
+            token_ids=list(range(1, 29)), sampling=SamplingOptions(),
+            stop=StopConditions(max_tokens=50, ignore_eos=True),
+            eos_token_ids=[])
+        toks, fin2 = [], None
+        async for out in engine.generate(req2, Context()):
+            toks.extend(out.token_ids)
+            if out.finish_reason:
+                fin2 = out.finish_reason
+                break
+        assert fin2 == "length"
+        assert len(toks) == 32 - 28
+        assert engine.pm.active == 0
+        await engine.stop()
+
+    run_async(main())
