@@ -37,10 +37,15 @@ def parse_args():
     ap.add_argument("--decode-steps", type=int, default=16,
                     help="fused decode window (amortizes dispatch latency)")
     ap.add_argument("--scenario", default="sharegpt",
-                    choices=["sharegpt", "multiturn"],
+                    choices=["sharegpt", "multiturn", "disagg"],
                     help="multiturn = conversations with growing shared "
                          "prefixes (the KV-offload TTFT scenario, "
-                         "reference docs/architecture.md:91-96)")
+                         "reference docs/architecture.md:91-96); "
+                         "disagg = A/B of disaggregated prefill/decode vs "
+                         "aggregated on the same workload (the BASELINE.md "
+                         "north-star, reference docs/architecture.md:57-61)")
+    ap.add_argument("--disagg-threshold", type=int, default=256,
+                    help="max local prefill length for the disagg router")
     ap.add_argument("--host-pages", type=int, default=0,
                     help="host-DRAM offload tier size (multiturn scenario)")
     ap.add_argument("--users", type=int, default=16)
@@ -69,10 +74,13 @@ def build_engine(args):
                           num_heads=32, num_kv_heads=8, head_dim=64,
                           dtype="bfloat16")
         # KV pool: 1536 pages x 64 tok = 96K cached tokens (~3.2 GB);
-        # headroom for the decode window's pool gather transients
+        # headroom for the decode window's pool gather transients.
+        # Two prefill T buckets + two page buckets: a 512-token prompt
+        # pays 512x1024 attention instead of 1024x2048 (bucket-
+        # homogeneous prefill batching keeps batches on their bucket)
         ecfg = EngineConfig(page_size=64, num_pages=1536, max_batch=32,
-                            prefill_chunk=1024, prefill_buckets=(1024,),
-                            batch_buckets=(8, 32), page_buckets=(32,),
+                            prefill_chunk=1024, prefill_buckets=(512, 1024),
+                            batch_buckets=(8, 32), page_buckets=(16, 32),
                             decode_steps=args.decode_steps,
                             host_pages=args.host_pages)
     if args.max_batch:
@@ -89,15 +97,19 @@ def build_engine(args):
     return engine, cfg
 
 
-def synth_requests(args, vocab: int):
-    """ShareGPT-like synthetic prompts: lognormal input lengths."""
+def synth_requests(args, vocab: int, cap_tokens: int = 1 << 30):
+    """ShareGPT-like synthetic prompts: lognormal input lengths, clipped
+    to the engine's grid capacity (a deployment router rejects over-
+    capacity prompts up front; letting them error-finish here would
+    inflate req/s with zero-work requests)."""
     import numpy as np
 
     rng = np.random.RandomState(args.seed)
+    hi = max(32, min(3072, cap_tokens - args.osl - 8))
     reqs = []
     for i in range(args.requests):
         isl = int(np.clip(rng.lognormal(mean=np.log(args.isl), sigma=0.6),
-                          32, 3072))
+                          32, hi))
         token_ids = rng.randint(1, min(vocab - 10, 255), size=isl).tolist()
         reqs.append((token_ids, args.osl))
     return reqs
@@ -124,7 +136,14 @@ async def run_multiturn(args):
                  for _ in range(args.users)]
     ttfts = []
 
+    errors = [0]
+
     async def one_turn(u):
+        # histories grow ~256 tokens/turn; keep them inside the engine's
+        # warmed-grid capacity (over-capacity prompts error-finish at
+        # admission and would silently drop out of the TTFT sample)
+        histories[u] = histories[u][-max(engine.cap_tokens - args.osl - 8,
+                                         64):]
         req = PreprocessedRequest(
             token_ids=list(histories[u]), sampling=SamplingOptions(),
             stop=StopConditions(max_tokens=args.osl, ignore_eos=True),
@@ -137,6 +156,8 @@ async def run_multiturn(args):
                 first = time.monotonic() - t0
             out_toks.extend(out.token_ids)
             if out.finish_reason:
+                if out.finish_reason == "error":
+                    errors[0] += 1
                 break
         ttfts.append(first)
         histories[u] = histories[u] + out_toks + \
@@ -153,6 +174,7 @@ async def run_multiturn(args):
     stats = engine.stats()
     report = {
         "scenario": "multiturn", "users": args.users, "turns": args.turns,
+        "errors": errors[0],
         "host_pages": args.host_pages, "wall_s": round(wall, 2),
         "ttft_later_turns_p50_ms":
             round(later[len(later) // 2] * 1000, 1) if later else None,
@@ -164,20 +186,16 @@ async def run_multiturn(args):
     return report
 
 
-async def run_bench(args):
+async def measure(engine, reqs, concurrency):
+    """Drive `reqs` through any AsyncEngine-shaped object at the given
+    concurrency; returns the aggregate report (the reference batch-mode
+    metrics, launch/dynamo-run input/batch.rs:42-105)."""
     from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
                                                  SamplingOptions,
                                                  StopConditions)
     from dynamo_tpu.runtime.engine import Context
 
-    engine, cfg = build_engine(args)
-    print("warming up (compiling bucket grid)...", file=sys.stderr)
-    t0 = time.monotonic()
-    engine.warmup()
-    print(f"warmup done in {time.monotonic()-t0:.1f}s", file=sys.stderr)
-
-    reqs = synth_requests(args, cfg.vocab_size)
-    sem = asyncio.Semaphore(args.concurrency)
+    sem = asyncio.Semaphore(concurrency)
     results = []
 
     async def one(req_idx, token_ids, osl):
@@ -192,6 +210,7 @@ async def run_bench(args):
             t_first = None
             stamps = []
             n_out = 0
+            finish = None
             async for out in engine.generate(pre, ctx):
                 now = time.monotonic()
                 if out.token_ids:
@@ -200,6 +219,7 @@ async def run_bench(args):
                     stamps.extend([now] * len(out.token_ids))
                     n_out += len(out.token_ids)
                 if out.finish_reason:
+                    finish = out.finish_reason
                     break
             t_end = time.monotonic()
             # window-amortized ITL: the fused decode window emits K tokens
@@ -213,13 +233,15 @@ async def run_bench(args):
                 "tokens_in": len(token_ids), "tokens_out": n_out,
                 "ttft": (t_first - t_start) if t_first else None,
                 "elapsed": t_end - t_start, "itl": itl,
+                "error": finish == "error",
             })
 
     bench_t0 = time.monotonic()
     await asyncio.gather(*(one(i, t, o) for i, (t, o) in enumerate(reqs)))
     wall = time.monotonic() - bench_t0
-    await engine.stop()
 
+    errors = sum(1 for r in results if r["error"])
+    results = [r for r in results if not r["error"]]
     total_out = sum(r["tokens_out"] for r in results)
     total_in = sum(r["tokens_in"] for r in results)
     ttfts = sorted(r["ttft"] for r in results if r["ttft"] is not None)
@@ -228,8 +250,9 @@ async def run_bench(args):
     def pct(v, p):
         return v[min(int(len(v) * p / 100), len(v) - 1)] if v else None
 
-    report = {
-        "requests": len(results), "wall_s": round(wall, 3),
+    return {
+        "requests": len(results), "errors": errors,
+        "wall_s": round(wall, 3),
         "req_per_s": round(len(results) / wall, 3),
         "output_tok_per_s": round(total_out / wall, 1),
         "total_tok_per_s": round((total_in + total_out) / wall, 1),
@@ -237,8 +260,98 @@ async def run_bench(args):
         "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 1) if ttfts else None,
         "itl_p50_ms": round(pct(itls, 50) * 1000, 2) if itls else None,
         "itl_p99_ms": round(pct(itls, 99) * 1000, 2) if itls else None,
-        "prefix_hit_rate": round(engine.stats()["gpu_prefix_cache_hit_rate"], 4),
     }
+
+
+async def run_bench(args):
+    engine, cfg = build_engine(args)
+    print("warming up (compiling bucket grid)...", file=sys.stderr)
+    t0 = time.monotonic()
+    engine.warmup()
+    print(f"warmup done in {time.monotonic()-t0:.1f}s", file=sys.stderr)
+
+    reqs = synth_requests(args, cfg.vocab_size, engine.cap_tokens)
+    report = await measure(engine, reqs, args.concurrency)
+    report["prefix_hit_rate"] = round(
+        engine.stats()["gpu_prefix_cache_hit_rate"], 4)
+    await engine.stop()
+    print(json.dumps(report), file=sys.stderr)
+    return report
+
+
+async def run_disagg(args):
+    """Disagg vs agg A/B on the same workload — the BASELINE.md north-star
+    (reference docs/architecture.md:57-61 claims +30%/GPU at 1 node).
+
+    On this testbed both engines time-share ONE chip and every KV page
+    crosses the loopback relay, so the interesting output is the full
+    metric set + the transfer-overhead breakdown, not a win: disagg's gain
+    comes from putting prefill on separate hardware, which a single-chip
+    A/B cannot express by construction.
+    """
+    import jax
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine
+    from dynamo_tpu.llm.disagg import DisaggRouter, PrefillWorker
+    from dynamo_tpu.llm.disagg.decode import build_disagg_decode
+    from dynamo_tpu.models.registry import get_model_module
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    engine, cfg = build_engine(args)  # aggregated baseline: full pool
+    params = engine.params  # one HBM copy shared by all three engines
+    print("warming up agg engine...", file=sys.stderr)
+    engine.warmup()
+    reqs = synth_requests(args, cfg.vocab_size, engine.cap_tokens)
+    agg = await measure(engine, reqs, args.concurrency)
+    await engine.stop()
+    base_ecfg = engine.ecfg
+    del engine
+
+    # disaggregated: decode engine (2/3 pool) + prefill engine (1/3 pool)
+    import dataclasses
+
+    decode_ecfg = dataclasses.replace(base_ecfg,
+                                      num_pages=base_ecfg.num_pages * 2 // 3)
+    prefill_ecfg = dataclasses.replace(base_ecfg,
+                                       num_pages=base_ecfg.num_pages // 3)
+    decode_eng = JaxEngine(cfg, decode_ecfg, params=params)
+    prefill_eng = JaxEngine(cfg, prefill_ecfg, params=params)
+    print("warming up disagg engines...", file=sys.stderr)
+    decode_eng.warmup()
+    prefill_eng.warmup(decode=False)
+
+    drt = await DistributedRuntime.detached()
+    router = DisaggRouter(max_local_prefill_length=args.disagg_threshold)
+    disagg = await build_disagg_decode(drt, decode_eng, namespace="bench",
+                                       router=router, watch_config=False)
+    pw = PrefillWorker(drt, prefill_eng, namespace="bench")
+    pw.start()
+
+    dis = await measure(disagg, reqs, args.concurrency)
+    st = disagg.stats()
+    xfer = disagg.transfer
+    dis["remote_prefills"] = st["remote_prefills"]
+    dis["local_prefills"] = st["local_prefills"]
+    dis["remote_fallbacks"] = st["remote_fallbacks"]
+    # per-request means over COMPLETED remote prefills (the wait/ingest
+    # accumulators only count successes; timeouts are in remote_fallbacks)
+    ok_remote = max(st["remote_prefills"] - st["remote_fallbacks"], 1)
+    dis["remote_wait_mean_ms"] = round(
+        1000 * st["remote_wait_total_s"] / ok_remote, 1)
+    dis["transfer_mb"] = round(xfer.bytes_ingested / 1e6, 1)
+    dis["transfer_pages"] = xfer.pages_ingested
+    dis["transfer_ingest_ms_per_req"] = round(
+        1000 * xfer.ingest_seconds / ok_remote, 1)
+
+    await pw.stop()
+    await disagg.transfer.stop()
+    await prefill_eng.stop()
+    await decode_eng.stop()
+    await drt.shutdown()
+
+    report = {"scenario": "disagg_vs_agg", "agg": agg, "disagg": dis,
+              "disagg_over_agg_req_per_s":
+                  round(dis["req_per_s"] / agg["req_per_s"], 3)}
     print(json.dumps(report), file=sys.stderr)
     return report
 
@@ -258,6 +371,14 @@ def main():
                       f"{args.host_pages}",
             "value": report["ttft_later_turns_p50_ms"],
             "unit": "ms", "vs_baseline": 1.0, "detail": report}))
+        return
+    if args.scenario == "disagg":
+        report = asyncio.run(run_disagg(args))
+        print(json.dumps({
+            "metric": f"disagg/agg req/s ratio (1-chip time-shared, "
+                      f"threshold {args.disagg_threshold})",
+            "value": report["disagg_over_agg_req_per_s"],
+            "unit": "ratio", "vs_baseline": 1.0, "detail": report}))
         return
     report = asyncio.run(run_bench(args))
     # the ONE line the driver records (vs_baseline: reference publishes no

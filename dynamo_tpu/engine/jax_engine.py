@@ -91,6 +91,13 @@ class EngineConfig:
     page_buckets: Tuple[int, ...] = (8, 64)
     watermark_pages: int = 4  # keep-free headroom before admitting
 
+    def __post_init__(self) -> None:
+        if self.prefill_chunk % self.page_size != 0:
+            raise ValueError(
+                f"prefill_chunk ({self.prefill_chunk}) must be a multiple "
+                f"of page_size ({self.page_size}): chunk starts must stay "
+                f"page-aligned for the page-granular KV commit")
+
     @staticmethod
     def _pick(buckets: Tuple[int, ...], n: int) -> int:
         for b in buckets:
@@ -119,7 +126,7 @@ class EngineConfig:
         return self._pick(self.page_buckets, n)
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: `in`/`==` must never deep-compare
 class Sequence:
     req: PreprocessedRequest
     context: Context
@@ -257,11 +264,13 @@ class JaxEngine:
 
     # ---------------------------------------------------------- lifecycle
 
-    def warmup(self, progress: bool = False) -> int:
+    def warmup(self, progress: bool = False, decode: bool = True) -> int:
         """Pre-compile the full bucket grid (prefill T×P, decode B×P,
         sampling per B) so no compile ever happens mid-serving — a
         mid-flight compile stalls every in-flight request for the compile
-        latency. Returns the number of programs compiled."""
+        latency. Returns the number of programs compiled.
+        ``decode=False`` skips the decode-window grid — for prefill-only
+        workers (disagg), whose engine never runs a decode step."""
         ecfg = self.ecfg
         page_buckets = [p for p in ecfg.page_buckets] or [8]
         t0 = time.monotonic()
@@ -271,19 +280,25 @@ class JaxEngine:
         for P in page_buckets:
             for T in {ecfg.bucket_len(t) for t in ecfg.prefill_buckets}:
                 for PB in prefill_bs:
+                    # warm exactly the serving variant: page-granular
+                    # commit for ps-aligned buckets, row scatter otherwise
+                    pslots = (jnp.full((PB, T // ecfg.page_size),
+                                       ecfg.num_pages, jnp.int32)
+                              if T % ecfg.page_size == 0 else None)
                     logits, self.kv_k, self.kv_v = self.prefill_fn(
                         self.params, jnp.zeros((PB, T), jnp.int32),
                         jnp.zeros((PB, T), jnp.int32) - 1,
                         self.kv_k, self.kv_v, jnp.zeros((PB, P), jnp.int32),
                         jnp.full((PB, T), DROP_SLOT, jnp.int32),
-                        jnp.zeros((PB,), jnp.int32))
+                        jnp.zeros((PB,), jnp.int32), pslots)
                     sample_tokens(logits, jnp.zeros(PB),
                                   jnp.zeros(PB, jnp.int32), jnp.ones(PB),
                                   jnp.zeros(PB, jnp.uint32),
                                   jnp.zeros(PB, jnp.int32),
                                   max_top_k=ecfg.max_top_k)
                     n += 1
-            for B in {ecfg.bucket_batch(b) for b in ecfg.batch_buckets}:
+            for B in ({ecfg.bucket_batch(b) for b in ecfg.batch_buckets}
+                      if decode else set()):
                 tableB = jnp.zeros((B, P), jnp.int32)
                 if ecfg.decode_steps > 1:
                     toks, _carry, self.kv_k, self.kv_v = self.decode_multi_fn(
@@ -312,7 +327,7 @@ class JaxEngine:
         # carry-merge combos (tiny programs): window N+1's inputs stitch
         # the previous window's device carry with host rows for newly
         # admitted sequences — one compile per (B_prev, B_new) pair
-        if ecfg.decode_steps > 1 and ecfg.pipeline_decode:
+        if decode and ecfg.decode_steps > 1 and ecfg.pipeline_decode:
             bset = sorted({ecfg.bucket_batch(b) for b in ecfg.batch_buckets})
             for Bp in bset:
                 carry = (jnp.zeros(Bp, jnp.int32), jnp.zeros(Bp, jnp.int32),
@@ -573,7 +588,7 @@ class JaxEngine:
         window when dispatch latency dominates: N prompts cost one round
         trip, not N — and under pipelining that round trip overlaps the
         in-flight decode window."""
-        batch: List[Sequence] = []
+        candidates: List[Sequence] = []
         for seq in list(self.prefilling):
             if seq.context.stopped:
                 self.prefilling.remove(seq)
@@ -585,11 +600,29 @@ class JaxEngine:
                 seq.last_token = seq.tokens[-1]
                 self.running.append(seq)
                 continue
-            batch.append(seq)
-            if len(batch) >= self.ecfg.max_prefill_batch:
-                break
-        if not batch:
+            candidates.append(seq)
+        if not candidates:
             return None
+        # bucket-homogeneous batching: the dispatch pads every row to the
+        # LARGEST member's (T, P) bucket, so one long prompt in a batch of
+        # short ones multiplies the whole batch's padded attention flops.
+        # Keep FIFO fairness for the head, then prefer its bucket-mates.
+        head = candidates[0]
+
+        def tbucket(s):
+            return self.ecfg.bucket_len(
+                min(s.prefill_extent - s.computed, self.ecfg.prefill_chunk))
+
+        hb = tbucket(head)
+        # fill with the head's bucket-mates, then with SMALLER-bucket
+        # prompts only (they ride along without raising T; a larger-bucket
+        # member would promote every row's padded attention to its bucket)
+        mates = [s for s in candidates[1:] if tbucket(s) == hb]
+        picked = {id(head)} | {id(s) for s in mates}
+        batch = [head] + mates
+        batch += [s for s in candidates[1:]
+                  if id(s) not in picked and tbucket(s) < hb]
+        batch = batch[: self.ecfg.max_prefill_batch]
 
         chunks = [min(s.prefill_extent - s.computed, self.ecfg.prefill_chunk)
                   for s in batch]
@@ -599,24 +632,39 @@ class JaxEngine:
 
         tokens = np.zeros((B, T), np.int32)
         positions = np.full((B, T), -1, np.int32)
-        slots = np.full((B, T), DROP_SLOT, np.int32)
         table = np.zeros((B, P), np.int32)
         last_idx = np.zeros(B, np.int32)
         ps = self.ecfg.page_size
+        # page-granular KV commit when the bucket is page-aligned AND every
+        # chunk start is (prefix hits are whole pages and chunk sizes are
+        # ps-multiples, so misalignment means an exotic config slipped past
+        # __post_init__ — fall back to the row scatter rather than crash)
+        use_paged = (T % ps == 0
+                     and all(s.computed % ps == 0 for s in batch))
+        slots = np.full((B, T), DROP_SLOT, np.int32)
+        pslots = np.full((B, max(T // ps, 1)), self.ecfg.num_pages, np.int32)
         for i, (seq, chunk) in enumerate(zip(batch, chunks)):
             start = seq.computed
             tokens[i, :chunk] = seq.tokens[start:start + chunk]
             positions[i, :chunk] = np.arange(start, start + chunk)
             pages = np.asarray(seq.pages, np.int64)
-            pos = np.arange(start, start + chunk)
-            slots[i, :chunk] = pages[pos // ps] * ps + pos % ps
             table[i, :len(seq.pages)] = seq.pages
             last_idx[i] = chunk - 1
+            # flat slots are always built: model modules without a paged
+            # commit path (MLA's latent cache) ignore page_slots and use
+            # these; llama ignores them when page_slots is present
+            pos = np.arange(start, start + chunk)
+            slots[i, :chunk] = pages[pos // ps] * ps + pos % ps
+            if use_paged:
+                first = start // ps
+                npg = (chunk + ps - 1) // ps
+                pslots[i, :npg] = pages[first:first + npg]
 
         logits, self.kv_k, self.kv_v = self.prefill_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots),
-            jnp.asarray(last_idx))
+            jnp.asarray(last_idx),
+            jnp.asarray(pslots) if use_paged else None)
         self.steps += 1
 
         finishing: List[Tuple[int, Sequence]] = []
@@ -693,6 +741,12 @@ class JaxEngine:
                 self.waiting.insert(0, victim)
                 if victim is seq:
                     break
+        # drain tier ops queued by grow-evictions NOW, before this step's
+        # forward dispatch: the evicted page's new owner writes it in the
+        # program we're about to enqueue, and a drain on the NEXT step
+        # would gather content the device has already overwritten —
+        # poisoning the host tier with spliced pages
+        self._drain_kv_tier()
 
     def _decode_step_single(self) -> None:
         """K=1 decode: one forward + sample per dispatch, synchronous."""
@@ -881,11 +935,17 @@ class JaxEngine:
             or tok in (seq.req.stop.stop_token_ids or [])
         self._emit(seq, EngineOutput(token_ids=[tok],
                                      prompt_tokens=seq.num_prompt))
-        # commit the page that just filled (prefix-cache publish)
+        # prefix-cache publish: commit a page only once every slot in it
+        # holds WRITTEN KV. The newest token's KV is written when it next
+        # serves as a decode input — which never happens for a terminal
+        # token under on-device stop freezing — so the publishable extent
+        # is len(tokens) - 1 positions, one token past the page boundary.
+        # Committing at filled % ps == 0 (the pre-pipelining rule) would
+        # publish a page whose last slot is junk and poison later hits.
         filled = len(seq.tokens)
         ps = self.ecfg.page_size
-        if filled % ps == 0:
-            nblocks = filled // ps
+        if filled > 1 and (filled - 1) % ps == 0 and (filled - 1) // ps >= 1:
+            nblocks = (filled - 1) // ps  # pages fully written
             hashes = chain_hashes(seq.tokens[:nblocks * ps], ps)
             parent = hashes[-2] if nblocks >= 2 else None
             self.pm.commit(seq.pages[nblocks - 1], hashes[-1],
@@ -1020,6 +1080,9 @@ class JaxEngine:
         loop = asyncio.get_running_loop()
 
         def _do():
+            # evictions queued when these pages were reserved must capture
+            # their OLD content before this injection overwrites it
+            self._drain_kv_tier()
             idx = jnp.asarray(page_ids, jnp.int32)
             self.kv_k = _inject_pages(self.kv_k, idx, jnp.asarray(k))
             self.kv_v = _inject_pages(self.kv_v, idx, jnp.asarray(v))
@@ -1124,7 +1187,7 @@ def _make_decode_multi(model, cfg: ModelConfig, allow_pallas: bool,
     Generic fallback for model modules without make_decode_window_fn
     (e.g. MLA): full forward per step with per-step pool writes; stopped
     rows write DROP_SLOT so nothing lands in their pages."""
-    from ..models.llama import logits_at
+    from ..models.llama import carry_active, carry_step_update, logits_at
 
     @partial(jax.jit, static_argnames=("k_steps",),
              donate_argnames=("kv_k", "kv_v"))
@@ -1143,7 +1206,7 @@ def _make_decode_multi(model, cfg: ModelConfig, allow_pallas: bool,
         tok, pos = tokens, positions
         toks = []
         for i in range(k_steps):
-            active = jnp.logical_and(jnp.logical_not(done), pos >= 0)
+            active = carry_active(done, pos)
             page = page_table[rows, jnp.clip(pos // ps, 0, P - 1)]
             slot = jnp.where(active, page * ps + pos % ps, DROP_SLOT)
             h, kv_k, kv_v = model.forward(
@@ -1152,14 +1215,8 @@ def _make_decode_multi(model, cfg: ModelConfig, allow_pallas: bool,
             logits = logits_at(params, cfg, h, jnp.zeros(B, jnp.int32))
             nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
                                 steps, max_top_k=max_top_k)
-            hit_stop = jnp.any(nxt[:, None] == eos_table, axis=1)
-            remaining = jnp.where(active, remaining - 1, remaining)
-            tok = jnp.where(active, nxt, tok)
-            pos = jnp.where(active, pos + 1, pos)
-            steps = jnp.where(active, steps + 1, steps)
-            done = jnp.logical_or(
-                done, jnp.logical_and(active, jnp.logical_or(
-                    hit_stop, remaining <= 0)))
+            tok, pos, done, steps, remaining = carry_step_update(
+                nxt, tok, pos, done, steps, remaining, eos_table)
             toks.append(tok)
         return (jnp.stack(toks, axis=1), (tok, pos, done, steps, remaining),
                 kv_k, kv_v)

@@ -45,12 +45,18 @@ class DisaggDecodeEngine:
         self.remote_prefills = 0
         self.local_prefills = 0
         self.remote_fallbacks = 0
+        # decode-side view of the remote leg: enqueue → KV landed + first
+        # token (queue wait + prefill compute + page transfer), the
+        # disagg-vs-agg transfer-overhead breakdown the reference's
+        # "+30%/GPU" claim hides (docs/architecture.md:57-61)
+        self.remote_wait_total_s = 0.0
 
     def stats(self) -> dict:
         s = dict(self.engine.stats())
         s.update(remote_prefills=self.remote_prefills,
                  local_prefills=self.local_prefills,
-                 remote_fallbacks=self.remote_fallbacks)
+                 remote_fallbacks=self.remote_fallbacks,
+                 remote_wait_total_s=round(self.remote_wait_total_s, 3))
         return s
 
     async def generate(self, request, context: Context
@@ -116,6 +122,9 @@ class DisaggDecodeEngine:
     async def _remote_prefill(self, request: PreprocessedRequest,
                               context: Context, res) -> Optional[int]:
         """Enqueue + await the KV arrival; returns the first token or None."""
+        import time as _time
+
+        t0 = _time.monotonic()
         fut = self.transfer.expect(context.id)
         await self.queue.put(RemotePrefillRequest(
             request_id=context.id,
@@ -127,7 +136,9 @@ class DisaggDecodeEngine:
             engine_id=self.engine_id,
         ))
         try:
-            return await asyncio.wait_for(fut, self.prefill_timeout)
+            first = await asyncio.wait_for(fut, self.prefill_timeout)
+            self.remote_wait_total_s += _time.monotonic() - t0
+            return first
         except asyncio.TimeoutError:
             self.transfer.cancel(context.id)
             return None

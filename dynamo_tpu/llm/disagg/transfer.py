@@ -64,6 +64,10 @@ class KvTransferServer:
         self._waiters: Dict[str, asyncio.Future] = {}
         self.host: str = ""
         self.port: int = 0
+        # transfer-plane accounting (disagg bench breakdown)
+        self.bytes_ingested = 0
+        self.pages_ingested = 0
+        self.ingest_seconds = 0.0
 
     async def start(self, host: str = "0.0.0.0") -> None:
         self._server = await asyncio.start_server(self._on_conn, host, 0)
@@ -136,12 +140,18 @@ class KvTransferServer:
             return
         page_ids = list(h["page_ids"])
         if page_ids:
+            import time as _time
+
+            t0 = _time.monotonic()
             shape = tuple(h["shape"])  # [L, n, KV, ps, hd]
             dtype = _np_dtype(h["dtype"])
             k_len = h["k_len"]
             k = np.frombuffer(msg.body[:k_len], dtype).reshape(shape)
             v = np.frombuffer(msg.body[k_len:], dtype).reshape(shape)
             await self.engine.inject_pages(page_ids, k, v)
+            self.bytes_ingested += len(msg.body)
+            self.pages_ingested += len(page_ids)
+            self.ingest_seconds += _time.monotonic() - t0
         if not fut.done():
             fut.set_result(int(h["first_token"]))
 

@@ -154,6 +154,31 @@ def apply_rope(x: jax.Array, positions: jax.Array,
     return out.astype(x.dtype)
 
 
+def _scatter_pages_paged(cache_layer: jax.Array, new: jax.Array,
+                         page_slots: jax.Array) -> jax.Array:
+    """Page-granular prefill commit: write WHOLE pages instead of
+    scattering T individual rows (the row scatter costs ~110 ms per
+    8x1024 prefill dispatch on v5e; this path is a reshape + block
+    write). Requires chunk starts page-aligned (the engine guarantees it:
+    prefix-cache hits are whole pages and chunk sizes are multiples of
+    the page size). The tail page may carry junk K/V beyond the chunk —
+    safe, because a position's K/V is always written before any query
+    attends to it (causal masks exclude unwritten positions, and decode
+    overwrites its slot before reading it).
+
+    cache_layer: [num_pages, KV, ps, hd]; new: [B, T, KV, hd] (T % ps
+    == 0); page_slots: [B, T // ps] destination page ids (>= num_pages →
+    dropped padding).
+    """
+    np_, kv, ps, hd = cache_layer.shape
+    B, T = new.shape[:2]
+    blocks = new.reshape(B, T // ps, ps, kv, hd).transpose(0, 1, 3, 2, 4)
+    blocks = blocks.reshape(B * (T // ps), kv, ps, hd)
+    idx = page_slots.reshape(-1)
+    return cache_layer.at[idx].set(blocks.astype(cache_layer.dtype),
+                                   mode="drop")
+
+
 def _scatter_pages(cache_layer: jax.Array, new: jax.Array,
                    flat_slots: jax.Array) -> jax.Array:
     """Write new K/V rows into the page pool.
@@ -225,13 +250,18 @@ def _paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     v = v.transpose(0, 1, 3, 2, 4).reshape(B, S, KV, hd)
 
     qg = q.reshape(B, T, KV, group, hd)
-    scores = jnp.einsum("btkgh,bskh->bkgts", qg.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
+    # native-dtype operands + f32 accumulation: upcasting q/k to f32
+    # BEFORE the matmul forces the MXU onto its f32 path (~8x slower than
+    # bf16 x bf16 -> f32); preferred_element_type keeps the accumulator
+    # exact. CPU test configs run f32 models, so this is identical there.
+    scores = jnp.einsum("btkgh,bskh->bkgts", qg, k,
+                        preferred_element_type=jnp.float32) * scale
     # mask [B, T, S]: slot j (logical position) visible iff j <= query pos
     mask = (jnp.arange(S)[None, None, :] <= q_positions[:, :, None])
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskh->btkgh", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return out.reshape(B, T, H, hd).astype(q.dtype)
 
 
@@ -269,13 +299,15 @@ def _moe_mlp(h: jax.Array, w_router, w_gate, w_up, w_down,
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             positions: jax.Array, kv_k: jax.Array, kv_v: jax.Array,
             page_table: jax.Array, flat_slots: jax.Array,
-            allow_pallas: bool = True,
+            allow_pallas: bool = True, page_slots: Optional[jax.Array] = None,
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Shared prefill/decode forward.
 
     tokens: [B, T] (T=1 for decode); positions: [B, T] absolute positions
     (-1 for padding rows); page_table: [B, P]; flat_slots: [B, T] cache
-    write slots (page*page_size + offset, -1 to drop padding).
+    write slots (page*page_size + offset, -1 to drop padding);
+    page_slots: optional [B, T // ps] page-granular write path for
+    aligned prefill chunks (see _scatter_pages_paged).
 
     Returns (hidden [B, T, D], new_kv_k, new_kv_v).
     """
@@ -306,8 +338,12 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         v = xv.reshape(B, T, KV, hd)
         q = apply_rope(q, safe_pos, inv_freq)
         k = apply_rope(k, safe_pos, inv_freq)
-        k_layer = _scatter_pages(k_layer, k, flat_slots)
-        v_layer = _scatter_pages(v_layer, v, flat_slots)
+        if page_slots is not None:
+            k_layer = _scatter_pages_paged(k_layer, k, page_slots)
+            v_layer = _scatter_pages_paged(v_layer, v, page_slots)
+        else:
+            k_layer = _scatter_pages(k_layer, k, flat_slots)
+            v_layer = _scatter_pages(v_layer, v, flat_slots)
         attn = _attention(q, k_layer, v_layer, page_table, positions, scale,
                           allow_pallas=allow_pallas)
         h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
@@ -351,11 +387,13 @@ def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True):
     @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
     def prefill_step(params: Params, tokens: jax.Array, positions: jax.Array,
                      kv_k: jax.Array, kv_v: jax.Array, page_table: jax.Array,
-                     flat_slots: jax.Array, last_idx: jax.Array):
+                     flat_slots: jax.Array, last_idx: jax.Array,
+                     page_slots: Optional[jax.Array] = None):
         """Process prompt chunks [B, T]; returns (logits [B, V], kv_k, kv_v)."""
         h, kv_k2, kv_v2 = forward(params, cfg, tokens, positions, kv_k, kv_v,
                                   page_table, flat_slots,
-                                  allow_pallas=allow_pallas)
+                                  allow_pallas=allow_pallas,
+                                  page_slots=page_slots)
         return logits_at(params, cfg, h, last_idx), kv_k2, kv_v2
 
     @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
@@ -376,6 +414,29 @@ def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True):
 
 
 # ------------------------------------------------- fused decode window
+
+
+def carry_active(done: jax.Array, pos: jax.Array) -> jax.Array:
+    """Rows still generating: not stopped, not padding (pos < 0)."""
+    return jnp.logical_and(jnp.logical_not(done), pos >= 0)
+
+
+def carry_step_update(nxt, tok, pos, done, steps, remaining, eos_table):
+    """Shared on-device sequence-carry update for one fused decode step:
+    freeze rows that sample a stop token or exhaust their budget. Both
+    fused-window implementations (llama window form and the engine's
+    generic full-forward fallback) MUST use this — the host bookkeeping in
+    _process_window assumes identical stop semantics on every path."""
+    active = carry_active(done, pos)
+    hit_stop = jnp.any(nxt[:, None] == eos_table, axis=1)
+    remaining = jnp.where(active, remaining - 1, remaining)
+    tok = jnp.where(active, nxt, tok)
+    pos = jnp.where(active, pos + 1, pos)
+    steps = jnp.where(active, steps + 1, steps)
+    done = jnp.logical_or(
+        done, jnp.logical_and(active, jnp.logical_or(
+            hit_stop, remaining <= 0)))
+    return tok, pos, done, steps, remaining
 
 
 def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
@@ -488,18 +549,11 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
             # frozen (done/pad) rows still flow through the matmuls — their
             # outputs are discarded and their KV never commits (commit mask
             # below), so correctness needs no per-row control flow
-            active = jnp.logical_and(jnp.logical_not(done), pos >= 0)
             logits, wk, wv = one_step(tok, pos, wk, wv, i)
             nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
                                 steps, max_top_k=max_top_k)
-            hit_stop = jnp.any(nxt[:, None] == eos_table, axis=1)
-            remaining = jnp.where(active, remaining - 1, remaining)
-            tok = jnp.where(active, nxt, tok)
-            pos = jnp.where(active, pos + 1, pos)
-            steps = jnp.where(active, steps + 1, steps)
-            done = jnp.logical_or(
-                done, jnp.logical_and(active, jnp.logical_or(
-                    hit_stop, remaining <= 0)))
+            tok, pos, done, steps, remaining = carry_step_update(
+                nxt, tok, pos, done, steps, remaining, eos_table)
             toks.append(tok)
 
         # commit the window into the pool: one scatter per layer; entry i
@@ -521,7 +575,8 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
 
 
 def _pool_window_attention_pallas(q, k_pools, v_pools, l_idx, page_table,
-                                  start, wk_l, wv_l, i: int, scale):
+                                  start, wk_l, wv_l, i: int, scale,
+                                  interpret: bool = False):
     """Decode attention for one fused-window step: the (frozen) paged pool
     via the Pallas flash kernel (stats returned, layer selected by index
     map — no layer-slice materialization), merged with the in-flight
@@ -540,7 +595,7 @@ def _pool_window_attention_pallas(q, k_pools, v_pools, l_idx, page_table,
     lengths = jnp.maximum(start, 0)  # pool extent; padding rows (-1) → 0
     out_p, m_p, l_p = paged_attention_decode_layered(
         q[:, 0], k_pools, v_pools, l_idx, page_table, lengths, scale=scale,
-        return_stats=True)
+        return_stats=True, interpret=interpret)
     qg = q.reshape(B, KV, G, hd).astype(jnp.float32)
     sw = jnp.einsum("bkgh,bwkh->bkgw", qg,
                     wk_l.astype(jnp.float32)) * scale  # [B, KV, G, K]

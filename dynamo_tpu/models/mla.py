@@ -222,7 +222,11 @@ def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True):
 
     @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
     def prefill_step(params, tokens, positions, kv_k, kv_v, page_table,
-                     flat_slots, last_idx):
+                     flat_slots, last_idx, page_slots=None):
+        # page_slots accepted for engine-contract parity with llama; the
+        # MLA latent cache keeps the row-scatter commit (its pages hold
+        # compressed latents, not per-head K/V blocks)
+        del page_slots
         h, k2, v2 = forward(params, cfg, tokens, positions, kv_k, kv_v,
                             page_table, flat_slots)
         return logits_at(params, cfg, h, last_idx), k2, v2

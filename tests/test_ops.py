@@ -81,3 +81,38 @@ def test_decode_kernel_bf16():
     np.testing.assert_allclose(np.asarray(got, np.float32),
                                np.asarray(want, np.float32),
                                rtol=0.05, atol=0.05)
+
+
+def test_pool_window_merge_matches_xla():
+    """The fused-window pool attention (Pallas kernel w/ stats + online-
+    softmax merge against the in-flight window buffer) must match the XLA
+    concat path — including rows with an empty pool (start=0) and padding
+    rows (start=-1). This is the only exercise the stats/merge path gets
+    off-TPU (interpret mode)."""
+    from dynamo_tpu.models.llama import (_pool_window_attention,
+                                         _pool_window_attention_pallas)
+
+    B, H, KV, hd, ps, P, L, K = 4, 8, 4, 64, 8, 3, 2, 4
+    key = jax.random.PRNGKey(7)
+    ks = jax.random.split(key, 5)
+    k_pools = jax.random.normal(ks[0], (L, 16, KV, ps, hd), jnp.float32)
+    v_pools = jax.random.normal(ks[1], (L, 16, KV, ps, hd), jnp.float32)
+    q = jax.random.normal(ks[2], (B, 1, H, hd), jnp.float32)
+    wk = jax.random.normal(ks[3], (B, K, KV, hd), jnp.float32)
+    wv = jax.random.normal(ks[4], (B, K, KV, hd), jnp.float32)
+    table = jnp.asarray([[1, 2, 3], [4, 5, 6], [7, 8, 9], [1, 0, 0]],
+                        jnp.int32)
+    # row 0: mid-pool; row 1: page-boundary; row 2: empty pool (start=0);
+    # row 3: padding (start=-1)
+    start = jnp.asarray([13, 16, 0, -1], jnp.int32)
+    scale = hd ** -0.5
+    for i in (0, K - 1):
+        for l in range(L):
+            got = _pool_window_attention_pallas(
+                q, k_pools, v_pools, jnp.int32(l), table, start, wk, wv,
+                i, scale, interpret=True)
+            want = _pool_window_attention(
+                q, k_pools[l], v_pools[l], table, start, wk, wv, i, scale)
+            np.testing.assert_allclose(np.asarray(got)[:3],
+                                       np.asarray(want)[:3],
+                                       rtol=2e-5, atol=2e-5)
