@@ -289,12 +289,9 @@ async def run_disagg(args):
     comes from putting prefill on separate hardware, which a single-chip
     A/B cannot express by construction.
     """
-    import jax
-
     from dynamo_tpu.engine.jax_engine import JaxEngine
     from dynamo_tpu.llm.disagg import DisaggRouter, PrefillWorker
     from dynamo_tpu.llm.disagg.decode import build_disagg_decode
-    from dynamo_tpu.models.registry import get_model_module
     from dynamo_tpu.runtime.runtime import DistributedRuntime
 
     engine, cfg = build_engine(args)  # aggregated baseline: full pool

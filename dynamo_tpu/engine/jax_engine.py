@@ -200,17 +200,27 @@ class JaxEngine:
             self.params = shard_params(self.params, model_cfg, mesh)
             self.kv_k, self.kv_v = shard_kv_cache(self.kv_k, self.kv_v,
                                                   model_cfg, mesh)
-        # Pallas decode kernel only on unsharded pools: pallas_call has no
-        # GSPMD partitioning rule, so a mesh-sharded KV operand would be
-        # replicated per step (or fail to partition)
+        # prefill + K=1 decode: the raw pallas_call has no GSPMD
+        # partitioning rule, so those paths keep the XLA fallback when the
+        # pool is mesh-sharded. The fused decode WINDOW (the serving hot
+        # path) keeps the kernel under TP via a shard_map over the head
+        # axis (paged_attention_decode_sharded).
         allow_pallas = mesh is None or mesh.size == 1
         self.prefill_fn, self.decode_fn = model.make_step_fns(
             model_cfg, allow_pallas=allow_pallas)
+        if mesh is not None and mesh.size > 1:
+            d = mesh.shape.get("data", 1)
+            bad = [b for b in self.ecfg.batch_buckets if b % d]
+            if d > 1 and bad:
+                raise ValueError(
+                    f"batch_buckets {bad} not divisible by mesh data axis "
+                    f"({d}): shard_map decode windows need whole rows per "
+                    f"data shard")
         if hasattr(model, "make_decode_window_fn"):
             # model-provided fused window (read-only pool + window buffer:
             # one pool copy in HBM; see llama.make_decode_window_fn)
             self.decode_multi_fn = model.make_decode_window_fn(
-                model_cfg, allow_pallas, self.ecfg.max_top_k)
+                model_cfg, True, self.ecfg.max_top_k, mesh=mesh)
         else:
             self.decode_multi_fn = _make_decode_multi(
                 model, model_cfg, allow_pallas, self.ecfg.max_top_k)
@@ -944,7 +954,7 @@ class JaxEngine:
         # publish a page whose last slot is junk and poison later hits.
         filled = len(seq.tokens)
         ps = self.ecfg.page_size
-        if filled > 1 and (filled - 1) % ps == 0 and (filled - 1) // ps >= 1:
+        if (filled - 1) >= ps and (filled - 1) % ps == 0:
             nblocks = (filled - 1) // ps  # pages fully written
             hashes = chain_hashes(seq.tokens[:nblocks * ps], ps)
             parent = hashes[-2] if nblocks >= 2 else None

