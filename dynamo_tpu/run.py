@@ -63,6 +63,13 @@ def parse_args(argv=None):
     ap.add_argument("--num-pages", type=int, default=None)
     ap.add_argument("--max-batch-size", type=int, default=None)
     ap.add_argument("--tensor-parallel-size", type=int, default=1)
+    ap.add_argument("--sequence-parallel-size", type=int, default=1,
+                    help="seq-axis mesh size for ring-attention long "
+                         "prefill (long-context serving)")
+    ap.add_argument("--long-prefill-threshold", type=int, default=None,
+                    help="prompts longer than this take the sequence-"
+                         "parallel ring prefill (needs "
+                         "--sequence-parallel-size > 1)")
     # multi-host SPMD bootstrap (replaces the reference's Ray head/follower
     # for vLLM multi-node TP, lib/llm/src/engines/vllm/ray.rs, and
     # SGLang's leader-addr handshake, engines/sglang/main.rs:48-76):
@@ -183,9 +190,18 @@ def build_engine(args) -> Tuple[object, object, bool]:
                      "(%d global devices)", args.coordinator,
                      args.process_id, args.num_processes,
                      len(__import__("jax").devices()))
-        if args.tensor_parallel_size > 1:
+        if args.tensor_parallel_size > 1 or args.sequence_parallel_size > 1:
             from .parallel.mesh import MeshSpec
-            mesh = MeshSpec(model=args.tensor_parallel_size).build()
+            mesh = MeshSpec(model=args.tensor_parallel_size,
+                            seq=args.sequence_parallel_size).build()
+        if args.long_prefill_threshold:
+            if args.sequence_parallel_size <= 1:
+                raise SystemExit(
+                    "--long-prefill-threshold needs "
+                    "--sequence-parallel-size > 1 (the ring prefill runs "
+                    "over the mesh's seq axis)")
+            ecfg = dataclasses.replace(
+                ecfg, long_prefill_threshold=args.long_prefill_threshold)
         if args.model_path:
             try:
                 params = load_params(args.model_path, cfg)
