@@ -239,6 +239,11 @@ class JaxEngine:
         self.long_prefills_total = 0
         if (self.ecfg.long_prefill_threshold is not None
                 and mesh is not None and mesh.shape.get("seq", 1) > 1):
+            if model_cfg.is_mla:
+                raise ValueError(
+                    "ring long-prefill is not implemented for MLA models "
+                    "(make_long_prefill_fn builds the GQA Llama stack); "
+                    "unset long_prefill_threshold")
             from ..parallel.ring_attention import make_long_prefill_fn
             self.long_prefill_fn = make_long_prefill_fn(model_cfg, mesh)
             self._seq_par = mesh.shape["seq"]

@@ -1,0 +1,54 @@
+"""Model acquisition by id: the serving front door.
+
+Reference: ``dynamo-run`` resolves positional model arguments against the
+HuggingFace hub with a local-cache-first download
+(launch/dynamo-run/src/hub.rs). Same contract here: a local directory
+passes through untouched; anything else resolves through the HF cache
+(offline-friendly) and only then the network. Zero-egress deployments
+pre-populate the cache (or set HF_HUB_OFFLINE=1) and everything keeps
+working.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("dynamo_tpu.hub")
+
+# weights + configs + tokenizer assets; skips .bin duplicates when
+# safetensors exist (the loader is safetensors-only)
+_PATTERNS = ["*.safetensors", "*.safetensors.index.json", "*.json",
+             "*.model", "tokenizer*", "*.tiktoken"]
+
+
+def resolve_model(model_id: str, revision: str | None = None) -> str:
+    """Resolve a model id or path to a local checkpoint directory.
+
+    Local directories are returned as-is. Hub ids resolve via
+    huggingface_hub's snapshot cache: cache-only first (works with zero
+    egress when the cache is pre-populated), then a network download.
+    """
+    if os.path.isdir(model_id):
+        return model_id
+    from huggingface_hub import snapshot_download
+
+    try:
+        path = snapshot_download(model_id, revision=revision,
+                                 allow_patterns=_PATTERNS,
+                                 local_files_only=True)
+        log.info("resolved %s from local HF cache: %s", model_id, path)
+        return path
+    except Exception:  # noqa: BLE001 — cache miss falls through to network
+        pass
+    try:
+        path = snapshot_download(model_id, revision=revision,
+                                 allow_patterns=_PATTERNS)
+        log.info("downloaded %s: %s", model_id, path)
+        return path
+    except Exception as exc:  # noqa: BLE001
+        raise RuntimeError(
+            f"cannot resolve model {model_id!r}: not a local directory, "
+            f"not in the HF cache, and download failed ({exc}). Pass "
+            f"--model-path, or pre-populate the HuggingFace cache on "
+            f"zero-egress hosts.") from exc

@@ -150,19 +150,22 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
         h = lax.with_sharding_constraint(h, act_spec)
         safe_pos = jnp.maximum(positions, 0)
 
-        layer_params = {kk: params[kk] for kk in
-                        ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                         "ln_attn", "ln_mlp")}
+        keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                "ln_attn", "ln_mlp"]
         if cfg.num_experts > 0:
-            layer_params["w_router"] = params["w_router"]
+            keys.append("w_router")
+        if cfg.attn_bias:
+            keys += ["bq", "bk", "bv"]
+        layer_params = {kk: params[kk] for kk in keys}
 
         def layer(h, lp):
             x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
-            q = apply_rope((x @ lp["wq"]).reshape(B, T, H, hd), safe_pos,
-                           inv_freq)
-            k = apply_rope((x @ lp["wk"]).reshape(B, T, KV, hd), safe_pos,
-                           inv_freq)
-            v = (x @ lp["wv"]).reshape(B, T, KV, hd)
+            xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+            if cfg.attn_bias:  # Qwen2-style qkv bias (matches llama.forward)
+                xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
+            q = apply_rope(xq.reshape(B, T, H, hd), safe_pos, inv_freq)
+            k = apply_rope(xk.reshape(B, T, KV, hd), safe_pos, inv_freq)
+            v = xv.reshape(B, T, KV, hd)
             attn = ring_attention(q, k, v, positions, mesh, scale=scale,
                                   seq_axis=seq_axis)
             h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
@@ -190,12 +193,16 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
     return long_prefill
 
 
+@partial(jax.jit, donate_argnums=(0, 1))
 def scatter_prefill_kv(kv_k: jax.Array, kv_v: jax.Array, k_all: jax.Array,
                        v_all: jax.Array, flat_slots: jax.Array
                        ) -> Tuple[jax.Array, jax.Array]:
     """Write long-prefill K/V ([L, B, T, KV, hd]) into the paged pools
     ([L, pages, KV, ps, hd]) at ``flat_slots`` [B, T] (page*ps + offset;
-    out-of-range = drop). Jit-compatible; vmapped over layers."""
+    out-of-range = drop). The pools are DONATED — like every other pool
+    update in the engine, XLA scatters in place instead of materializing
+    a second full-pool copy (which would double peak KV memory on pools
+    sized to fill HBM)."""
     from ..models.llama import _scatter_pages
 
     def per_layer(cache_layer, new):

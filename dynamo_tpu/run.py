@@ -47,6 +47,10 @@ def parse_args(argv=None):
         prog="dynamo-run", usage="%(prog)s in=<input> out=<engine> [flags]")
     ap.add_argument("io", nargs="*", help="in=… and out=… positionals")
     ap.add_argument("--model-path", help="local HF-style model directory")
+    ap.add_argument("--model-id", default=None,
+                    help="HuggingFace model id (or local path) — resolved "
+                         "cache-first via the HF hub (reference "
+                         "launch/dynamo-run/src/hub.rs)")
     ap.add_argument("--model-name", help="served model name")
     ap.add_argument("--model", default=None,
                     help="preset when no --model-path: tiny|1b|8b")
@@ -88,6 +92,12 @@ def parse_args(argv=None):
         "serving session into this directory (view with xprof/tensorboard)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+
+    if args.model_id and not args.model_path:
+        from .models.hub import resolve_model
+        args.model_path = resolve_model(args.model_id)
+        if not args.model_name:
+            args.model_name = args.model_id
 
     args.input, args.output = "http", "jax"
     for tok in args.io:
@@ -194,7 +204,7 @@ def build_engine(args) -> Tuple[object, object, bool]:
             from .parallel.mesh import MeshSpec
             mesh = MeshSpec(model=args.tensor_parallel_size,
                             seq=args.sequence_parallel_size).build()
-        if args.long_prefill_threshold:
+        if args.long_prefill_threshold is not None:
             if args.sequence_parallel_size <= 1:
                 raise SystemExit(
                     "--long-prefill-threshold needs "

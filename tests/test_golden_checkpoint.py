@@ -1,0 +1,110 @@
+"""Golden real-checkpoint validation: loader + model + engine vs
+`transformers` on an actual HF Llama checkpoint (generated locally with a
+fixed seed — fully offline; VERDICT r2 item 6: nothing previously proved
+the loader+engine reproduce transformers logits/tokens for a real
+checkpoint).
+
+Also covers the hub front door (models/hub.py resolve_model) for the
+local-directory case — the path `--model-id` takes on zero-egress hosts.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    """A tiny REAL Llama checkpoint written by transformers itself
+    (config.json + model.safetensors), plus the live HF model."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    tcfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        attention_bias=False, torch_dtype="float32")
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(tcfg).eval()
+    path = tmp_path_factory.mktemp("golden") / "ckpt"
+    model.save_pretrained(path, safe_serialization=True)
+    return str(path), model
+
+
+def test_hub_resolves_local_dir(hf_checkpoint):
+    from dynamo_tpu.models.hub import resolve_model
+
+    path, _ = hf_checkpoint
+    assert resolve_model(path) == path
+
+
+def test_loader_logits_match_transformers(hf_checkpoint):
+    """Full-attention forward on the loaded weights == transformers
+    logits (f32, tight tolerance), position by position."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.loader import load_params
+
+    path, hf = hf_checkpoint
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.num_layers == 2 and cfg.num_kv_heads == 2
+    params = load_params(path, cfg, dtype=jnp.float32)
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(1, 128, size=(2, 17)).astype(np.int32)
+    ours = np.asarray(llama.reference_forward(params, cfg,
+                                              jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_generation_matches_transformers_generate(hf_checkpoint,
+                                                         run_async):
+    """The SERVING path (paged prefill + pipelined fused-window decode)
+    greedy-generates exactly what transformers.generate does on the same
+    checkpoint — loader, paging, windowing, sampling all on the line."""
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.loader import load_params
+    from dynamo_tpu.runtime.engine import Context
+
+    path, hf = hf_checkpoint
+    cfg = ModelConfig.from_local_path(path)
+    params = load_params(path, cfg, dtype=jnp.float32)
+    N = 12
+    prompt = [(i * 11) % 120 + 1 for i in range(21)]
+    with torch.no_grad():
+        want = hf.generate(torch.tensor([prompt], dtype=torch.long),
+                           max_new_tokens=N, do_sample=False,
+                           pad_token_id=0)[0, len(prompt):].tolist()
+
+    ecfg = EngineConfig(page_size=4, num_pages=64, max_batch=4,
+                        prefill_chunk=16, prefill_buckets=(16,),
+                        batch_buckets=(4,), page_buckets=(16,),
+                        decode_steps=4)
+    engine = JaxEngine(cfg, ecfg, params=params)
+
+    async def gen():
+        req = PreprocessedRequest(
+            token_ids=list(prompt), sampling=SamplingOptions(),
+            stop=StopConditions(max_tokens=N, ignore_eos=True),
+            eos_token_ids=[])
+        toks = []
+        async for out in engine.generate(req, Context()):
+            toks.extend(out.token_ids)
+            if out.finish_reason:
+                break
+        await engine.stop()
+        return toks
+
+    got = run_async(gen())
+    assert got == want, f"engine {got} vs transformers {want}"
