@@ -1,0 +1,13 @@
+"""Kubernetes deployment plane: CRD rendering + reconcile controller.
+
+The reference ships a kubebuilder operator (deploy/dynamo/operator, Go:
+internal/controller/dynamodeployment_controller.go) that converges
+DynamoDeployment CRs into per-service Deployments/Services. This package is
+the same control loop in Python: `render` (the pure CR→manifests mapping),
+`KubeClient` (pluggable API transport: in-cluster REST or a test fake), and
+`Reconciler` (diff + create/patch/delete + status)."""
+
+from .controller import Reconciler
+from .render import render
+
+__all__ = ["Reconciler", "render"]

@@ -1,0 +1,133 @@
+"""Kubernetes API client: a minimal pluggable transport.
+
+The reconcile controller (controller.py) talks to the cluster through the
+four verbs below; tests inject an in-memory fake, production uses
+``InClusterClient`` — a dependency-free REST client over the pod's service
+account (the environment bakes no kubernetes client package, and the
+controller needs only a tiny slice of the API).
+
+Reference parity: the Go operator uses controller-runtime's cached client
+(deploy/dynamo/operator internal/controller); the verbs here are the same
+ones its Reconcile() bodies issue.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.request
+from typing import Any, Dict, List, Optional, Protocol
+
+# group/version/plural routing for the kinds the controller manages
+_ROUTES = {
+    "DynamoDeployment": ("apis/dynamo-tpu.dev/v1alpha1", "dynamodeployments"),
+    "Deployment": ("apis/apps/v1", "deployments"),
+    "Service": ("api/v1", "services"),
+    "ConfigMap": ("api/v1", "configmaps"),
+}
+
+
+class KubeClient(Protocol):
+    def list(self, kind: str, namespace: str,
+             label_selector: Optional[str] = None) -> List[Dict[str, Any]]:
+        ...
+
+    def get(self, kind: str, namespace: str,
+            name: str) -> Optional[Dict[str, Any]]:
+        ...
+
+    def create(self, kind: str, namespace: str,
+               obj: Dict[str, Any]) -> Dict[str, Any]:
+        ...
+
+    def replace(self, kind: str, namespace: str, name: str,
+                obj: Dict[str, Any]) -> Dict[str, Any]:
+        ...
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        ...
+
+    def update_status(self, kind: str, namespace: str, name: str,
+                      status: Dict[str, Any]) -> None:
+        ...
+
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class InClusterClient:
+    """Service-account REST client (stdlib only).
+
+    Speaks to https://$KUBERNETES_SERVICE_HOST with the mounted token +
+    cluster CA — the standard in-cluster path the Go operator's rest
+    config resolves to.
+    """
+
+    def __init__(self, host: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_path: Optional[str] = None):
+        self.base = host or (
+            f"https://{os.environ['KUBERNETES_SERVICE_HOST']}:"
+            f"{os.environ.get('KUBERNETES_SERVICE_PORT', '443')}")
+        # bound service-account tokens rotate on disk (~hourly); keep the
+        # PATH and re-read per request so the operator survives rotation
+        self._token = token
+        self._token_path = (None if token is not None
+                            else os.path.join(SA_DIR, "token"))
+        ctx = ssl.create_default_context(
+            cafile=ca_path or os.path.join(SA_DIR, "ca.crt"))
+        self._ctx = ctx
+
+    def _bearer(self) -> str:
+        if self._token_path is not None:
+            with open(self._token_path) as f:
+                return f.read().strip()
+        return self._token
+
+    def _req(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None) -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Authorization": f"Bearer {self._bearer()}",
+                     "Content-Type": "application/json",
+                     "Accept": "application/json"})
+        try:
+            with urllib.request.urlopen(req, context=self._ctx) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+
+    def _path(self, kind: str, namespace: str, name: str = "") -> str:
+        api, plural = _ROUTES[kind]
+        p = f"/{api}/namespaces/{namespace}/{plural}"
+        return f"{p}/{name}" if name else p
+
+    def list(self, kind, namespace, label_selector=None):
+        path = self._path(kind, namespace)
+        if label_selector:
+            path += f"?labelSelector={urllib.request.quote(label_selector)}"
+        res = self._req("GET", path)
+        return (res or {}).get("items", [])
+
+    def get(self, kind, namespace, name):
+        return self._req("GET", self._path(kind, namespace, name))
+
+    def create(self, kind, namespace, obj):
+        return self._req("POST", self._path(kind, namespace), obj)
+
+    def replace(self, kind, namespace, name, obj):
+        return self._req("PUT", self._path(kind, namespace, name), obj)
+
+    def delete(self, kind, namespace, name):
+        self._req("DELETE", self._path(kind, namespace, name))
+
+    def update_status(self, kind, namespace, name, status):
+        cur = self.get(kind, namespace, name)
+        if cur is None:
+            return
+        cur["status"] = status
+        self._req("PUT", self._path(kind, namespace, name) + "/status", cur)

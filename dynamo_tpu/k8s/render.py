@@ -1,0 +1,135 @@
+"""Render a DynamoDeployment spec into Kubernetes manifests.
+
+The reference ships a kubebuilder operator (deploy/dynamo/operator, Go)
+whose controllers expand a DynamoDeployment CR into per-service
+Deployments/Services. This renderer is that expansion as a pure,
+cluster-free function — usable as `kubectl apply -f <(python render.py
+deployment.yaml)`, as the core of a future in-cluster controller, and as a
+unit-testable spec of the mapping. TPU scheduling uses GKE's
+`google.com/tpu` resources + node selectors instead of the reference's
+GPU allocator env slicing.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List
+
+import yaml
+
+DCP_PORT = 6650
+
+
+def render(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """DynamoDeployment dict → list of k8s manifests."""
+    meta = spec.get("metadata", {})
+    name = meta.get("name", "dynamo")
+    ns = meta.get("namespace", "default")
+    s = spec["spec"]
+    image = s.get("image", "dynamo-tpu:latest")
+    graph = s["graph"]
+    config_yaml = s.get("configYaml", "")
+    out: List[Dict[str, Any]] = []
+
+    labels = {"app.kubernetes.io/part-of": name}
+
+    # control plane: one DCP server Deployment + Service
+    out.append({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": f"{name}-dcp", "namespace": ns,
+                     "labels": labels},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": f"{name}-dcp"}},
+            "template": {
+                "metadata": {"labels": {"app": f"{name}-dcp", **labels}},
+                "spec": {"containers": [{
+                    "name": "dcp", "image": image,
+                    "command": ["python", "-m", "dynamo_tpu", "dcp-server",
+                                "--host", "0.0.0.0", "--port",
+                                str(DCP_PORT)],
+                    "ports": [{"containerPort": DCP_PORT}],
+                    "env": [{"name": "JAX_PLATFORMS", "value": "cpu"}],
+                }]}}}})
+    out.append({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": f"{name}-dcp", "namespace": ns,
+                     "labels": labels},
+        "spec": {"selector": {"app": f"{name}-dcp"},
+                 "ports": [{"port": DCP_PORT}]}})
+
+    cfgmap_name = f"{name}-service-config"
+    out.append({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": cfgmap_name, "namespace": ns, "labels": labels},
+        "data": {"config.yaml": config_yaml}})
+
+    for svc_name, svc in (s.get("services") or {}).items():
+        slug = svc_name.lower()
+        tpu = svc.get("tpuAccelerator")
+        pod: Dict[str, Any] = {
+            "containers": [{
+                "name": slug, "image": image,
+                "command": ["python", "-m", "dynamo_tpu", "serve-worker",
+                            "--target", graph, "--service", svc_name],
+                "env": [
+                    {"name": "DYN_DCP_ADDRESS",
+                     "value": f"{name}-dcp.{ns}.svc:{DCP_PORT}"},
+                    {"name": "DYNAMO_SERVICE_CONFIG_FILE",
+                     "value": "/etc/dynamo/config.yaml"},
+                ],
+                "volumeMounts": [{"name": "svc-config",
+                                  "mountPath": "/etc/dynamo"}],
+                "resources": {"limits": dict(svc.get("resources") or {})},
+            }],
+            "volumes": [{"name": "svc-config",
+                         "configMap": {"name": cfgmap_name}}],
+        }
+        if tpu:
+            # GKE TPU scheduling: node selectors + google.com/tpu resource
+            pod["nodeSelector"] = {
+                "cloud.google.com/gke-tpu-accelerator": tpu,
+                "cloud.google.com/gke-tpu-topology":
+                    svc.get("tpuTopology", "1x1"),
+            }
+            pod["containers"][0]["resources"].setdefault(
+                "limits", {})
+            pod["containers"][0]["resources"]["limits"][
+                "google.com/tpu"] = svc.get("tpuChips", "1")
+        else:
+            pod["containers"][0]["env"].append(
+                {"name": "JAX_PLATFORMS", "value": "cpu"})
+        out.append({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": f"{name}-{slug}", "namespace": ns,
+                         "labels": labels},
+            "spec": {
+                "replicas": svc.get("replicas", 1),
+                "selector": {"matchLabels": {"app": f"{name}-{slug}"}},
+                "template": {
+                    "metadata": {"labels": {"app": f"{name}-{slug}",
+                                            **labels}},
+                    "spec": pod}}})
+        if svc.get("frontend"):
+            out.append({
+                "apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": f"{name}-{slug}", "namespace": ns,
+                             "labels": labels},
+                "spec": {"selector": {"app": f"{name}-{slug}"},
+                         "ports": [{"port": svc.get("port", 8080)}],
+                         "type": svc.get("serviceType", "ClusterIP")}})
+    return out
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print("usage: render.py <dynamodeployment.yaml>", file=sys.stderr)
+        return 2
+    with open(sys.argv[1]) as f:
+        spec = yaml.safe_load(f)
+    print(yaml.safe_dump_all(render(spec), sort_keys=False))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
