@@ -24,9 +24,9 @@ from ..runtime.component import INSTANCE_ROOT, EndpointInstance
 from ..runtime.dcp_client import pack, unpack
 from ..runtime.runtime import DistributedRuntime
 
-log = logging.getLogger("dynamo_tpu.admin")
+from .store import DEPLOYMENT_PREFIX
 
-DEPLOYMENT_PREFIX = "deployments/"
+log = logging.getLogger("dynamo_tpu.admin")
 
 
 class AdminApiServer:
@@ -41,6 +41,7 @@ class AdminApiServer:
         r.add_get("/api/v1/instances", self._instances)
         r.add_get("/api/v1/services", self._services)
         r.add_get("/api/v1/cards", self._cards)
+        r.add_get("/api/v1/planner/advisories", self._planner_advisories)
         r.add_get("/api/v1/deployments", self._deployments_list)
         r.add_post("/api/v1/deployments", self._deployments_put)
         r.add_get("/api/v1/deployments/{name}", self._deployments_get)
@@ -101,6 +102,11 @@ class AdminApiServer:
         items = await self.drt.dcp.kv_get_prefix(MDC_PREFIX)
         return web.json_response(
             {"cards": [unpack(i.value) for i in items]})
+
+    async def _planner_advisories(self, _req):
+        from ..planner import read_advisories
+        return web.json_response(
+            {"advisories": await read_advisories(self.drt.dcp)})
 
     async def _deployments_list(self, _req):
         items = await self.drt.dcp.kv_get_prefix(DEPLOYMENT_PREFIX)

@@ -1,0 +1,8 @@
+"""Shared key scheme for the deployment spec store.
+
+The admin API writes specs here (api_server.py) and the planner's
+``--apply`` path edits them (planner/planner.py); a single constant keeps
+the two components on the same keys.
+"""
+
+DEPLOYMENT_PREFIX = "deployments/"

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import os
 import ssl
+import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional, Protocol
 
@@ -97,7 +98,10 @@ class InClusterClient:
             with urllib.request.urlopen(req, context=self._ctx) as resp:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as exc:
-            if exc.code == 404:
+            # absent-object 404s are an expected answer only for reads and
+            # deletes; a 404 on POST/PUT (missing CRD, missing namespace,
+            # RBAC misroute) is a real failure that must surface
+            if exc.code == 404 and method in ("GET", "DELETE"):
                 return None
             raise
 

@@ -21,7 +21,7 @@ import json
 import hashlib
 import logging
 import time
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from .client import KubeClient
 from .render import render
@@ -52,6 +52,26 @@ def _owned_fields_drifted(want: Any, have: Any) -> bool:
             return True
         return any(_owned_fields_drifted(v, have.get(k))
                    for k, v in want.items())
+    if isinstance(want, list):
+        # element-wise, not atomic: the apiserver defaults fields INSIDE
+        # list items too (containers[].imagePullPolicy, ports[].protocol)
+        # and those additions must not read as drift. Extra elements the
+        # cluster added (admission-webhook sidecars) are tolerated for
+        # the same reason server-added dict keys are; missing ones are
+        # drift.
+        if not isinstance(have, list) or len(have) < len(want):
+            return True
+        if want and all(isinstance(w, dict) and "name" in w for w in want):
+            # named-element lists (containers, env, ports): match by name
+            # like server-side-apply, so a webhook PREPENDING an element
+            # doesn't misalign a positional comparison
+            by_name = {h.get("name"): h for h in have
+                       if isinstance(h, dict)}
+            return any(w["name"] not in by_name
+                       or _owned_fields_drifted(w, by_name[w["name"]])
+                       for w in want)
+        return any(_owned_fields_drifted(w, h)
+                   for w, h in zip(want, have))
     return want != have
 
 
@@ -62,14 +82,39 @@ class Reconciler:
     # ------------------------------------------------------------ converge
 
     def reconcile_all(self, namespace: str) -> None:
+        # list each managed kind ONCE per pass and partition by instance
+        # label — per-CR listing would cost 3N+1 apiserver calls per tick
+        observed_by_cr: Dict[str, Dict[Tuple[str, str], Dict[str, Any]]] = {}
+        for kind in ("Deployment", "Service", "ConfigMap"):
+            sel = f"app.kubernetes.io/managed-by={MANAGED_BY}"
+            for obj in self.client.list(kind, namespace,
+                                        label_selector=sel):
+                obj.setdefault("kind", kind)
+                inst = (obj.get("metadata", {}).get("labels", {})
+                        .get("app.kubernetes.io/instance"))
+                if inst is not None:
+                    observed_by_cr.setdefault(inst, {})[_key(obj)] = obj
         for cr in self.client.list("DynamoDeployment", namespace):
+            name = cr.get("metadata", {}).get("name")
             try:
-                self.reconcile(cr)
+                self.reconcile(cr, observed=observed_by_cr.get(name))
             except Exception:  # noqa: BLE001 — one bad CR must not wedge
-                log.exception("reconcile failed for %s",
-                              cr.get("metadata", {}).get("name"))
+                log.exception("reconcile failed for %s", name)
 
-    def reconcile(self, cr: Dict[str, Any]) -> None:
+    def _observe(self, ns: str, name: str
+                 ) -> Dict[Tuple[str, str], Dict[str, Any]]:
+        selector = (f"app.kubernetes.io/managed-by={MANAGED_BY},"
+                    f"app.kubernetes.io/instance={name}")
+        observed: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for kind in ("Deployment", "Service", "ConfigMap"):
+            for obj in self.client.list(kind, ns, label_selector=selector):
+                obj.setdefault("kind", kind)
+                observed[_key(obj)] = obj
+        return observed
+
+    def reconcile(self, cr: Dict[str, Any],
+                  observed: Optional[Dict[Tuple[str, str],
+                                          Dict[str, Any]]] = None) -> None:
         """Converge one DynamoDeployment toward its rendered manifests."""
         meta = cr["metadata"]
         name, ns = meta["name"], meta.get("namespace", "default")
@@ -92,13 +137,10 @@ class Reconciler:
             m.setdefault("annotations", {})[SPEC_HASH_ANN] = _spec_hash(obj)
             desired[_key(obj)] = obj
 
-        selector = (f"app.kubernetes.io/managed-by={MANAGED_BY},"
-                    f"app.kubernetes.io/instance={name}")
-        observed: Dict[Tuple[str, str], Dict[str, Any]] = {}
-        for kind in ("Deployment", "Service", "ConfigMap"):
-            for obj in self.client.list(kind, ns, label_selector=selector):
-                obj.setdefault("kind", kind)
-                observed[_key(obj)] = obj
+        if observed is None:
+            observed = self._observe(ns, name)
+        else:
+            observed = dict(observed)
 
         for key, want in desired.items():
             kind, oname = key
@@ -121,6 +163,15 @@ class Reconciler:
                 rv = have.get("metadata", {}).get("resourceVersion")
                 if rv is not None:
                     want["metadata"]["resourceVersion"] = rv
+                if kind == "Service":
+                    # carry over the server-allocated immutable fields: a
+                    # PUT without spec.clusterIP is rejected with 422
+                    # "field is immutable" by a real apiserver
+                    for f in ("clusterIP", "clusterIPs", "ipFamilies",
+                              "ipFamilyPolicy"):
+                        v = (have.get("spec") or {}).get(f)
+                        if v is not None and f not in want["spec"]:
+                            want["spec"][f] = v
                 log.info("replace %s/%s", kind, oname)
                 observed[key] = (self.client.replace(kind, ns, oname, want)
                                  or want)

@@ -1,0 +1,11 @@
+"""Planner — demand-driven scale advisories (reference
+docs/architecture.md:47 roadmap component, realized)."""
+
+from .planner import Planner, WatchTarget, read_advisories
+from .policy import (PLANNER_ADVISORY_SUBJECT, PLANNER_KV_PREFIX,
+                     ComponentSnapshot, PlannerConfig, ScaleAdvisory,
+                     decide)
+
+__all__ = ["Planner", "WatchTarget", "read_advisories",
+           "ComponentSnapshot", "PlannerConfig", "ScaleAdvisory", "decide",
+           "PLANNER_ADVISORY_SUBJECT", "PLANNER_KV_PREFIX"]

@@ -1,0 +1,3 @@
+from .planner import main
+
+raise SystemExit(main())

@@ -1,0 +1,238 @@
+"""Planner v0 — demand-driven scale advisories.
+
+Reference docs/architecture.md:47 describes the Planner as the component
+that "scales up and down [workers] based on demand"; the reference ships
+it as a roadmap box.  Here it is a real component: it scrapes the same
+ForwardPassMetrics plane the KV router costs on, reads the shared
+prefill-queue depth, runs the pure policy (policy.py), and
+
+  1. publishes every advisory on the event plane
+     (``<ns>.planner.advisory``) for anything to consume,
+  2. stores the latest advisory per component in KV
+     (``planner/advisories/<component>``) so the admin API can surface
+     it, and
+  3. (``apply=True``) edits the stored deployment spec's replica count
+     (``deployments/<name>``) — the K8s renderer/controller then
+     converge the cluster, closing the elastic loop end-to-end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..llm.kv_router.protocols import ForwardPassMetrics
+from ..runtime.component import Client
+from ..runtime.dcp_client import pack, unpack
+from ..runtime.runtime import DistributedRuntime
+from .policy import (PLANNER_ADVISORY_SUBJECT, PLANNER_KV_PREFIX,
+                     ComponentSnapshot, PlannerConfig, ScaleAdvisory, decide)
+
+from ..admin.store import DEPLOYMENT_PREFIX
+
+log = logging.getLogger("dynamo_tpu.planner")
+
+
+@dataclass
+class WatchTarget:
+    """One scaled pool the planner observes."""
+
+    component: str
+    endpoint: str = "generate_tokens"
+    queue: Optional[str] = None       # DCP work queue feeding this pool
+    deployment: Optional[str] = None  # stored deployment spec to edit
+    service: Optional[str] = None     # service key inside that spec
+    config: PlannerConfig = field(default_factory=PlannerConfig)
+
+
+class Planner:
+    def __init__(self, drt: DistributedRuntime, namespace: str = "dynamo",
+                 targets: Optional[List[WatchTarget]] = None,
+                 interval: float = 5.0, apply: bool = False,
+                 clock=time.monotonic):
+        self.drt = drt
+        self.namespace = namespace
+        self.targets = targets or []
+        self.interval = interval
+        self.apply = apply
+        self.clock = clock
+        self._clients: Dict[str, Client] = {}
+        self._last_up: Dict[str, float] = {}
+        self._last_down: Dict[str, float] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.advisories: List[ScaleAdvisory] = []   # emitted this lifetime
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        for t in self.targets:
+            self._clients[t.component] = await self.drt.namespace(
+                self.namespace).component(t.component).endpoint(
+                t.endpoint).client()
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            # wait the cancellation out before closing the clients the
+            # in-flight tick may still be using
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None
+        for c in self._clients.values():
+            await c.close()
+        self._clients.clear()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except Exception:
+                log.exception("planner tick failed")
+            await asyncio.sleep(self.interval)
+
+    # ----------------------------------------------------------------- tick
+
+    async def observe(self, t: WatchTarget) -> ComponentSnapshot:
+        stats = await self._clients[t.component].collect_stats()
+        metrics = {}
+        for wid, payload in stats.items():
+            metrics[wid] = ForwardPassMetrics.from_dict(
+                payload.get("data") or {})
+        depth = 0
+        if t.queue:
+            depth = await self.drt.dcp.queue_len(
+                f"{self.namespace}.{t.queue}")
+        return ComponentSnapshot(component=t.component, metrics=metrics,
+                                 queue_depth=depth)
+
+    async def tick(self) -> List[ScaleAdvisory]:
+        """One observe→decide→emit pass over all targets. Returns the
+        advisories emitted this tick (also accumulated on
+        ``self.advisories``)."""
+        now = self.clock()
+        out: List[ScaleAdvisory] = []
+        for t in self.targets:
+            snap = await self.observe(t)
+            adv = decide(
+                snap, t.config, now=now,
+                last_up_at=self._last_up.get(t.component, float("-inf")),
+                last_down_at=self._last_down.get(
+                    t.component, float("-inf")))
+            if adv is None:
+                continue
+            adv.at = time.time()   # wall time on the wire
+            if adv.direction == "up":
+                self._last_up[t.component] = now
+            elif adv.direction == "down":
+                self._last_down[t.component] = now
+            await self._emit(t, adv)
+            out.append(adv)
+            self.advisories.append(adv)
+        return out
+
+    async def _emit(self, t: WatchTarget, adv: ScaleAdvisory) -> None:
+        log.info("scale advisory %s: %d -> %d (%s)", adv.component,
+                 adv.current_replicas, adv.desired_replicas, adv.reason)
+        await self.drt.dcp.publish(
+            f"{self.namespace}.{PLANNER_ADVISORY_SUBJECT}",
+            pack(adv.to_dict()))
+        await self.drt.dcp.kv_put(
+            f"{PLANNER_KV_PREFIX}{adv.component}", pack(adv.to_dict()))
+        # never auto-apply a zero-observed advisory: n==0 is ambiguous
+        # between "scaled to zero" and "briefly unobservable" (rolling
+        # restart / scrape timeout), and shrinking a live deployment to
+        # min_replicas on a scrape blip would be destructive
+        if self.apply and t.deployment and adv.current_replicas > 0:
+            await self._apply(t, adv)
+
+    async def _apply(self, t: WatchTarget, adv: ScaleAdvisory,
+                     retries: int = 3) -> None:
+        """Edit the stored deployment spec so the K8s reconcile loop
+        (k8s/controller.py) converges replicas — planner decides,
+        controller actuates.  CAS on mod_rev so a concurrent admin-API
+        spec update (new image, config) is never silently reverted."""
+        key = f"{DEPLOYMENT_PREFIX}{t.deployment}"
+        for _ in range(retries):
+            item = await self.drt.dcp.kv_get_item(key)
+            if item is None:
+                log.warning("apply: stored deployment %r not found",
+                            t.deployment)
+                return
+            spec = unpack(item.value)
+            services = (spec.get("spec") or {}).get("services") or {}
+            svc_key = t.service or t.component
+            if svc_key not in services:
+                log.warning("apply: service %r not in deployment %r",
+                            svc_key, t.deployment)
+                return
+            services[svc_key]["replicas"] = adv.desired_replicas
+            if await self.drt.dcp.kv_cas(key, pack(spec), item.mod_rev):
+                log.info("applied: %s/%s replicas=%d", t.deployment,
+                         svc_key, adv.desired_replicas)
+                return
+        log.warning("apply: CAS conflict persisted for %r after %d tries",
+                    t.deployment, retries)
+
+
+async def read_advisories(dcp, limit: int = 64) -> List[dict]:
+    """Latest advisory per component, for the admin API."""
+    items = await dcp.kv_get_prefix(PLANNER_KV_PREFIX)
+    out = [unpack(i.value) for i in items]
+    out.sort(key=lambda d: -float(d.get("at", 0.0)))
+    return out[:limit]
+
+
+def main(argv=None) -> int:
+    """Standalone planner process.
+
+        python -m dynamo_tpu.planner --component decode \\
+            --queue prefill_queue --apply --deployment my-graph
+    """
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(prog="dynamo-planner")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", action="append", required=True,
+                    help="component pool to watch (repeatable)")
+    ap.add_argument("--endpoint", default="generate_tokens")
+    ap.add_argument("--queue", default=None,
+                    help="DCP work queue feeding the pool")
+    ap.add_argument("--deployment", default=None,
+                    help="stored deployment spec to edit with --apply")
+    ap.add_argument("--apply", action="store_true")
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=8)
+    ap.add_argument("--dcp", default=None)
+    args = ap.parse_args(argv)
+
+    cfg = PlannerConfig(min_replicas=args.min_replicas,
+                        max_replicas=args.max_replicas)
+    targets = [WatchTarget(component=c, endpoint=args.endpoint,
+                           queue=args.queue, deployment=args.deployment,
+                           config=cfg)
+               for c in args.component]
+
+    async def amain():
+        drt = await DistributedRuntime.attach(
+            args.dcp or os.environ.get("DYN_DCP_ADDRESS"))
+        planner = Planner(drt, args.namespace, targets,
+                          interval=args.interval, apply=args.apply)
+        await planner.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await planner.stop()
+            await drt.shutdown()
+
+    logging.basicConfig(level="INFO")
+    asyncio.run(amain())
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""Scale-decision policy — the pure core of the planner.
+
+Reference: the "Planner" box in docs/architecture.md:47 ("scales up and
+down [workers] based on demand") is a roadmap component there; this is
+our v0 realization.  The policy is deliberately a pure function of an
+observed snapshot + config + clock so it can be unit-tested exhaustively
+and reused by any driver (the async Planner component, a CLI dry-run, or
+a K8s controller hook).
+
+Signals (per watched component):
+  - ForwardPassMetrics scraped from each live worker (cache usage,
+    waiting requests) — the same snapshot the KV router costs on.
+  - Shared prefill-queue depth (disagg xPyD elasticity: the queue is the
+    natural backpressure signal for prefill workers,
+    docs/disagg_serving.md:93-100).
+
+Rules (classic utilization band + hysteresis):
+  - UP   when mean cache usage > high-water, or waiting/worker > cap,
+         or queue depth/worker > cap.  Step is proportional to overload.
+  - DOWN one replica at a time when everything is comfortably under the
+         low-water mark — and only after a (longer) cooldown.
+  - Cooldowns gate both directions so advisories cannot flap.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..llm.kv_router.protocols import ForwardPassMetrics
+
+PLANNER_ADVISORY_SUBJECT = "planner.advisory"   # published under <ns>.
+PLANNER_KV_PREFIX = "planner/advisories/"
+
+
+@dataclass
+class PlannerConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # utilization band on mean KV-cache usage
+    cache_high_water: float = 0.85
+    cache_low_water: float = 0.30
+    # request-pressure caps
+    waiting_per_worker_high: float = 2.0
+    queue_depth_per_worker_high: float = 4.0
+    # hysteresis
+    scale_up_cooldown_s: float = 30.0
+    scale_down_cooldown_s: float = 180.0
+
+    def clamp(self, n: int) -> int:
+        return max(self.min_replicas, min(self.max_replicas, n))
+
+
+@dataclass
+class ComponentSnapshot:
+    """What the planner observed for one component this tick."""
+
+    component: str
+    metrics: Dict[int, ForwardPassMetrics] = field(default_factory=dict)
+    queue_depth: int = 0          # shared work queue feeding this pool
+
+    @property
+    def replicas(self) -> int:
+        return len(self.metrics)
+
+    @property
+    def mean_cache_usage(self) -> float:
+        if not self.metrics:
+            return 0.0
+        return (sum(m.gpu_cache_usage_perc for m in self.metrics.values())
+                / len(self.metrics))
+
+    @property
+    def total_waiting(self) -> int:
+        return sum(m.num_requests_waiting for m in self.metrics.values())
+
+
+@dataclass
+class ScaleAdvisory:
+    """One scale decision, published on the event plane and stored in KV
+    for the admin API.  ``at`` is injected by the caller (wall time)."""
+
+    component: str
+    current_replicas: int
+    desired_replicas: int
+    reason: str
+    at: float = 0.0
+
+    @property
+    def direction(self) -> str:
+        if self.desired_replicas > self.current_replicas:
+            return "up"
+        if self.desired_replicas < self.current_replicas:
+            return "down"
+        return "hold"
+
+    def to_dict(self) -> dict:
+        return {"component": self.component,
+                "current_replicas": self.current_replicas,
+                "desired_replicas": self.desired_replicas,
+                "reason": self.reason, "at": self.at,
+                "direction": self.direction}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScaleAdvisory":
+        return cls(component=d["component"],
+                   current_replicas=int(d["current_replicas"]),
+                   desired_replicas=int(d["desired_replicas"]),
+                   reason=d["reason"], at=float(d.get("at", 0.0)))
+
+
+def decide(snap: ComponentSnapshot, cfg: PlannerConfig, *, now: float,
+           last_up_at: float = float("-inf"),
+           last_down_at: float = float("-inf")
+           ) -> Optional[ScaleAdvisory]:
+    """Return a scale advisory, or None when no change is warranted.
+
+    Pure: all state (snapshot, clock, last-action timestamps) is passed
+    in.  A component with zero live replicas yields an UP advisory to
+    ``min_replicas`` immediately (cold start / total failure beats
+    cooldown).
+    """
+    n = snap.replicas
+    if n == 0:
+        # cold start / total outage: advise min_replicas, but rate-limit
+        # by the up-cooldown so an unobservable pool doesn't republish
+        # every tick. NOTE: n==0 can also mean "pool briefly unreachable"
+        # (rolling restart, scrape timeout) — Planner._emit therefore
+        # never --applies this advisory, it only publishes it.
+        if cfg.min_replicas <= 0 or now - last_up_at < cfg.scale_up_cooldown_s:
+            return None
+        return ScaleAdvisory(snap.component, 0, cfg.min_replicas,
+                             "no live replicas", at=now)
+
+    usage = snap.mean_cache_usage
+    waiting_pw = snap.total_waiting / n
+    queue_pw = snap.queue_depth / n
+
+    # ---- scale up: any pressure signal over its cap -----------------
+    pressure = max(
+        usage / cfg.cache_high_water if cfg.cache_high_water > 0 else 0.0,
+        waiting_pw / cfg.waiting_per_worker_high
+        if cfg.waiting_per_worker_high > 0 else 0.0,
+        queue_pw / cfg.queue_depth_per_worker_high
+        if cfg.queue_depth_per_worker_high > 0 else 0.0,
+    )
+    if pressure > 1.0:
+        if now - last_up_at < cfg.scale_up_cooldown_s:
+            return None
+        # proportional: enough replicas to bring the worst signal back
+        # under its cap, never more than double per step
+        desired = cfg.clamp(min(2 * n, math.ceil(n * pressure)))
+        if desired > n:
+            reasons = []
+            if usage > cfg.cache_high_water:
+                reasons.append(f"cache usage {usage:.2f} > "
+                               f"{cfg.cache_high_water:.2f}")
+            if waiting_pw > cfg.waiting_per_worker_high:
+                reasons.append(f"waiting/worker {waiting_pw:.1f} > "
+                               f"{cfg.waiting_per_worker_high:.1f}")
+            if queue_pw > cfg.queue_depth_per_worker_high:
+                reasons.append(f"queue/worker {queue_pw:.1f} > "
+                               f"{cfg.queue_depth_per_worker_high:.1f}")
+            return ScaleAdvisory(snap.component, n, desired,
+                                 "; ".join(reasons), at=now)
+        return None
+
+    # ---- scale down: everything under the low-water mark ------------
+    if (usage < cfg.cache_low_water and snap.total_waiting == 0
+            and snap.queue_depth == 0 and n > cfg.min_replicas):
+        if now - last_down_at < cfg.scale_down_cooldown_s:
+            return None
+        # also respect the up-cooldown: don't shed a replica we just added
+        if now - last_up_at < cfg.scale_down_cooldown_s:
+            return None
+        return ScaleAdvisory(
+            snap.component, n, cfg.clamp(n - 1),
+            f"cache usage {usage:.2f} < {cfg.cache_low_water:.2f}, "
+            f"idle queue", at=now)
+
+    return None

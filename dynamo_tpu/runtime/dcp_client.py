@@ -27,6 +27,7 @@ class KvItem:
     key: str
     value: bytes
     lease: int = 0
+    mod_rev: int = 0
 
 
 @dataclass
@@ -183,9 +184,31 @@ class DcpClient:
         resp = await self._call("kv_get", key=key)
         return resp["value"] if resp.get("found") else None
 
+    async def kv_get_item(self, key: str) -> Optional[KvItem]:
+        """kv_get with metadata (mod_rev for CAS round-trips)."""
+        resp = await self._call("kv_get", key=key)
+        if not resp.get("found"):
+            return None
+        return KvItem(key, resp["value"], resp.get("lease", 0),
+                      resp.get("mod_rev", 0))
+
+    async def kv_cas(self, key: str, value: bytes, prev_rev: int,
+                     lease: int = 0) -> bool:
+        """Compare-and-swap: write only if the key's mod_rev still equals
+        ``prev_rev`` (0 = key must not exist).  Returns False on conflict
+        (reference etcd.rs transactional guard)."""
+        try:
+            await self._call("kv_put", key=key, value=value, lease=lease,
+                             prev_rev=prev_rev)
+            return True
+        except DcpError as e:
+            if "cas conflict" in str(e):
+                return False
+            raise
+
     async def kv_get_prefix(self, prefix: str) -> List[KvItem]:
         resp = await self._call("kv_get_prefix", prefix=prefix)
-        return [KvItem(i["key"], i["value"], i.get("lease", 0)) for i in resp["items"]]
+        return [KvItem(i["key"], i["value"], i.get("lease", 0), i.get("mod_rev", 0)) for i in resp["items"]]
 
     async def kv_delete(self, key: str) -> bool:
         return (await self._call("kv_delete", key=key))["deleted"]
@@ -202,7 +225,7 @@ class DcpClient:
         q: asyncio.Queue = asyncio.Queue()
         self._watch_queues[wid] = q
         resp = await self._call("watch_prefix", prefix=prefix, watch_id=wid)
-        items = [KvItem(i["key"], i["value"], i.get("lease", 0)) for i in resp["items"]]
+        items = [KvItem(i["key"], i["value"], i.get("lease", 0), i.get("mod_rev", 0)) for i in resp["items"]]
         return items, PrefixWatch(self, wid, q)
 
     # ------------------------------------------------------------- lease API

@@ -285,8 +285,16 @@ class DcpServer:
         key, value, lease = msg["key"], msg["value"], msg.get("lease", 0)
         if lease and lease not in self._leases:
             return {"ok": False, "error": f"no such lease {lease}"}
-        self._rev += 1
         prev = self._kv.get(key)
+        # compare-and-swap (reference etcd.rs txn: mod_revision guard):
+        # prev_rev=0 means "must not exist"
+        prev_rev = msg.get("prev_rev")
+        if prev_rev is not None:
+            have = prev.mod_rev if prev is not None else 0
+            if have != prev_rev:
+                return {"ok": False, "error": "cas conflict",
+                        "conflict": True, "mod_rev": have}
+        self._rev += 1
         self._kv[key] = _KvEntry(
             value=value, lease=lease,
             create_rev=prev.create_rev if prev else self._rev, mod_rev=self._rev)
@@ -305,12 +313,14 @@ class DcpServer:
         e = self._kv.get(msg["key"])
         if e is None:
             return {"found": False}
-        return {"found": True, "value": e.value, "lease": e.lease}
+        return {"found": True, "value": e.value, "lease": e.lease,
+                "mod_rev": e.mod_rev}
 
     async def _op_kv_get_prefix(self, conn, msg):
         p = msg["prefix"]
         items = [
-            {"key": k, "value": e.value, "lease": e.lease}
+            {"key": k, "value": e.value, "lease": e.lease,
+             "mod_rev": e.mod_rev}
             for k, e in sorted(self._kv.items()) if k.startswith(p)
         ]
         return {"items": items}

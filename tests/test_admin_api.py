@@ -58,6 +58,13 @@ def test_admin_api_crud(run_async):
                 out["dep_gone"] = r.status
             async with s.delete(f"{base}/api/v1/models/chat/m1") as r:
                 assert r.status == 200
+            from dynamo_tpu.planner.policy import PLANNER_KV_PREFIX
+            from dynamo_tpu.runtime.dcp_client import pack
+            await drt.dcp.kv_put(f"{PLANNER_KV_PREFIX}decode", pack(
+                {"component": "decode", "current_replicas": 1,
+                 "desired_replicas": 2, "reason": "test", "at": 1.0}))
+            async with s.get(f"{base}/api/v1/planner/advisories") as r:
+                out["advisories"] = await r.json()
         await srv.stop()
         await handle.stop()
         await drt.shutdown()
@@ -72,3 +79,4 @@ def test_admin_api_crud(run_async):
                out["services"]["services"])
     assert out["dep"]["spec"]["graph"].startswith("examples.")
     assert out["dep_gone"] == 404
+    assert out["advisories"]["advisories"][0]["component"] == "decode"

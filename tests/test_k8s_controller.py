@@ -159,12 +159,70 @@ def test_drift_heals_and_foreign_objects_untouched():
     rv_before = d["metadata"]["resourceVersion"]
     d["spec"]["strategy"] = {"type": "RollingUpdate"}  # server default
     d["status"] = {"observedGeneration": 1}
+    # ...including defaults added INSIDE list elements, where a real
+    # apiserver does most of its defaulting (containers[], ports[])
+    for c in d["spec"]["template"]["spec"]["containers"]:
+        c["imagePullPolicy"] = "IfNotPresent"
+        c["terminationMessagePath"] = "/dev/termination-log"
+        for p in c.get("ports", []):
+            p["protocol"] = "TCP"
     rec.reconcile_all(ns)
     assert (kube.get("Deployment", ns, "llama-disagg-router")
             ["metadata"]["resourceVersion"] == rv_before)
+    # but a real in-list edit (image override) IS drift and heals
+    d = kube.store[("Deployment", ns, "llama-disagg-router")]
+    orig_image = d["spec"]["template"]["spec"]["containers"][0]["image"]
+    d["spec"]["template"]["spec"]["containers"][0]["image"] = "evil:latest"
+    rec.reconcile_all(ns)
+    assert (kube.get("Deployment", ns, "llama-disagg-router")
+            ["spec"]["template"]["spec"]["containers"][0]["image"]
+            == orig_image)
 
     assert kube.get("Deployment", ns, "unrelated")["spec"]["replicas"] == 3
     assert ("Deployment", ns, "unrelated") not in kube.deleted
+
+
+def test_webhook_injected_sidecar_tolerated():
+    """A mutating webhook PREPENDING a container (vault-agent style) is a
+    server addition, not drift — named-element matching keeps the
+    positional comparison from misaligning and replace-fighting it."""
+    kube = FakeKube()
+    ns = "serving"
+    kube.create("DynamoDeployment", ns, example_cr())
+    rec = Reconciler(kube)
+    rec.reconcile_all(ns)
+
+    d = kube.store[("Deployment", ns, "llama-disagg-router")]
+    rv_before = d["metadata"]["resourceVersion"]
+    d["spec"]["template"]["spec"]["containers"].insert(0, {
+        "name": "istio-proxy", "image": "istio/proxyv2:1.20"})
+    rec.reconcile_all(ns)
+    after = kube.get("Deployment", ns, "llama-disagg-router")
+    assert after["metadata"]["resourceVersion"] == rv_before
+    assert after["spec"]["template"]["spec"]["containers"][0]["name"] \
+        == "istio-proxy"
+
+
+def test_service_replace_preserves_cluster_ip():
+    """A real apiserver 422-rejects a Service PUT that drops the
+    server-allocated spec.clusterIP; the controller must carry the
+    immutable fields over when healing drift."""
+    kube = FakeKube()
+    ns = "serving"
+    kube.create("DynamoDeployment", ns, example_cr())
+    rec = Reconciler(kube)
+    rec.reconcile_all(ns)
+
+    s = kube.store[("Service", ns, "llama-disagg-routedfrontend")]
+    s["spec"]["clusterIP"] = "10.0.0.42"           # server-allocated
+    s["spec"]["clusterIPs"] = ["10.0.0.42"]
+    s["metadata"]["annotations"]["dynamo-tpu.dev/spec-hash"] = "tampered"
+    rec.reconcile_all(ns)
+    healed = kube.get("Service", ns, "llama-disagg-routedfrontend")
+    assert (healed["metadata"]["annotations"]["dynamo-tpu.dev/spec-hash"]
+            != "tampered")
+    assert healed["spec"]["clusterIP"] == "10.0.0.42"
+    assert healed["spec"]["clusterIPs"] == ["10.0.0.42"]
 
 
 def test_cr_error_does_not_wedge_other_crs():

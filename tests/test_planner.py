@@ -1,0 +1,146 @@
+"""Planner v0: pure policy decisions + multi-worker advisory emission
+(reference docs/architecture.md:47 — the Planner roadmap component)."""
+
+import asyncio
+
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.metrics import MockWorker
+from dynamo_tpu.planner import (PLANNER_ADVISORY_SUBJECT, ComponentSnapshot,
+                                Planner, PlannerConfig, WatchTarget, decide,
+                                read_advisories)
+from dynamo_tpu.runtime.dcp_client import pack, unpack
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+
+def _snap(n, usage=0.5, waiting=0, queue=0):
+    metrics = {i: ForwardPassMetrics(gpu_cache_usage_perc=usage,
+                                     num_requests_waiting=waiting)
+               for i in range(n)}
+    return ComponentSnapshot("decode", metrics, queue_depth=queue)
+
+
+CFG = PlannerConfig(min_replicas=1, max_replicas=8,
+                    scale_up_cooldown_s=30.0, scale_down_cooldown_s=180.0)
+
+
+class TestPolicy:
+    def test_steady_state_holds(self):
+        assert decide(_snap(2, usage=0.5), CFG, now=0.0) is None
+
+    def test_cache_pressure_scales_up_proportionally(self):
+        adv = decide(_snap(2, usage=0.95), CFG, now=0.0)
+        assert adv is not None and adv.direction == "up"
+        # 0.95/0.85 ≈ 1.12 → ceil(2*1.12) = 3
+        assert adv.desired_replicas == 3
+        assert "cache usage" in adv.reason
+
+    def test_queue_depth_scales_up(self):
+        adv = decide(_snap(2, queue=20), CFG, now=0.0)
+        assert adv is not None and adv.direction == "up"
+        # queue/worker 10 vs cap 4 → pressure 2.5, capped at 2n
+        assert adv.desired_replicas == 4
+        assert "queue/worker" in adv.reason
+
+    def test_up_step_clamped_to_max(self):
+        cfg = PlannerConfig(max_replicas=3)
+        adv = decide(_snap(3, usage=0.99, waiting=50), cfg, now=0.0)
+        assert adv is None  # already at max → desired==current → hold
+
+    def test_up_cooldown_suppresses(self):
+        adv = decide(_snap(2, usage=0.95), CFG, now=10.0, last_up_at=0.0)
+        assert adv is None
+        adv = decide(_snap(2, usage=0.95), CFG, now=40.0, last_up_at=0.0)
+        assert adv is not None
+
+    def test_scale_down_requires_idle_and_cooldown(self):
+        # busy queue blocks down even at low usage
+        assert decide(_snap(4, usage=0.1, queue=1), CFG, now=1000.0) is None
+        adv = decide(_snap(4, usage=0.1), CFG, now=1000.0)
+        assert adv is not None and adv.direction == "down"
+        assert adv.desired_replicas == 3  # one at a time
+        # inside down-cooldown: hold
+        assert decide(_snap(3, usage=0.1), CFG, now=1010.0,
+                      last_down_at=1000.0) is None
+        # a recent up also blocks down (don't shed what we just added)
+        assert decide(_snap(3, usage=0.1), CFG, now=1000.0,
+                      last_up_at=900.0) is None
+
+    def test_never_below_min(self):
+        assert decide(_snap(1, usage=0.0), CFG, now=1000.0) is None
+
+    def test_zero_replicas_cold_start(self):
+        adv = decide(ComponentSnapshot("decode", {}), CFG, now=0.0)
+        assert adv is not None
+        assert adv.current_replicas == 0
+        assert adv.desired_replicas == CFG.min_replicas
+        # re-emission is rate-limited by the up-cooldown (no every-tick
+        # republish during an outage)
+        assert decide(ComponentSnapshot("decode", {}), CFG, now=5.0,
+                      last_up_at=0.0) is None
+
+
+def test_planner_emits_and_applies(run_async):
+    """Two live mock workers + a deep queue → UP advisory on the bus, in
+    KV, and applied to the stored deployment spec (the closed loop the
+    K8s controller converges)."""
+
+    async def scenario():
+        drt = await DistributedRuntime.detached()
+        # two workers in the pool: separate runtimes → separate instance
+        # ids on the stats plane
+        drt2 = await DistributedRuntime.attach(drt.dcp.address)
+        workers = [MockWorker(d, component="pool", seed=7,
+                              hit_rate_interval=9e9) for d in (drt, drt2)]
+        for w in workers:
+            await w.start()
+
+        # deep shared queue: 20 items over 2 workers >> cap 4
+        for i in range(20):
+            await drt.dcp.queue_put("dynamo.pq", pack({"i": i}))
+
+        # stored deployment spec the --apply path edits
+        spec = {"metadata": {"name": "graph"},
+                "spec": {"services": {"pool": {"replicas": 2}}}}
+        await drt.dcp.kv_put("deployments/graph", pack(spec))
+
+        heard = []
+
+        async def on_adv(msg):
+            heard.append(unpack(msg.payload))
+
+        await drt.dcp.subscribe(
+            f"dynamo.{PLANNER_ADVISORY_SUBJECT}", on_adv)
+
+        fake_now = [0.0]
+        planner = Planner(
+            drt, "dynamo",
+            [WatchTarget(component="pool", queue="pq",
+                         deployment="graph",
+                         config=PlannerConfig(max_replicas=8))],
+            apply=True, clock=lambda: fake_now[0])
+        await planner.start()
+        planner._task.cancel()  # drive ticks manually for determinism
+
+        advs = await planner.tick()
+        assert len(advs) == 1 and advs[0].direction == "up"
+        # cooldown: immediate second tick emits nothing
+        fake_now[0] = 5.0
+        assert await planner.tick() == []
+
+        await asyncio.sleep(0.1)  # let the pub-sub fanout land
+        stored = await read_advisories(drt.dcp)
+        new_spec = unpack(await drt.dcp.kv_get("deployments/graph"))
+
+        await planner.stop()
+        for w in workers:
+            await w.stop()
+        await drt2.shutdown()
+        await drt.shutdown()
+        return advs, heard, stored, new_spec
+
+    advs, heard, stored, new_spec = run_async(scenario())
+    adv = advs[0]
+    assert adv.current_replicas == 2 and adv.desired_replicas == 4
+    assert heard and heard[0]["component"] == "pool"
+    assert stored and stored[0]["desired_replicas"] == 4
+    assert new_spec["spec"]["services"]["pool"]["replicas"] == 4

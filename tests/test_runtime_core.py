@@ -80,6 +80,40 @@ def test_kv_lease_watch(run_async):
     run_async(main())
 
 
+def test_kv_compare_and_swap(run_async):
+    """mod_rev-guarded writes (reference etcd.rs transactional guard):
+    a concurrent writer makes the stale CAS fail instead of silently
+    reverting the other write."""
+
+    async def main():
+        server = await DcpServer.start()
+        c1 = await DcpClient.connect(server.address)
+        c2 = await DcpClient.connect(server.address)
+
+        await c1.kv_put("spec/x", b"v1")
+        item = await c1.kv_get_item("spec/x")
+        assert item is not None and item.mod_rev > 0
+
+        # concurrent writer bumps the revision
+        await c2.kv_put("spec/x", b"v2-concurrent")
+        # stale CAS must fail and leave the concurrent write intact
+        assert await c1.kv_cas("spec/x", b"v3-stale", item.mod_rev) is False
+        assert await c1.kv_get("spec/x") == b"v2-concurrent"
+        # fresh CAS succeeds
+        item = await c1.kv_get_item("spec/x")
+        assert await c1.kv_cas("spec/x", b"v3", item.mod_rev) is True
+        assert await c1.kv_get("spec/x") == b"v3"
+        # prev_rev=0 = create-if-absent semantics
+        assert await c1.kv_cas("spec/x", b"v4", 0) is False
+        assert await c1.kv_cas("spec/new", b"v1", 0) is True
+
+        await c1.close()
+        await c2.close()
+        await server.stop()
+
+    run_async(main())
+
+
 def test_pubsub_and_request_reply(run_async):
     async def main():
         server = await DcpServer.start()
