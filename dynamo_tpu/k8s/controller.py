@@ -59,19 +59,24 @@ def _owned_fields_drifted(want: Any, have: Any) -> bool:
         # cluster added (admission-webhook sidecars) are tolerated for
         # the same reason server-added dict keys are; missing ones are
         # drift.
-        if not isinstance(have, list) or len(have) < len(want):
+        if not isinstance(have, list):
             return True
         if want and all(isinstance(w, dict) and "name" in w for w in want):
             # named-element lists (containers, env, ports): match by name
-            # like server-side-apply, so a webhook PREPENDING an element
-            # doesn't misalign a positional comparison
+            # like server-side-apply, so a webhook PRE/APPENDING an
+            # element (injected sidecar) doesn't misalign the comparison
+            # or read as drift
             by_name = {h.get("name"): h for h in have
                        if isinstance(h, dict)}
             return any(w["name"] not in by_name
                        or _owned_fields_drifted(w, by_name[w["name"]])
                        for w in want)
-        return any(_owned_fields_drifted(w, h)
-                   for w, h in zip(want, have))
+        # scalar/unnamed lists (args, command): the server never appends
+        # to these, so any length change — including a kubectl-edit that
+        # appends a flag — is drift to heal
+        return (len(want) != len(have)
+                or any(_owned_fields_drifted(w, h)
+                       for w, h in zip(want, have)))
     return want != have
 
 

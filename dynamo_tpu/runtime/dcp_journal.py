@@ -1,0 +1,197 @@
+"""Durability for the control-plane service: snapshot + append-only journal.
+
+The reference delegates durability to its infra services — etcd is
+raft-replicated and NATS JetStream persists the prefill work queue
+(reference deploy/docker-compose.yml:16-31, examples/llm/utils/
+nats_queue.py). Our single-process DCP server needs its own story:
+this module gives it a write-ahead journal with periodic snapshot
+compaction, so a restart replays to the exact pre-crash KV + queue
+state.
+
+What is durable and what is deliberately NOT:
+
+- **Unleased KV** (model registry, deployment specs, planner advisories,
+  router config): durable.
+- **Work queues** (disagg prefill queue): durable — every append and
+  every pop is journaled, so a crash between put and pull loses nothing
+  and double-delivers nothing.
+- **Leases + lease-attached keys** (endpoint instances, service records):
+  ephemeral BY DESIGN. A lease exists to say "this worker is alive right
+  now"; the restarted server has no live keep-alive sessions, so
+  restoring leased keys would resurrect dead instances and the discovery
+  plane would route to ghosts. Workers re-register on reconnect — the
+  same behavior etcd gives the reference when a lease outlives nobody.
+- **Watches / subscriptions / in-flight requests**: connection state,
+  gone with the connections; clients re-establish.
+
+File layout: ``<path>.snap`` (one msgpack map: rev + kv + queues) and
+``<path>.log`` (length-prefixed msgpack frames, one per mutation).
+Recovery = load snapshot, replay log. Compaction = write new snapshot,
+truncate log; triggered when the log exceeds ``max_log_bytes``.
+
+Writes are flushed to the OS on every record (survives process death,
+e.g. SIGKILL); ``fsync=True`` additionally fsyncs (survives machine
+crash) at a heavy per-op cost — the docker-compose single-node etcd the
+reference ships makes the same flush-vs-fsync tradeoff by default.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+import msgpack
+
+log = logging.getLogger("dynamo_tpu.dcp.journal")
+
+
+class Journal:
+    """Append-only mutation log + snapshot for DcpServer state."""
+
+    def __init__(self, path: str, *, max_log_bytes: int = 4 * 1024 * 1024,
+                 fsync: bool = False):
+        self.snap_path = path + ".snap"
+        self.log_path = path + ".log"
+        self.max_log_bytes = max_log_bytes
+        self.fsync = fsync
+        self._f = None  # open log file handle (append mode)
+        self._bytes = 0
+
+    # ------------------------------------------------------------- recovery
+
+    def recover(self) -> Tuple[int, Dict[str, Tuple[bytes, int, int]],
+                               Dict[str, deque]]:
+        """Load snapshot + replay log.
+
+        Returns ``(rev, kv, queues)`` where ``kv`` maps key ->
+        (value, create_rev, mod_rev) for unleased entries and ``queues``
+        maps name -> deque of payloads.
+        """
+        rev = 0
+        kv: Dict[str, Tuple[bytes, int, int]] = {}
+        queues: Dict[str, deque] = {}
+
+        if os.path.exists(self.snap_path):
+            with open(self.snap_path, "rb") as f:
+                snap = msgpack.unpackb(f.read(), raw=False)
+            rev = snap["rev"]
+            for k, v, cr, mr in snap["kv"]:
+                kv[k] = (v, cr, mr)
+            for name, items in snap["queues"].items():
+                queues[name] = deque(items)
+
+        if os.path.exists(self.log_path):
+            replayed = truncated = 0
+            with open(self.log_path, "rb") as f:
+                buf = f.read()
+            off = 0
+            while off + 4 <= len(buf):
+                n = int.from_bytes(buf[off:off + 4], "big")
+                if off + 4 + n > len(buf):
+                    truncated = len(buf) - off  # torn tail write: drop it
+                    break
+                rec = msgpack.unpackb(buf[off + 4:off + 4 + n], raw=False)
+                off += 4 + n
+                replayed += 1
+                t = rec["t"]
+                if t == "put":
+                    kv[rec["k"]] = (rec["v"], rec["cr"], rec["mr"])
+                    rev = max(rev, rec["mr"])
+                elif t == "del":
+                    kv.pop(rec["k"], None)
+                elif t == "qput":
+                    queues.setdefault(rec["q"], deque()).append(rec["p"])
+                elif t == "qpop":
+                    q = queues.get(rec["q"])
+                    if q:
+                        q.popleft()
+                elif t == "rev":
+                    rev = max(rev, rec["r"])
+            if truncated:
+                log.warning("journal: dropped %d-byte torn tail", truncated)
+            log.info("journal: recovered rev=%d kv=%d queues=%d "
+                     "(replayed %d records)", rev, len(kv),
+                     sum(map(len, queues.values())), replayed)
+        return rev, kv, queues
+
+    # -------------------------------------------------------------- writing
+
+    def open(self) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(self.log_path)),
+                    exist_ok=True)
+        self._f = open(self.log_path, "ab")
+        self._bytes = self._f.tell()
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None
+
+    def _append(self, rec: dict) -> None:
+        body = msgpack.packb(rec, use_bin_type=True)
+        self._f.write(len(body).to_bytes(4, "big") + body)
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self._bytes += 4 + len(body)
+
+    def record_put(self, key: str, value: bytes, create_rev: int,
+                   mod_rev: int) -> None:
+        self._append({"t": "put", "k": key, "v": value,
+                      "cr": create_rev, "mr": mod_rev})
+
+    def record_delete(self, key: str) -> None:
+        self._append({"t": "del", "k": key})
+
+    def record_qput(self, queue: str, payload: bytes) -> None:
+        self._append({"t": "qput", "q": queue, "p": payload})
+
+    def record_qpop(self, queue: str) -> None:
+        self._append({"t": "qpop", "q": queue})
+
+    def record_rev(self, rev: int) -> None:
+        """Persist a revision bump that has no durable payload (leased
+        puts): recovery must never re-issue a pre-crash mod_rev, or stale
+        CAS tokens captured before the crash could alias new writes."""
+        self._append({"t": "rev", "r": rev})
+
+    @property
+    def log_size(self) -> int:
+        return getattr(self, "_bytes", 0)
+
+    # ----------------------------------------------------------- compaction
+
+    def maybe_compact(self, rev: int,
+                      kv: Dict[str, Tuple[bytes, int, int]],
+                      queues: Dict[str, deque]) -> bool:
+        """Snapshot current state + truncate the log when it has grown
+        past ``max_log_bytes``. Crash-safe: the snapshot is written to a
+        temp file and atomically renamed BEFORE the log is truncated, so
+        every instant has (old snap + full log) or (new snap + empty
+        log)."""
+        if self.log_size < self.max_log_bytes:
+            return False
+        self.snapshot(rev, kv, queues)
+        return True
+
+    def snapshot(self, rev: int, kv: Dict[str, Tuple[bytes, int, int]],
+                 queues: Dict[str, deque]) -> None:
+        snap = {
+            "rev": rev,
+            "kv": [[k, v, cr, mr] for k, (v, cr, mr) in kv.items()],
+            "queues": {name: list(items) for name, items in queues.items()
+                       if items},
+        }
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(snap, use_bin_type=True))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        # now the log's contents are all reflected in the snapshot
+        if self._f:
+            self._f.truncate(0)
+            self._bytes = 0
+        log.info("journal: compacted (snapshot rev=%d kv=%d)", rev, len(kv))

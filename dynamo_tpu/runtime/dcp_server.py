@@ -31,6 +31,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import signal
 import time
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
@@ -173,20 +174,46 @@ class DcpServer:
         self._queue_waiters: Dict[str, deque] = defaultdict(deque)
         self._server: Optional[asyncio.AbstractServer] = None
         self._lease_task: Optional[asyncio.Task] = None
+        self._journal = None  # Optional[Journal] — durability (dcp_journal.py)
         self.port: int = 0
         self.host: str = ""
 
     # ------------------------------------------------------------- lifecycle
 
     @classmethod
-    async def start(cls, host: str = "127.0.0.1", port: int = 0) -> "DcpServer":
+    async def start(cls, host: str = "127.0.0.1", port: int = 0,
+                    journal_path: Optional[str] = None) -> "DcpServer":
         self = cls()
+        if journal_path:
+            from .dcp_journal import Journal
+
+            self._journal = Journal(journal_path)
+            rev, kv, queues = self._journal.recover()
+            self._rev = rev
+            for k, (v, cr, mr) in kv.items():
+                self._kv[k] = _KvEntry(value=v, create_rev=cr, mod_rev=mr)
+            for name, items in queues.items():
+                self._queues[name] = items
+            self._journal.open()
         self._server = await asyncio.start_server(self._on_conn, host, port)
         sock = self._server.sockets[0]
         self.host, self.port = sock.getsockname()[:2]
         self._lease_task = asyncio.create_task(self._lease_reaper())
         log.info("dcp server listening on %s:%d", self.host, self.port)
         return self
+
+    def _durable_kv(self) -> Dict[str, Tuple[bytes, int, int]]:
+        """Unleased entries only — leased keys are ephemeral by design
+        (see dcp_journal.py module docstring)."""
+        return {k: (e.value, e.create_rev, e.mod_rev)
+                for k, e in self._kv.items() if not e.lease}
+
+    def _journal_compact_check(self) -> None:
+        # size-gate BEFORE materializing the snapshot dict: _durable_kv()
+        # is O(total keys) and this runs on every journaled mutation
+        j = self._journal
+        if j is not None and j.log_size >= j.max_log_bytes:
+            j.snapshot(self._rev, self._durable_kv(), self._queues)
 
     async def stop(self) -> None:
         if self._lease_task:
@@ -202,6 +229,11 @@ class DcpServer:
                 await asyncio.wait_for(self._server.wait_closed(), 5.0)
             except asyncio.TimeoutError:
                 log.warning("dcp server wait_closed timed out")
+        if self._journal is not None:
+            # graceful exit: compact so restart recovery is snapshot-only
+            self._journal.snapshot(self._rev, self._durable_kv(),
+                                   self._queues)
+            self._journal.close()
 
     @property
     def address(self) -> str:
@@ -295,11 +327,26 @@ class DcpServer:
                 return {"ok": False, "error": "cas conflict",
                         "conflict": True, "mod_rev": have}
         self._rev += 1
-        self._kv[key] = _KvEntry(
+        entry = _KvEntry(
             value=value, lease=lease,
             create_rev=prev.create_rev if prev else self._rev, mod_rev=self._rev)
+        self._kv[key] = entry
         if lease:
             self._leases[lease].keys.add(key)
+        if self._journal is not None:
+            if not lease:
+                self._journal.record_put(key, value, entry.create_rev,
+                                         entry.mod_rev)
+            else:
+                # leased puts still bump _rev; persist the counter so a
+                # recovered server can't re-issue a pre-crash mod_rev
+                # (stale CAS tokens must keep failing after restart)
+                self._journal.record_rev(self._rev)
+                if prev is not None and not prev.lease:
+                    # a leased write over a durable key: the durable value
+                    # is gone; without this it would resurrect on replay
+                    self._journal.record_delete(key)
+            self._journal_compact_check()
         self._notify_watchers("put", key, value)
         return {"rev": self._rev}
 
@@ -331,6 +378,9 @@ class DcpServer:
         if e is not None:
             if e.lease in self._leases:
                 self._leases[e.lease].keys.discard(key)
+            if self._journal is not None and not e.lease:
+                self._journal.record_delete(key)
+                self._journal_compact_check()
             self._notify_watchers("delete", key, None)
         return {"deleted": e is not None}
 
@@ -341,14 +391,18 @@ class DcpServer:
             e = self._kv.pop(k)
             if e.lease in self._leases:
                 self._leases[e.lease].keys.discard(k)
+            if self._journal is not None and not e.lease:
+                self._journal.record_delete(k)
             self._notify_watchers("delete", k, None)
+        self._journal_compact_check()
         return {"deleted": len(keys)}
 
     async def _op_watch_prefix(self, conn, msg):
         w = _Watch(conn, msg["watch_id"], msg["prefix"])
         self._watches[(conn.id, w.watch_id)] = w
         items = [
-            {"key": k, "value": e.value, "lease": e.lease}
+            {"key": k, "value": e.value, "lease": e.lease,
+             "mod_rev": e.mod_rev}
             for k, e in sorted(self._kv.items()) if k.startswith(w.prefix)
         ]
         return {"items": items}
@@ -481,9 +535,14 @@ class DcpServer:
         while waiters:
             _c, fut = waiters.popleft()
             if not fut.done():
+                # direct handoff to a blocked puller: the item never
+                # enters the queue, so there is nothing to journal
                 fut.set_result(payload)
                 return {"queued": 0}
         self._queues[qname].append(payload)
+        if self._journal is not None:
+            self._journal.record_qput(qname, payload)
+            self._journal_compact_check()
         return {"queued": len(self._queues[qname])}
 
     async def _op_q_pull(self, conn, msg):
@@ -491,7 +550,11 @@ class DcpServer:
         timeout = msg.get("timeout_ms", 0) / 1000.0
         q = self._queues[qname]
         if q:
-            return {"found": True, "payload": q.popleft()}
+            payload = q.popleft()
+            if self._journal is not None:
+                self._journal.record_qpop(qname)
+                self._journal_compact_check()
+            return {"found": True, "payload": payload}
         if timeout <= 0:
             return {"found": False}
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -509,10 +572,20 @@ class DcpServer:
         return {"pong": True, "time": time.time()}
 
 
-async def _amain(host: str, port: int) -> None:
-    server = await DcpServer.start(host, port)
+async def _amain(host: str, port: int,
+                 journal: Optional[str] = None) -> None:
+    server = await DcpServer.start(host, port, journal_path=journal)
     print(f"dcp listening on {server.address}", flush=True)
-    await asyncio.Event().wait()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        # graceful shutdown writes the compaction snapshot; SIGKILL is
+        # the crash path the journal replay covers
+        loop.add_signal_handler(sig, stop.set)
+    try:
+        await stop.wait()
+    finally:
+        await server.stop()
 
 
 def main(argv=None) -> int:
@@ -521,9 +594,15 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="dynamo-tpu control-plane service")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6650)
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="durability journal path prefix (creates "
+                         "PATH.snap + PATH.log); omit for in-memory only")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    asyncio.run(_amain(args.host, args.port))
+    try:
+        asyncio.run(_amain(args.host, args.port, args.journal))
+    except KeyboardInterrupt:
+        pass
     return 0
 
 

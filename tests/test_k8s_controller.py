@@ -202,6 +202,20 @@ def test_webhook_injected_sidecar_tolerated():
     assert after["spec"]["template"]["spec"]["containers"][0]["name"] \
         == "istio-proxy"
 
+    # but appending to an OWNED scalar list (a rendered command flag) is
+    # an edit to heal — the extra-element tolerance is only for
+    # named-element lists
+    d = kube.store[("Deployment", ns, "llama-disagg-router")]
+    dyn_c = [c for c in d["spec"]["template"]["spec"]["containers"]
+             if c["name"] != "istio-proxy"][0]
+    n_cmd = len(dyn_c["command"])
+    dyn_c["command"].append("--insecure")
+    rec.reconcile_all(ns)
+    healed = kube.get("Deployment", ns, "llama-disagg-router")
+    healed_c = [c for c in healed["spec"]["template"]["spec"]["containers"]
+                if c["name"] != "istio-proxy"][0]
+    assert len(healed_c["command"]) == n_cmd
+
 
 def test_service_replace_preserves_cluster_ip():
     """A real apiserver 422-rejects a Service PUT that drops the
