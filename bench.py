@@ -46,6 +46,9 @@ def parse_args():
                          "north-star, reference docs/architecture.md:57-61)")
     ap.add_argument("--disagg-threshold", type=int, default=256,
                     help="max local prefill length for the disagg router")
+    ap.add_argument("--prefill-token-budget", type=int, default=None,
+                    help="chunked-prefill mixing: cap prefill tokens per "
+                         "iteration, interleave decode windows")
     ap.add_argument("--host-pages", type=int, default=0,
                     help="host-DRAM offload tier size (multiturn scenario)")
     ap.add_argument("--users", type=int, default=16)
@@ -86,6 +89,8 @@ def build_engine(args):
     if args.max_batch:
         ecfg.max_batch = args.max_batch
         ecfg.batch_buckets = (8, args.max_batch)
+    if args.prefill_token_budget is not None:
+        ecfg.prefill_token_budget = args.prefill_token_budget
     if args.scenario == "multiturn":
         # size the HBM pool BELOW the conversation working set so turns
         # evict each other; the host tier is what keeps TTFT low

@@ -80,6 +80,15 @@ class EngineConfig:
     # (measured: interleaving a K-step window between every prefill batch
     # doubles TTFT and costs throughput by delaying batch build-up)
     prefill_priority: bool = True
+    # token-budgeted chunked-prefill mixing (the vLLM-style middle ground
+    # between the two all-or-nothing policies above): when set, every
+    # iteration dispatches BOTH a decode window and a prefill batch, but
+    # the prefill batch is trimmed to at most this many prompt tokens, so
+    # a burst of long prompts cannot starve running decodes (ITL p99
+    # bounded by window + budget-prefill time instead of the full burst
+    # drain). None keeps pure prefill-priority. Overrides prefill_priority
+    # when set.
+    prefill_token_budget: Optional[int] = None
     # on-device stop table width (eos_token_ids + stop_token_ids rows,
     # padded with -1); requests with more ids fall back to the (lagging
     # but correct) host-side check
@@ -291,6 +300,9 @@ class JaxEngine:
         # observability (ForwardPassMetrics analog, kv_router/protocols.rs)
         self.steps = 0
         self.prefill_tokens_total = 0
+        # iterations where a decode window dispatched WHILE prompts were
+        # still prefilling — the observable for budgeted mixing
+        self.mixed_dispatches = 0
         self.decode_tokens_total = 0
         self.prefix_hit_tokens_total = 0
         self.prompt_tokens_total = 0
@@ -489,20 +501,29 @@ class JaxEngine:
         prefill-priority ordering."""
         self._drain_kv_tier()
         if self.ecfg.decode_steps <= 1:
-            # single-step decode: fully synchronous, prefill-priority
+            # single-step decode: fully synchronous; budgeted mixing
+            # interleaves a decode step behind the trimmed prefill batch
+            budget = self.ecfg.prefill_token_budget
             if self.prefilling:
-                pf = self._dispatch_prefill()
+                pf = self._dispatch_prefill(budget)
                 if pf is not None:
                     self._process_prefill(pf)
-            elif self.running:
+            if self.running and (budget is not None
+                                 or not self.prefilling):
+                if budget is not None and self.prefilling:
+                    self.mixed_dispatches += 1
                 self._decode_step_single()
             return
         if not self.ecfg.pipeline_decode:
+            budget = self.ecfg.prefill_token_budget
             if self.prefilling:
-                pf = self._dispatch_prefill()
+                pf = self._dispatch_prefill(budget)
                 if pf is not None:
                     self._process_prefill(pf)
-            elif self.running:
+            if self.running and (budget is not None
+                                 or not self.prefilling):
+                if budget is not None and self.prefilling:
+                    self.mixed_dispatches += 1
                 pend = self._dispatch_decode_window()
                 if pend is not None:
                     self._process_window(pend)
@@ -510,11 +531,17 @@ class JaxEngine:
             return
         prev = self._pending
         prev_pf = self._pending_prefill
-        if self.ecfg.prefill_priority and self.prefilling:
+        budget = self.ecfg.prefill_token_budget
+        if (budget is None and self.ecfg.prefill_priority
+                and self.prefilling):
             self._pending = None
         else:
+            # budgeted mixing (or prefill_priority off): decode windows
+            # keep their cadence even while prompts are prefilling
             self._pending = self._dispatch_decode_window()
-        self._pending_prefill = self._dispatch_prefill()
+            if self._pending is not None and self.prefilling:
+                self.mixed_dispatches += 1
+        self._pending_prefill = self._dispatch_prefill(budget)
         if prev is not None:
             self._process_window(prev)
         if prev_pf is not None:
@@ -636,7 +663,8 @@ class JaxEngine:
 
     # ------------------------------------------------------------- prefill
 
-    def _dispatch_prefill(self) -> Optional[_PendingPrefill]:
+    def _dispatch_prefill(self, token_budget: Optional[int] = None
+                          ) -> Optional[_PendingPrefill]:
         """Enqueue one chunked-prefill step over a BATCH of prefilling
         sequences (each contributes its next chunk) WITHOUT reading back.
         Batching prompts into one dispatch matters as much as the decode
@@ -686,6 +714,21 @@ class JaxEngine:
         batch += [s for s in candidates[1:]
                   if id(s) not in picked and tbucket(s) < hb]
         batch = batch[: self.ecfg.max_prefill_batch]
+        if token_budget is not None:
+            # budgeted mixing: trim the batch to ~token_budget prompt
+            # tokens. The head always ships (its chunk may alone exceed a
+            # small budget — per-iteration prefill is then bounded by
+            # max(prefill_chunk, budget), keeping chunk starts page-aligned
+            # rather than slicing mid-chunk)
+            kept, total = [], 0
+            for s in batch:
+                c = min(s.prefill_extent - s.computed,
+                        self.ecfg.prefill_chunk)
+                if kept and total + c > token_budget:
+                    break
+                kept.append(s)
+                total += c
+            batch = kept
 
         chunks = [min(s.prefill_extent - s.computed, self.ecfg.prefill_chunk)
                   for s in batch]

@@ -70,6 +70,11 @@ def parse_args(argv=None):
     ap.add_argument("--sequence-parallel-size", type=int, default=1,
                     help="seq-axis mesh size for ring-attention long "
                          "prefill (long-context serving)")
+    ap.add_argument("--prefill-token-budget", type=int, default=None,
+                    help="cap prompt tokens prefilled per engine "
+                         "iteration and interleave decode windows "
+                         "(chunked-prefill mixing; bounds ITL p99 under "
+                         "prompt bursts at some TTFT cost)")
     ap.add_argument("--long-prefill-threshold", type=int, default=None,
                     help="prompts longer than this take the sequence-"
                          "parallel ring prefill (needs "
@@ -185,6 +190,8 @@ def build_engine(args) -> Tuple[object, object, bool]:
             overrides["num_pages"] = args.num_pages
         if args.max_batch_size:
             overrides["max_batch"] = args.max_batch_size
+        if args.prefill_token_budget is not None:
+            overrides["prefill_token_budget"] = args.prefill_token_budget
         if overrides:
             # replace() re-runs __post_init__ — CLI overrides get the same
             # validation as direct construction

@@ -58,6 +58,14 @@ class Journal:
         self.fsync = fsync
         self._f = None  # open log file handle (append mode)
         self._bytes = 0
+        # monotone record sequence: every appended record carries one and
+        # the snapshot stamps the last it covers, so replay after a crash
+        # BETWEEN snapshot-rename and log-truncate skips the already-
+        # snapshotted prefix instead of double-applying it
+        self._seq = 0
+        # set by recover(): log offset before any torn tail. None until
+        # recover() runs — open() must not truncate a log it hasn't parsed
+        self._valid_log_bytes: Optional[int] = None
 
     # ------------------------------------------------------------- recovery
 
@@ -70,6 +78,7 @@ class Journal:
         maps name -> deque of payloads.
         """
         rev = 0
+        snap_seq = 0
         kv: Dict[str, Tuple[bytes, int, int]] = {}
         queues: Dict[str, deque] = {}
 
@@ -77,13 +86,15 @@ class Journal:
             with open(self.snap_path, "rb") as f:
                 snap = msgpack.unpackb(f.read(), raw=False)
             rev = snap["rev"]
+            snap_seq = snap.get("seq", 0)
             for k, v, cr, mr in snap["kv"]:
                 kv[k] = (v, cr, mr)
             for name, items in snap["queues"].items():
                 queues[name] = deque(items)
+        self._seq = snap_seq
 
         if os.path.exists(self.log_path):
-            replayed = truncated = 0
+            replayed = skipped = truncated = 0
             with open(self.log_path, "rb") as f:
                 buf = f.read()
             off = 0
@@ -94,6 +105,14 @@ class Journal:
                     break
                 rec = msgpack.unpackb(buf[off + 4:off + 4 + n], raw=False)
                 off += 4 + n
+                seq = rec.get("s", 0)
+                self._seq = max(self._seq, seq)
+                if seq and seq <= snap_seq:
+                    # already folded into the snapshot: a crash between
+                    # snapshot-rename and log-truncate must not re-apply
+                    # (a replayed qput would double-deliver its item)
+                    skipped += 1
+                    continue
                 replayed += 1
                 t = rec["t"]
                 if t == "put":
@@ -109,11 +128,13 @@ class Journal:
                         q.popleft()
                 elif t == "rev":
                     rev = max(rev, rec["r"])
+            self._valid_log_bytes = off
             if truncated:
                 log.warning("journal: dropped %d-byte torn tail", truncated)
             log.info("journal: recovered rev=%d kv=%d queues=%d "
-                     "(replayed %d records)", rev, len(kv),
-                     sum(map(len, queues.values())), replayed)
+                     "(replayed %d records, %d pre-snapshot skipped)",
+                     rev, len(kv), sum(map(len, queues.values())),
+                     replayed, skipped)
         return rev, kv, queues
 
     # -------------------------------------------------------------- writing
@@ -122,7 +143,14 @@ class Journal:
         os.makedirs(os.path.dirname(os.path.abspath(self.log_path)),
                     exist_ok=True)
         self._f = open(self.log_path, "ab")
-        self._bytes = self._f.tell()
+        if (self._valid_log_bytes is not None
+                and self._f.tell() > self._valid_log_bytes):
+            # cut the torn tail recover() dropped in memory — appending
+            # after garbage bytes would corrupt the NEXT recovery
+            self._f.truncate(self._valid_log_bytes)
+        self._bytes = (self._valid_log_bytes
+                       if self._valid_log_bytes is not None
+                       else self._f.tell())
 
     def close(self) -> None:
         if self._f:
@@ -130,6 +158,8 @@ class Journal:
             self._f = None
 
     def _append(self, rec: dict) -> None:
+        self._seq += 1
+        rec["s"] = self._seq
         body = msgpack.packb(rec, use_bin_type=True)
         self._f.write(len(body).to_bytes(4, "big") + body)
         self._f.flush()
@@ -163,23 +193,16 @@ class Journal:
 
     # ----------------------------------------------------------- compaction
 
-    def maybe_compact(self, rev: int,
-                      kv: Dict[str, Tuple[bytes, int, int]],
-                      queues: Dict[str, deque]) -> bool:
-        """Snapshot current state + truncate the log when it has grown
-        past ``max_log_bytes``. Crash-safe: the snapshot is written to a
-        temp file and atomically renamed BEFORE the log is truncated, so
-        every instant has (old snap + full log) or (new snap + empty
-        log)."""
-        if self.log_size < self.max_log_bytes:
-            return False
-        self.snapshot(rev, kv, queues)
-        return True
-
     def snapshot(self, rev: int, kv: Dict[str, Tuple[bytes, int, int]],
                  queues: Dict[str, deque]) -> None:
+        """Write current state to ``.snap`` (temp file + atomic rename,
+        fsynced) and truncate the log. Crash-safe: the snapshot stamps
+        the last record sequence it covers, so a crash BETWEEN rename
+        and truncate recovers as (new snap + log whose records are all
+        seq-skipped) — nothing double-applies."""
         snap = {
             "rev": rev,
+            "seq": self._seq,
             "kv": [[k, v, cr, mr] for k, (v, cr, mr) in kv.items()],
             "queues": {name: list(items) for name, items in queues.items()
                        if items},

@@ -199,3 +199,62 @@ def test_torn_tail_write_dropped(run_async, tmp_path):
     with open(jpath + ".log", "ab") as f:
         f.write((1000).to_bytes(4, "big") + b"partial")
     run_async(phase2())
+
+
+def test_torn_tail_then_new_writes_then_crash(run_async, tmp_path):
+    """open() must truncate the torn tail on disk — otherwise records
+    appended after the garbage are unreachable on the NEXT recovery."""
+    jpath = str(tmp_path / "dcp")
+
+    async def phase(write_key, expect):
+        s = await DcpServer.start(journal_path=jpath)
+        c = await DcpClient.connect(s.address)
+        if write_key:
+            await c.kv_put(write_key, b"v-" + write_key.encode())
+        for k in expect:
+            assert await c.kv_get(k) == b"v-" + k.encode(), k
+        await c.close()
+        s._journal.close()
+        s._journal = None      # crash: no graceful snapshot
+        await s.stop()
+
+    run_async(phase("a", ["a"]))
+    with open(jpath + ".log", "ab") as f:
+        f.write((999).to_bytes(4, "big") + b"torn")
+    run_async(phase("b", ["a", "b"]))      # recovers past tail, writes b
+    run_async(phase(None, ["a", "b"]))     # b survives the second crash
+
+
+def test_crash_between_snapshot_and_truncate(run_async, tmp_path):
+    """The compaction crash window: new snapshot renamed in, old log not
+    yet truncated. Replay must seq-skip the already-snapshotted records —
+    a re-applied qput would double-deliver a prefill request."""
+    jpath = str(tmp_path / "dcp")
+
+    async def phase1():
+        s = await DcpServer.start(journal_path=jpath)
+        c = await DcpClient.connect(s.address)
+        await c.kv_put("x", b"1")
+        await c.queue_put("q", b"only-once")
+        # snapshot with the log intact = the mid-compaction crash state
+        with open(jpath + ".log", "rb") as f:
+            log_bytes = f.read()
+        s._journal.snapshot(s._rev, s._durable_kv(), s._queues)
+        with open(jpath + ".log", "wb") as f:
+            f.write(log_bytes)           # "truncate never happened"
+        await c.close()
+        s._journal.close()
+        s._journal = None
+        await s.stop()
+
+    async def phase2():
+        s = await DcpServer.start(journal_path=jpath)
+        c = await DcpClient.connect(s.address)
+        assert await c.kv_get("x") == b"1"
+        assert await c.queue_len("q") == 1, "qput double-applied"
+        assert await c.queue_pull("q") == b"only-once"
+        await c.close()
+        await s.stop()
+
+    run_async(phase1())
+    run_async(phase2())

@@ -327,6 +327,55 @@ def test_pipeline_toggle_token_identity(run_async):
     assert all(len(t) == 9 for t in results[True])
 
 
+def test_prefill_token_budget_mixing(run_async):
+    """Budgeted chunked-prefill mixing: tokens identical to pure
+    prefill-priority, and decode windows demonstrably dispatch while a
+    prompt backlog is still prefilling (the decode-starvation fix)."""
+    cfg = ModelConfig.tiny()
+    rng = np.random.RandomState(7)
+    # a running request first, then a burst of long prompts to create a
+    # prefill backlog that pure priority would drain before any decode
+    first = rng.randint(1, 500, 9).tolist()
+    burst = [rng.randint(1, 500, 60).tolist() for _ in range(4)]
+
+    async def gen_all(engine):
+        async def one(p, i, delay=0.0):
+            if delay:
+                await asyncio.sleep(delay)
+            req = PreprocessedRequest(
+                token_ids=p,
+                sampling=SamplingOptions(temperature=0.6, top_k=8,
+                                         seed=200 + i),
+                stop=StopConditions(max_tokens=12, ignore_eos=True),
+                eos_token_ids=[])
+            toks = []
+            async for out in engine.generate(req, Context()):
+                toks.extend(out.token_ids)
+                if out.finish_reason:
+                    break
+            return toks
+        outs = await asyncio.gather(
+            one(first, 0),
+            *(one(p, i + 1, delay=0.05) for i, p in enumerate(burst)))
+        await engine.stop()
+        return outs
+
+    results = {}
+    mixed = {}
+    for budget in (None, 32):
+        ecfg = EngineConfig(page_size=4, num_pages=128, max_batch=8,
+                            prefill_chunk=32, prefill_buckets=(32,),
+                            batch_buckets=(8,), page_buckets=(16,),
+                            decode_steps=3, prefill_token_budget=budget)
+        eng = JaxEngine(cfg, ecfg, seed=0)
+        results[budget] = run_async(gen_all(eng))
+        mixed[budget] = eng.mixed_dispatches
+
+    assert results[None] == results[32], "budgeted mixing changed tokens"
+    assert mixed[32] > 0, "no decode window overlapped the prefill backlog"
+    assert mixed[None] == 0  # pure priority never mixes
+
+
 def test_admission_clamped_to_warmed_grid(run_async):
     """No mid-serving compile: prompts beyond the largest page bucket are
     rejected at admission, and generation is cut at the grid capacity
