@@ -670,6 +670,40 @@ def _pool_window_attention(q, k_pool_l, v_pool_l, page_table, start,
 # -------------------------------------------------- full-attention reference
 
 
+def full_attention_layer(cfg: ModelConfig, h: jax.Array, lp: Params,
+                         pos: jax.Array, inv_freq: jax.Array,
+                         scale: float) -> jax.Array:
+    """One transformer layer with plain causal full attention (no paged
+    cache). The single source of the layer math for every non-paged
+    consumer: ``reference_forward`` (test oracle) and the
+    pipeline-parallel stage body (parallel/pipeline_parallel.py)."""
+    B, T = h.shape[:2]
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+    xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+    if cfg.attn_bias:
+        xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
+    q = apply_rope(xq.reshape(B, T, H, hd), pos, inv_freq)
+    k = apply_rope(xk.reshape(B, T, KV, hd), pos, inv_freq)
+    v = xv.reshape(B, T, KV, hd)
+    qg = q.reshape(B, T, KV, H // KV, hd)
+    scores = jnp.einsum("btkgh,bskh->bkgts", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(causal[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bkgts,bskh->btkgh", probs, v.astype(jnp.float32))
+    attn = attn.reshape(B, T, H * hd).astype(h.dtype)
+    h = h + attn @ lp["wo"]
+    x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+    if cfg.num_experts > 0:
+        h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
+                         lp["w_down"], cfg.num_experts_per_tok)
+    else:
+        h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+    return h
+
+
 def reference_forward(params: Params, cfg: ModelConfig,
                       tokens: jax.Array) -> jax.Array:
     """Plain full-attention forward (no paging) used to validate the paged
@@ -677,7 +711,6 @@ def reference_forward(params: Params, cfg: ModelConfig,
     B, T = tokens.shape
     inv_freq = rope_freqs(cfg)
     scale = 1.0 / math.sqrt(cfg.head_dim_)
-    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     h = params["embed"][tokens]
 
@@ -690,29 +723,7 @@ def reference_forward(params: Params, cfg: ModelConfig,
     layer_params = {k: params[k] for k in layer_keys}
 
     def layer(h, lp):
-        x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
-        xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
-        if cfg.attn_bias:
-            xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
-        q = apply_rope(xq.reshape(B, T, H, hd), pos, inv_freq)
-        k = apply_rope(xk.reshape(B, T, KV, hd), pos, inv_freq)
-        v = xv.reshape(B, T, KV, hd)
-        qg = q.reshape(B, T, KV, H // KV, hd)
-        scores = jnp.einsum("btkgh,bskh->bkgts", qg.astype(jnp.float32),
-                            k.astype(jnp.float32)) * scale
-        causal = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(causal[None, None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bkgts,bskh->btkgh", probs, v.astype(jnp.float32))
-        attn = attn.reshape(B, T, H * hd).astype(h.dtype)
-        h = h + attn @ lp["wo"]
-        x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
-        if cfg.num_experts > 0:
-            h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
-                             lp["w_down"], cfg.num_experts_per_tok)
-        else:
-            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
-        return h, None
+        return full_attention_layer(cfg, h, lp, pos, inv_freq, scale), None
 
     h, _ = lax.scan(layer, h, layer_params)
     h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)

@@ -35,21 +35,24 @@ class MeshSpec:
     model: int = 1
     expert: int = 1
     seq: int = 1
+    stage: int = 1  # pipeline parallelism (parallel/pipeline_parallel.py)
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.model * self.expert * self.seq
+        return self.data * self.model * self.expert * self.seq * self.stage
 
     def build(self, devices=None) -> Mesh:
         devices = devices if devices is not None else jax.devices()
         if len(devices) < self.num_devices:
             raise ValueError(
                 f"mesh needs {self.num_devices} devices, have {len(devices)}")
-        # seq innermost-but-one so ring ppermute hops ride neighbouring ICI
+        # stage sits outside seq/model (its activation handoffs are
+        # infrequent bulk transfers, fine across slower links); seq
+        # innermost-but-one so ring ppermute hops ride neighbouring ICI
         # links; model innermost (highest-bandwidth all-reduces)
         devs = np.asarray(devices[: self.num_devices]).reshape(
-            self.data, self.expert, self.seq, self.model)
-        return Mesh(devs, ("data", "expert", "seq", "model"))
+            self.data, self.expert, self.stage, self.seq, self.model)
+        return Mesh(devs, ("data", "expert", "stage", "seq", "model"))
 
     @classmethod
     def single(cls) -> "MeshSpec":

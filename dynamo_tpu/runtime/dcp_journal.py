@@ -107,10 +107,12 @@ class Journal:
                 off += 4 + n
                 seq = rec.get("s", 0)
                 self._seq = max(self._seq, seq)
-                if seq and seq <= snap_seq:
+                if seq <= snap_seq and snap_seq > 0:
                     # already folded into the snapshot: a crash between
                     # snapshot-rename and log-truncate must not re-apply
-                    # (a replayed qput would double-deliver its item)
+                    # (a replayed qput would double-deliver its item).
+                    # Records WITHOUT a seq (seq=0) necessarily predate
+                    # any seq-stamped snapshot, so they are covered too.
                     skipped += 1
                     continue
                 replayed += 1
