@@ -343,13 +343,24 @@ class PageManager:
                 return slot
         return None
 
-    def drain_tier_ops(self) -> Tuple[List[Tuple[int, int]],
-                                      List[Tuple[int, int]]]:
+    def drain_tier_ops(self, restore_limit: Optional[int] = None
+                       ) -> Tuple[List[Tuple[int, int]],
+                                  List[Tuple[int, int]]]:
         """Pop queued (page, host_slot) tier copies: (offloads, restores).
-        The engine must run offloads before restores, and both before its
-        next device step."""
+        The engine must make all popped offload content visible in the
+        host pool before executing any popped restore, and dispatch both
+        before a device step that touches the pages involved.
+
+        ``restore_limit`` caps restores popped per call (FIFO prefix) so
+        a huge restore burst drains over several iterations instead of
+        blocking one — sequences whose restores are still queued are
+        gated out of prefill by the engine until their ops dispatch."""
         off, self.pending_offload = self.pending_offload, []
-        res, self.pending_restore = self.pending_restore, []
+        if restore_limit is None or len(self.pending_restore) <= restore_limit:
+            res, self.pending_restore = self.pending_restore, []
+        else:
+            res = self.pending_restore[:restore_limit]
+            self.pending_restore = self.pending_restore[restore_limit:]
         return off, res
 
     def host_usage(self) -> float:

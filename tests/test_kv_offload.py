@@ -161,6 +161,57 @@ def test_engine_offload_end_to_end(host_pages, run_async):
         assert engine.restore_pages_total == 0
 
 
+def test_engine_chunked_restore_token_identity(run_async):
+    """tier_restore_chunk=1: a multi-page host hit must drain its
+    restores over SEVERAL iterations (sequence gated meanwhile) and still
+    reproduce the unchunked continuation exactly."""
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = ModelConfig.tiny()
+
+    def run(chunk):
+        ecfg = EngineConfig(page_size=4, num_pages=24, max_batch=4,
+                            prefill_chunk=32, prefill_buckets=(32,),
+                            batch_buckets=(4,), page_buckets=(16,),
+                            host_pages=64, watermark_pages=2,
+                            tier_restore_chunk=chunk)
+        engine = JaxEngine(cfg, ecfg, seed=0)
+
+        async def gen(prompt, n=8):
+            req = PreprocessedRequest(
+                token_ids=prompt, sampling=SamplingOptions(),
+                stop=StopConditions(max_tokens=n, ignore_eos=True),
+                eos_token_ids=[])
+            toks = []
+            async for out in engine.generate(req, Context()):
+                toks.extend(out.token_ids)
+                if out.finish_reason:
+                    break
+            return toks
+
+        async def scenario():
+            rng = np.random.RandomState(1)
+            prompt_a = rng.randint(1, 500, 24).tolist()  # 6 pages
+            first = await gen(prompt_a)
+            for _ in range(4):  # churn A out of the 23-page HBM pool
+                await gen(rng.randint(1, 500, 24).tolist())
+            again = await gen(prompt_a)
+            await engine.stop()
+            return first, again, engine.restore_pages_total
+
+        return run_async(scenario())
+
+    first_c, again_c, restored_c = run(1)     # one page per iteration
+    first_u, again_u, restored_u = run(0)     # unchunked baseline
+    assert first_c == again_c == first_u == again_u
+    assert restored_c > 1 and restored_c == restored_u
+
+
 def test_restore_slots_pinned_against_midalloc_eviction():
     """Regression (ADVICE r1 high): slots planned for restore must be
     pinned for the whole allocate_sequence call. Previously they reached
