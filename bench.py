@@ -18,11 +18,17 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import faulthandler
 import json
 import os
+import signal
 import statistics
 import sys
 import time
+
+# kill -USR1 <pid> dumps every thread's stack to stderr — the first tool
+# to reach for when a scenario wedges on the relay-attached chip
+faulthandler.register(signal.SIGUSR1)
 
 
 def parse_args():
@@ -202,44 +208,65 @@ async def measure(engine, reqs, concurrency):
 
     sem = asyncio.Semaphore(concurrency)
     results = []
+    # hard per-request watchdog: a wedged generator must surface as an
+    # error row, never hang the whole bench (the driver runs this
+    # unattended at end of round)
+    req_timeout = float(os.environ.get("DYN_BENCH_REQ_TIMEOUT", "600"))
 
     async def one(req_idx, token_ids, osl):
         async with sem:
-            pre = PreprocessedRequest(
-                token_ids=token_ids,
-                sampling=SamplingOptions(),  # greedy
-                stop=StopConditions(max_tokens=osl, ignore_eos=True),
-                eos_token_ids=[])
             ctx = Context()
-            t_start = time.monotonic()
-            t_first = None
-            stamps = []
-            n_out = 0
-            finish = None
-            async for out in engine.generate(pre, ctx):
-                now = time.monotonic()
-                if out.token_ids:
-                    if t_first is None:
-                        t_first = now
-                    stamps.extend([now] * len(out.token_ids))
-                    n_out += len(out.token_ids)
-                if out.finish_reason:
-                    finish = out.finish_reason
-                    break
-            t_end = time.monotonic()
-            # window-amortized ITL: the fused decode window emits K tokens
-            # per host sync, so raw inter-arrival gaps are 0 within a
-            # window and ~window-time at boundaries (the r1/r2 itl_p50=0
-            # artifact). The honest per-request number is the mean
-            # inter-token interval over the whole stream.
-            itl = ((stamps[-1] - stamps[0]) / (n_out - 1)
-                   if n_out > 1 else None)
-            results.append({
-                "tokens_in": len(token_ids), "tokens_out": n_out,
-                "ttft": (t_first - t_start) if t_first else None,
-                "elapsed": t_end - t_start, "itl": itl,
-                "error": finish == "error",
-            })
+            try:
+                await asyncio.wait_for(_one_inner(ctx, token_ids, osl),
+                                       req_timeout)
+            except asyncio.TimeoutError:
+                # cancel the engine-side sequence too: an abandoned
+                # request would keep its batch slot + KV pages and decode
+                # to max_tokens, starving the remaining waves
+                ctx.stop_generating()
+                print(f"request {req_idx} timed out after {req_timeout}s",
+                      file=sys.stderr)
+                results.append({
+                    "tokens_in": len(token_ids), "tokens_out": 0,
+                    "ttft": None, "elapsed": req_timeout, "itl": None,
+                    "error": True,
+                })
+
+    async def _one_inner(ctx, token_ids, osl):
+        pre = PreprocessedRequest(
+            token_ids=token_ids,
+            sampling=SamplingOptions(),  # greedy
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+            eos_token_ids=[])
+        t_start = time.monotonic()
+        t_first = None
+        stamps = []
+        n_out = 0
+        finish = None
+        async for out in engine.generate(pre, ctx):
+            now = time.monotonic()
+            if out.token_ids:
+                if t_first is None:
+                    t_first = now
+                stamps.extend([now] * len(out.token_ids))
+                n_out += len(out.token_ids)
+            if out.finish_reason:
+                finish = out.finish_reason
+                break
+        t_end = time.monotonic()
+        # window-amortized ITL: the fused decode window emits K tokens
+        # per host sync, so raw inter-arrival gaps are 0 within a
+        # window and ~window-time at boundaries (the r1/r2 itl_p50=0
+        # artifact). The honest per-request number is the mean
+        # inter-token interval over the whole stream.
+        itl = ((stamps[-1] - stamps[0]) / (n_out - 1)
+               if n_out > 1 else None)
+        results.append({
+            "tokens_in": len(token_ids), "tokens_out": n_out,
+            "ttft": (t_first - t_start) if t_first else None,
+            "elapsed": t_end - t_start, "itl": itl,
+            "error": finish == "error",
+        })
 
     bench_t0 = time.monotonic()
     await asyncio.gather(*(one(i, t, o) for i, (t, o) in enumerate(reqs)))

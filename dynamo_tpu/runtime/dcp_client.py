@@ -233,8 +233,9 @@ class DcpClient:
     async def lease_grant(self, ttl: float = 10.0) -> int:
         return (await self._call("lease_grant", ttl=ttl))["lease"]
 
-    async def lease_keepalive(self, lease: int) -> None:
-        await self._call("lease_keepalive", lease=lease)
+    async def lease_keepalive(self, lease: int,
+                              timeout: Optional[float] = None) -> None:
+        await self._call("lease_keepalive", lease=lease, timeout=timeout)
 
     async def lease_revoke(self, lease: int) -> None:
         await self._call("lease_revoke", lease=lease)
@@ -242,7 +243,13 @@ class DcpClient:
     def spawn_keepalive(self, lease: int, ttl: float,
                         cancel: Optional[asyncio.Event] = None) -> asyncio.Task:
         """Background keep-alive tied to a cancel event (reference
-        transports/etcd/lease.rs: keep-alive tied to CancellationToken)."""
+        transports/etcd/lease.rs: keep-alive tied to CancellationToken).
+
+        NOTE: this task lives on the caller's event loop, so synchronous
+        work that blocks the loop for multiples of the TTL (XLA warmup,
+        big host transfers) starves it and the lease expires — use
+        :class:`KeepaliveThread` for leases that must survive loop
+        stalls (DistributedRuntime's primary lease does)."""
 
         async def _loop():
             interval = max(ttl / 3.0, 0.1)
@@ -371,3 +378,118 @@ def pack(obj) -> bytes:
 
 def unpack(data: bytes):
     return msgpack.unpackb(data, raw=False)
+
+
+class KeepaliveThread:
+    """Lease keep-alive on a dedicated daemon thread with its OWN
+    connection and event loop, immune to main-loop stalls.
+
+    The serving process routinely blocks its event loop for multiples of
+    the lease TTL — engine warmup compiles the whole bucket grid
+    synchronously, host-staged KV transfers materialize multi-MB arrays —
+    and a loop-resident keepalive task then starves until the lease
+    expires, deleting every lease-attached key (endpoint instances, the
+    disagg transfer endpoint) out from under a live worker. A thread with
+    its own socket keeps renewals flowing regardless; with the embedded
+    DCP server the renewal frames queue in the socket during a stall and
+    are processed before the reaper's timer callback when the loop
+    resumes (asyncio runs IO callbacks ahead of timers in an iteration).
+    """
+
+    def __init__(self, address: str, lease: int, ttl: float):
+        import threading
+
+        self.address = address
+        self.lease = lease
+        self.ttl = ttl
+        self.dead = False          # lease reported gone by the server
+        self._stop = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._waker: Optional[asyncio.Event] = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"dcp-keepalive-{lease:x}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            asyncio.run(self._amain())
+        except Exception:  # noqa: BLE001 — best-effort background thread
+            log.exception("keepalive thread for lease %x died", self.lease)
+
+    async def _amain(self) -> None:
+        interval = max(self.ttl / 3.0, 0.05)
+        self._loop = asyncio.get_running_loop()
+        self._waker = asyncio.Event()
+        client: Optional[DcpClient] = None
+
+        async def _pause() -> None:
+            try:
+                await asyncio.wait_for(self._waker.wait(), interval)
+            except asyncio.TimeoutError:
+                pass
+
+        try:
+            # connect EAGERLY, before the first interval: once a stall
+            # begins, the (possibly loop-embedded) server can no longer
+            # accept, and renewals can only queue on an existing socket
+            try:
+                client = await DcpClient.connect(self.address)
+            except OSError:
+                pass
+            while not self._stop.is_set():
+                await _pause()
+                if self._stop.is_set():
+                    return
+                try:
+                    if client is None or not client.connected:
+                        if client is not None:
+                            await client.close()
+                        client = await DcpClient.connect(self.address)
+                    # bound the wait so a wedged server can't pin the
+                    # thread past cancel()
+                    await client.lease_keepalive(
+                        self.lease, timeout=max(self.ttl, 1.0))
+                except DcpError as e:
+                    if "lease" in str(e):
+                        # the server says the lease is GONE (expired or
+                        # revoked) — renewing cannot resurrect it, and the
+                        # worker's lease-attached records are already
+                        # deleted. Surface loudly and stop; the owner
+                        # must re-attach to get a new identity.
+                        log.error(
+                            "lease %x is gone (%s): keepalive stopping — "
+                            "this worker's instance records are deleted; "
+                            "re-attach to rejoin discovery",
+                            self.lease, e)
+                        self.dead = True
+                        return
+                    await self._drop(client)
+                    client = None
+                except (OSError, asyncio.TimeoutError):
+                    # server briefly down/stalled: keep trying until
+                    # cancelled — renewals must survive transient faults
+                    await self._drop(client)
+                    client = None
+        finally:
+            if client is not None:
+                await client.close()
+
+    @staticmethod
+    async def _drop(client: Optional[DcpClient]) -> None:
+        try:
+            if client is not None:
+                await client.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def cancel(self) -> None:
+        """Stop the thread. Wakes its sleep via its own loop so the join
+        returns in milliseconds instead of blocking the caller up to a
+        renewal interval."""
+        self._stop.set()
+        if self._loop is not None and self._waker is not None:
+            try:
+                self._loop.call_soon_threadsafe(self._waker.set)
+            except RuntimeError:
+                pass  # thread's loop already closed
+        self._thread.join(timeout=2.0)

@@ -438,9 +438,21 @@ class DcpServer:
                 self._notify_watchers("delete", key, None)
 
     async def _lease_reaper(self) -> None:
+        last = time.monotonic()
         while True:
             await asyncio.sleep(0.25)
             now = time.monotonic()
+            gap = now - last
+            last = now
+            if gap > 1.0:
+                # the event loop (and so this server) just resumed from a
+                # stall: keep-alive renewals may still be queued in socket
+                # buffers or mid-reconnect — judging deadlines NOW would
+                # expire leases whose owners renewed on time. Skip one
+                # tick so pending renewals land first.
+                log.info("lease reaper resumed after %.1fs stall; "
+                         "deferring one tick", gap)
+                continue
             for lid in [l.id for l in self._leases.values() if l.deadline < now]:
                 log.info("lease %x expired", lid)
                 await self._expire_lease(lid)

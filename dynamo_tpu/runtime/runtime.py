@@ -18,7 +18,7 @@ from typing import Awaitable, Callable, Optional
 
 from .component import Namespace
 from .config import RuntimeConfig
-from .dcp_client import DcpClient
+from .dcp_client import DcpClient, KeepaliveThread
 from .dcp_server import DcpServer
 from .tcp import TcpStreamServer
 
@@ -70,7 +70,7 @@ class DistributedRuntime:
         self.primary_lease = lease
         self._tcp_server: Optional[TcpStreamServer] = None
         self._tcp_lock = asyncio.Lock()
-        self._keepalive_task: Optional[asyncio.Task] = None
+        self._keepalive_task: Optional[KeepaliveThread] = None
         self._embedded_server: Optional[DcpServer] = None
 
     @classmethod
@@ -87,8 +87,12 @@ class DistributedRuntime:
         dcp = await DcpClient.connect(address)
         lease = await dcp.lease_grant(lease_ttl)
         self = cls(runtime, dcp, lease)
-        self._keepalive_task = dcp.spawn_keepalive(
-            lease, lease_ttl, runtime.shutdown_event)
+        # dedicated-thread keepalive: the serving process blocks its event
+        # loop for multiples of the TTL (engine warmup, host-staged KV
+        # transfers), and a loop-resident keepalive would let the primary
+        # lease expire mid-stall, deleting every instance/endpoint record
+        # under it (see KeepaliveThread)
+        self._keepalive_task = KeepaliveThread(address, lease, lease_ttl)
         return self
 
     @classmethod

@@ -211,3 +211,93 @@ def test_disagg_fallback_on_no_prefill_worker(run_async):
             await drt.shutdown()
 
     run_async(main())
+
+
+def test_disagg_concurrent_mixed_fallback_completes(run_async):
+    """The TPU-bench wedge scenario, deterministic on CPU: many concurrent
+    requests racing remote prefills against a SLOW prefill worker under a
+    small decode pool, so the run mixes remote successes, timeout
+    fallbacks, local prefills, and late KV arrivals (dropped after
+    fallback). Every request must complete — a hang here is the disagg
+    deadlock the bench watchdog guards against."""
+
+    async def main():
+        import jax
+
+        params = init_params(tiny_cfg(), jax.random.PRNGKey(4))
+        drt = await DistributedRuntime.detached()
+        try:
+            # reference outputs from a plain local engine
+            local = make_engine(params)
+            prompts = [[(i * 11 + j * 3) % 100 + 1 for j in range(16 + i)]
+                       for i in range(10)]
+            want = []
+            for p in prompts:
+                toks, _ = await collect(local, greedy_request(p))
+                want.append(toks)
+            await local.stop()
+
+            # small decode pool: reservations + admissions contend
+            decode_ecfg = EngineConfig(
+                page_size=PS, num_pages=24, max_batch=4,
+                prefill_chunk=32, batch_buckets=(1, 2, 4),
+                prefill_buckets=(8, 32), page_buckets=(8,),
+                watermark_pages=2)
+            decode_eng = JaxEngine(tiny_cfg(), decode_ecfg, params=params)
+            prefill_eng = make_engine(params)
+            # pre-compile the full bucket grids BEFORE registering the
+            # lease-attached transfer endpoint (bench.py's order): warmup
+            # blocks the event loop for multiples of the lease TTL, and a
+            # stalled keepalive would expire the lease and delete the
+            # endpoint — every remote prefill then fails with "no KV
+            # transfer endpoint registered"
+            decode_eng.warmup()
+            prefill_eng.warmup(decode=False)
+            router = DisaggRouter(max_local_prefill_length=4)
+            disagg = await build_disagg_decode(drt, decode_eng,
+                                               namespace="stress",
+                                               router=router,
+                                               watch_config=False)
+            # max_inflight covers every request so no fast job queues
+            # behind a slow one — the fast/slow mix below stays
+            # deterministic per request, not ordering-dependent
+            pw = PrefillWorker(drt, prefill_eng, namespace="stress",
+                               max_inflight=len(prompts) + 1)
+
+            # slow worker: odd-length prompts sleep far past the decode
+            # timeout, so their KV lands AFTER the fallback released the
+            # reservation (the late-arrival drop path); even-length
+            # prompts are handled promptly and succeed remotely
+            orig_handle = pw._handle
+
+            async def slow_handle(req):
+                if len(req.token_ids) % 2 == 1:
+                    await asyncio.sleep(12.0)
+                await orig_handle(req)
+
+            pw._handle = slow_handle
+            pw.start()
+
+            disagg.prefill_timeout = 5.0
+
+            results = await asyncio.wait_for(
+                asyncio.gather(*(collect(disagg, greedy_request(p))
+                                 for p in prompts)),
+                timeout=120.0)
+
+            for i, ((toks, fin), w) in enumerate(zip(results, want)):
+                assert fin in ("length", "stop"), f"req {i}: {fin}"
+                assert toks == w, f"req {i} diverged"
+            assert disagg.remote_fallbacks > 0, \
+                "stress never exercised the fallback path"
+            assert disagg.remote_prefills > disagg.remote_fallbacks, \
+                "stress never exercised a remote success"
+
+            await pw.stop()
+            await disagg.transfer.stop()
+            await prefill_eng.stop()
+            await decode_eng.stop()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())

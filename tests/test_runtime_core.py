@@ -380,3 +380,34 @@ def test_object_pool(run_async):
         return True
 
     assert run_async(scenario())
+
+
+def test_lease_survives_event_loop_stall(run_async):
+    """The primary lease must outlive synchronous work that blocks the
+    event loop for multiples of the TTL (engine warmup, bulk host
+    transfers): the keepalive runs on its own thread + connection, so a
+    stalled loop cannot starve renewals and vaporize every
+    lease-attached record (the disagg 'no KV transfer endpoint' failure
+    mode)."""
+    import time
+
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    async def main():
+        drt = await DistributedRuntime.attach(
+            (await _fresh_server()).address, lease_ttl=0.5)
+        await drt.dcp.kv_put("inst/me", b"alive", lease=drt.primary_lease)
+        # block the loop for 4x the TTL — the old loop-resident keepalive
+        # died here and the key vanished
+        time.sleep(2.0)
+        await asyncio.sleep(0.3)  # let the reaper tick with IO pending
+        assert await drt.dcp.kv_get("inst/me") == b"alive"
+        # a fresh keepalive still renews after the stall
+        await asyncio.sleep(1.0)
+        assert await drt.dcp.kv_get("inst/me") == b"alive"
+        await drt.shutdown()
+
+    async def _fresh_server():
+        return await DcpServer.start()
+
+    run_async(main())
