@@ -40,6 +40,14 @@ class ModelConfig:
     qk_rope_head_dim: int = 64
     v_head_dim: int = 128
     attn_bias: bool = False        # qkv projection bias (Qwen2-style)
+    # Gemma-family knobs (model_type "gemma"/"gemma2"): scaled embeddings,
+    # (1 + w) RMSNorm, GeGLU activation, explicit attention scale, and the
+    # Gemma-2 final-logit softcap
+    embed_scale: bool = False      # multiply embeddings by sqrt(hidden)
+    norm_unit_offset: bool = False  # rms_norm weight is (1 + w)
+    hidden_act: str = "silu"       # "silu" | "gelu_tanh"
+    query_pre_attn_scalar: Optional[float] = None  # attn scale override
+    final_logit_softcap: Optional[float] = None
     dtype: str = "bfloat16"
 
     @property
@@ -49,6 +57,13 @@ class ModelConfig:
     @property
     def head_dim_(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def attn_scale(self) -> float:
+        """Attention logit scale: 1/sqrt(head_dim) unless the config pins
+        a different denominator (Gemma-2's query_pre_attn_scalar)."""
+        denom = self.query_pre_attn_scalar or self.head_dim_
+        return 1.0 / (denom ** 0.5)
 
     @property
     def jax_dtype(self):
@@ -88,6 +103,25 @@ class ModelConfig:
         if mt == "qwen2":
             c.model_type = "llama"  # same decoder shape (GQA + SwiGLU)
             c.attn_bias = True      # qwen2 keeps bias on q/k/v projections
+        if mt in ("gemma", "gemma2"):
+            # Gemma rides the Llama GQA stack with four semantic switches
+            c.model_type = "gemma"
+            c.embed_scale = True
+            c.norm_unit_offset = True
+            c.hidden_act = "gelu_tanh"
+            c.tie_word_embeddings = cfg.get("tie_word_embeddings", True)
+            c.rope_theta = cfg.get("rope_theta", 10000.0)
+            if mt == "gemma2":
+                # Gemma-2 additionally uses sandwich norms (pre/post
+                # feed-forward layernorms, post-attention norm AFTER the
+                # residual), sliding-window attention on alternate
+                # layers, and attention-logit softcapping — none of
+                # which the Llama stack implements. Loading it here would
+                # produce silently-wrong logits, so refuse outright.
+                raise NotImplementedError(
+                    "gemma2 checkpoints are not supported (sandwich "
+                    "norms + sliding-window attention + attention "
+                    "softcap are unimplemented); gemma-1 is")
         return c
 
     @classmethod

@@ -118,10 +118,47 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
 # -------------------------------------------------------------- primitives
 
 
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, w: jax.Array, eps: float,
+             unit_offset: bool = False) -> jax.Array:
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
-    return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * w
+    normed = x32 * lax.rsqrt(var + eps)
+    if unit_offset:
+        # Gemma: w is a delta around 1, applied in float32 before the
+        # cast (matches HF GemmaRMSNorm exactly)
+        return (normed * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
+    # Llama: cast first, then scale (matches HF LlamaRMSNorm)
+    return normed.astype(x.dtype) * w
+
+
+def embed_tokens(params: Params, cfg: ModelConfig,
+                 tokens: jax.Array) -> jax.Array:
+    """Token embedding lookup; Gemma scales by sqrt(hidden)."""
+    h = params["embed"][tokens]
+    if cfg.embed_scale:
+        h = h * jnp.asarray(math.sqrt(cfg.hidden_size), h.dtype)
+    return h
+
+
+def project_logits(params: Params, cfg: ModelConfig,
+                   h: jax.Array) -> jax.Array:
+    """LM head (tied to the embedding when absent) + the optional
+    Gemma-2-style final-logit softcap — the single logit-path exit used
+    by every forward variant."""
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (h @ head).astype(jnp.float32)
+    if cfg.final_logit_softcap:
+        c = cfg.final_logit_softcap
+        logits = c * jnp.tanh(logits / c)
+    return logits
+
+
+def _act(cfg: ModelConfig):
+    if cfg.hidden_act == "gelu_tanh":
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu
 
 
 def rope_freqs(cfg: ModelConfig, dim: Optional[int] = None) -> jax.Array:
@@ -268,8 +305,8 @@ def _paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 # ------------------------------------------------------------ forward pass
 
 
-def _mlp(h: jax.Array, w_gate, w_up, w_down) -> jax.Array:
-    return (jax.nn.silu(h @ w_gate) * (h @ w_up)) @ w_down
+def _mlp(h: jax.Array, w_gate, w_up, w_down, act=jax.nn.silu) -> jax.Array:
+    return (act(h @ w_gate) * (h @ w_up)) @ w_down
 
 
 def _moe_mlp(h: jax.Array, w_router, w_gate, w_up, w_down,
@@ -312,11 +349,12 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     Returns (hidden [B, T, D], new_kv_k, new_kv_v).
     """
     inv_freq = rope_freqs(cfg)
-    scale = 1.0 / math.sqrt(cfg.head_dim_)
+    scale = cfg.attn_scale
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     B, T = tokens.shape
 
-    h = params["embed"][tokens]  # [B, T, D]
+    h = embed_tokens(params, cfg, tokens)  # [B, T, D]
+    act = _act(cfg)
     safe_pos = jnp.maximum(positions, 0)
 
     layer_keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
@@ -329,7 +367,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
     def layer(h, xs):
         lp, k_layer, v_layer = xs
-        x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+        x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.norm_unit_offset)
         xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
         if cfg.attn_bias:
             xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
@@ -347,16 +385,16 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         attn = _attention(q, k_layer, v_layer, page_table, positions, scale,
                           allow_pallas=allow_pallas)
         h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
-        x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+        x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
         if cfg.num_experts > 0:
             h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
                              lp["w_down"], cfg.num_experts_per_tok)
         else:
-            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"], act)
         return h, (k_layer, v_layer)
 
     h, (new_k, new_v) = lax.scan(layer, h, (layer_params, kv_k, kv_v))
-    h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+    h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     return h, new_k, new_v
 
 
@@ -366,10 +404,7 @@ def logits_at(params: Params, cfg: ModelConfig, hidden: jax.Array,
     gather_idx: [B] position per row → logits [B, V] (float32)."""
     B = hidden.shape[0]
     h_last = hidden[jnp.arange(B), gather_idx]  # [B, D]
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    return (h_last @ head).astype(jnp.float32)
+    return project_logits(params, cfg, h_last)
 
 
 # ----------------------------------------------------- jitted entry points
@@ -466,7 +501,7 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
     from ..engine.sampling import sample_tokens
 
     inv_freq = rope_freqs(cfg)
-    scale = 1.0 / math.sqrt(cfg.head_dim_)
+    scale = cfg.attn_scale
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     # pool attention: Pallas flash kernel on TPU (streams only each row's
     # live pages HBM→VMEM, returns online-softmax stats merged with the
@@ -506,8 +541,10 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
         wv = jnp.zeros((L, B, k_steps, KV, hd), wdt)
         layer_params = {k: params[k] for k in _layer_keys()}
 
+        act = _act(cfg)
+
         def one_step(tok, pos, wk, wv, i):
-            h = params["embed"][tok][:, None]  # [B, 1, D]
+            h = embed_tokens(params, cfg, tok)[:, None]  # [B, 1, D]
             safe_pos = jnp.maximum(pos, 0)[:, None]
 
             def layer(h, xs):
@@ -516,7 +553,7 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
                 # slice copy for each unrolled step's pallas operand
                 # (≈6.4 GB/step of copy traffic at serving sizes)
                 lp, l_idx, wk_l, wv_l = xs
-                x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+                x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.norm_unit_offset)
                 xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
                 if cfg.attn_bias:
                     xq, xk, xv = (xq + lp["bq"], xk + lp["bk"],
@@ -537,19 +574,20 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
                         q, kv_k[l_idx], kv_v[l_idx], page_table, start,
                         wk_l, wv_l, i, scale)
                 h = h + attn.reshape(B, 1, H * hd) @ lp["wo"]
-                x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+                x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
                 if cfg.num_experts > 0:
                     h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"],
                                      lp["w_up"], lp["w_down"],
                                      cfg.num_experts_per_tok)
                 else:
-                    h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+                    h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"],
+                                 act)
                 return h, (wk_l, wv_l)
 
             h, (wk, wv) = lax.scan(
                 layer, h,
                 (layer_params, jnp.arange(L, dtype=jnp.int32), wk, wv))
-            h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+            h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps, cfg.norm_unit_offset)
             logits = logits_at(params, cfg, h, jnp.zeros(B, jnp.int32))
             return logits, wk, wv
 
@@ -679,7 +717,7 @@ def full_attention_layer(cfg: ModelConfig, h: jax.Array, lp: Params,
     pipeline-parallel stage body (parallel/pipeline_parallel.py)."""
     B, T = h.shape[:2]
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
-    x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+    x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
     if cfg.attn_bias:
         xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
@@ -695,12 +733,12 @@ def full_attention_layer(cfg: ModelConfig, h: jax.Array, lp: Params,
     attn = jnp.einsum("bkgts,bskh->btkgh", probs, v.astype(jnp.float32))
     attn = attn.reshape(B, T, H * hd).astype(h.dtype)
     h = h + attn @ lp["wo"]
-    x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+    x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     if cfg.num_experts > 0:
         h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
                          lp["w_down"], cfg.num_experts_per_tok)
     else:
-        h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"], _act(cfg))
     return h
 
 
@@ -710,9 +748,9 @@ def reference_forward(params: Params, cfg: ModelConfig,
     path in tests; returns logits for every position [B, T, V]."""
     B, T = tokens.shape
     inv_freq = rope_freqs(cfg)
-    scale = 1.0 / math.sqrt(cfg.head_dim_)
+    scale = cfg.attn_scale
     pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-    h = params["embed"][tokens]
+    h = embed_tokens(params, cfg, tokens)
 
     layer_keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                   "ln_attn", "ln_mlp"]
@@ -726,8 +764,5 @@ def reference_forward(params: Params, cfg: ModelConfig,
         return full_attention_layer(cfg, h, lp, pos, inv_freq, scale), None
 
     h, _ = lax.scan(layer, h, layer_params)
-    h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    return (h @ head).astype(jnp.float32)
+    h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps, cfg.norm_unit_offset)
+    return project_logits(params, cfg, h)

@@ -26,7 +26,6 @@ reference ships PP=1 everywhere it matters).
 
 from __future__ import annotations
 
-import math
 from typing import Dict
 
 import jax
@@ -35,8 +34,8 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..models.llama import (Params, full_attention_layer, rms_norm,
-                            rope_freqs)
+from ..models.llama import (Params, embed_tokens, full_attention_layer,
+                            project_logits, rms_norm, rope_freqs)
 
 # params stacked on a leading layer axis get that axis stage-sharded;
 # everything else (embed, final norm, head) is replicated
@@ -74,7 +73,7 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh,
                                   "needs both PP and EP")
     M = num_microbatches
     inv_freq = rope_freqs(cfg)
-    scale = 1.0 / math.sqrt(cfg.head_dim_)
+    scale = cfg.attn_scale
 
     def _local_layers(h, lp_stack):
         """Run this stage's layer slice (leading axis L/S) over h
@@ -108,7 +107,8 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh,
             recv, outbuf = carry
             # stage 0 injects microbatch t (clamped once the injection
             # phase is over; the result is masked out by collection)
-            emb = params["embed"][tokens[jnp.clip(t, 0, M - 1)]]
+            emb = embed_tokens(params, cfg,
+                               tokens[jnp.clip(t, 0, M - 1)])
             my_in = jnp.where(ax == 0, emb, recv)
             out = _local_layers(my_in, lp_stack)
             # last stage collects microbatch t-(S-1) once it emerges
@@ -129,11 +129,9 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh,
         (_, outbuf), _ = lax.scan(tick, (recv0, outbuf0),
                                   jnp.arange(S + M - 1))
 
-        h = rms_norm(outbuf, params["ln_final"], cfg.rms_norm_eps)
-        head = params.get("lm_head")
-        if head is None:
-            head = params["embed"].T
-        logits = (h @ head).astype(jnp.float32)
+        h = rms_norm(outbuf, params["ln_final"], cfg.rms_norm_eps,
+                     cfg.norm_unit_offset)
+        logits = project_logits(params, cfg, h)
         # only the last stage holds real outputs; masked psum replicates
         logits = jnp.where(ax == S - 1, logits, 0.0)
         return lax.psum(logits, "stage")

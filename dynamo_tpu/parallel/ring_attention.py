@@ -28,7 +28,6 @@ plus last-position logits.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import Tuple
 
@@ -134,11 +133,12 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
     :func:`scatter_prefill_kv`, or ship to the decode mesh via the disagg
     transfer plane). ``positions`` are absolute; -1 marks padding.
     """
-    from ..models.llama import (_mlp, _moe_mlp, apply_rope, rms_norm,
+    from ..models.llama import (_act, _mlp, _moe_mlp, apply_rope,
+                                embed_tokens, project_logits, rms_norm,
                                 rope_freqs)
 
     inv_freq = rope_freqs(cfg)
-    scale = 1.0 / math.sqrt(cfg.head_dim_)
+    scale = cfg.attn_scale
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
 
     act_spec = NamedSharding(mesh, P("data", seq_axis, None))
@@ -146,7 +146,7 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
     @jax.jit
     def long_prefill(params, tokens, positions):
         B, T = tokens.shape
-        h = params["embed"][tokens]
+        h = embed_tokens(params, cfg, tokens)
         h = lax.with_sharding_constraint(h, act_spec)
         safe_pos = jnp.maximum(positions, 0)
 
@@ -159,7 +159,7 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
         layer_params = {kk: params[kk] for kk in keys}
 
         def layer(h, lp):
-            x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+            x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.norm_unit_offset)
             xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
             if cfg.attn_bias:  # Qwen2-style qkv bias (matches llama.forward)
                 xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
@@ -169,26 +169,23 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
             attn = ring_attention(q, k, v, positions, mesh, scale=scale,
                                   seq_axis=seq_axis)
             h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
-            x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+            x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
             if cfg.num_experts > 0:
                 h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"],
                                  lp["w_up"], lp["w_down"],
                                  cfg.num_experts_per_tok)
             else:
-                h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+                h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"],
+                             _act(cfg))
             h = lax.with_sharding_constraint(h, act_spec)
             return h, (k, v)
 
         h, (k_all, v_all) = lax.scan(layer, h, layer_params)
-        h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+        h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps, cfg.norm_unit_offset)
         # logits at the true last token of each row (max position)
         last_idx = jnp.argmax(positions, axis=1)
         h_last = h[jnp.arange(B), last_idx]
-        head = params.get("lm_head")
-        if head is None:
-            head = params["embed"].T
-        logits = (h_last @ head).astype(jnp.float32)
-        return logits, k_all, v_all
+        return project_logits(params, cfg, h_last), k_all, v_all
 
     return long_prefill
 

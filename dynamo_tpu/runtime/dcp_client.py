@@ -455,12 +455,15 @@ class KeepaliveThread:
                         # revoked) — renewing cannot resurrect it, and the
                         # worker's lease-attached records are already
                         # deleted. Surface loudly and stop; the owner
-                        # must re-attach to get a new identity.
-                        log.error(
-                            "lease %x is gone (%s): keepalive stopping — "
-                            "this worker's instance records are deleted; "
-                            "re-attach to rejoin discovery",
-                            self.lease, e)
+                        # must re-attach to get a new identity. (During
+                        # shutdown the revoke races a final renewal —
+                        # that's the expected quiet path, not an error.)
+                        if not self._stop.is_set():
+                            log.error(
+                                "lease %x is gone (%s): keepalive "
+                                "stopping — this worker's instance "
+                                "records are deleted; re-attach to "
+                                "rejoin discovery", self.lease, e)
                         self.dead = True
                         return
                     await self._drop(client)

@@ -122,7 +122,11 @@ class DistributedRuntime:
     async def shutdown(self) -> None:
         self.runtime.shutdown()
         if self._keepalive_task:
-            self._keepalive_task.cancel()
+            # cancel() joins the keepalive thread, which may sit in an
+            # in-flight renewal RPC for up to its timeout — run it in the
+            # default executor so this loop keeps serving meanwhile
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._keepalive_task.cancel)
         try:
             await self.dcp.lease_revoke(self.primary_lease)
         except Exception:
