@@ -110,7 +110,6 @@ class ModelConfig:
             c.norm_unit_offset = True
             c.hidden_act = "gelu_tanh"
             c.tie_word_embeddings = cfg.get("tie_word_embeddings", True)
-            c.rope_theta = cfg.get("rope_theta", 10000.0)
             if mt == "gemma2":
                 # Gemma-2 additionally uses sandwich norms (pre/post
                 # feed-forward layernorms, post-attention norm AFTER the

@@ -200,3 +200,30 @@ def test_sampling_greedy_and_seeded():
                        jnp.asarray(b3.seeds), jnp.int32(0))
     np.testing.assert_array_equal(np.asarray(t4),
                                   np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_softcap_and_attn_scale_knobs():
+    """The Gemma-2-forward-looking knobs are exercised directly (no HF
+    checkpoint can set them yet — gemma2 loading is refused — but the
+    logit softcap and attention-scale override must not bit-rot in the
+    hot logit path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.llama import init_params, reference_forward
+
+    cap = 5.0
+    cfg = ModelConfig.tiny(final_logit_softcap=cap,
+                           query_pre_attn_scalar=64.0)
+    assert abs(cfg.attn_scale - 0.125) < 1e-9  # 1/sqrt(64), not head_dim
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.arange(1, 9)[None, :])
+    logits = np.asarray(reference_forward(params, cfg, tokens))
+    assert np.all(np.abs(logits) < cap)  # tanh-capped
+    # and the cap actually changes values vs the uncapped config
+    cfg0 = ModelConfig.tiny(query_pre_attn_scalar=64.0)
+    base = np.asarray(reference_forward(params, cfg0, tokens))
+    expect = cap * np.tanh(base / cap)
+    np.testing.assert_allclose(logits, expect, rtol=1e-5, atol=1e-5)

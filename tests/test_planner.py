@@ -144,3 +144,63 @@ def test_planner_emits_and_applies(run_async):
     assert heard and heard[0]["component"] == "pool"
     assert stored and stored[0]["desired_replicas"] == 4
     assert new_spec["spec"]["services"]["pool"]["replicas"] == 4
+
+
+def test_elastic_loop_end_to_end(run_async):
+    """The full elastic-scaling loop: planner --apply edits the stored
+    deployment spec (CAS in the control-plane KV) → the same spec renders
+    as a DynamoDeployment CR → the K8s reconcile controller converges the
+    fake cluster's Deployment to the advised replica count. Decide
+    (planner) and actuate (controller) meet in the middle."""
+    from tests.test_k8s_controller import FakeKube
+    from dynamo_tpu.k8s.controller import Reconciler
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    async def scenario():
+        drt = await DistributedRuntime.detached()
+        drt2 = await DistributedRuntime.attach(drt.dcp.address)
+        workers = [MockWorker(d, component="pool", seed=3,
+                              hit_rate_interval=9e9) for d in (drt, drt2)]
+        for w in workers:
+            await w.start()
+        for i in range(30):  # deep queue → scale-up pressure
+            await drt.dcp.queue_put("dynamo.pq", pack({"i": i}))
+
+        cr = {"apiVersion": "dynamo-tpu.dev/v1alpha1",
+              "kind": "DynamoDeployment",
+              "metadata": {"name": "graph", "namespace": "serving",
+                           "uid": "u1"},
+              "spec": {"graph": "examples.llm.graphs.agg:Frontend",
+                       "services": {"pool": {"replicas": 2}}}}
+        await drt.dcp.kv_put("deployments/graph", pack(cr))
+
+        planner = Planner(
+            drt, "dynamo",
+            [WatchTarget(component="pool", queue="pq", deployment="graph",
+                         service="pool",
+                         config=PlannerConfig(max_replicas=8))],
+            apply=True, clock=lambda: 0.0)
+        await planner.start()
+        planner._task.cancel()
+        advs = await planner.tick()
+        await planner.stop()
+        new_cr = unpack(await drt.dcp.kv_get("deployments/graph"))
+
+        for w in workers:
+            await w.stop()
+        await drt2.shutdown()
+        await drt.shutdown()
+        return advs, new_cr
+
+    advs, new_cr = run_async(scenario())
+    assert advs and advs[0].direction == "up"
+    desired = advs[0].desired_replicas
+    assert new_cr["spec"]["services"]["pool"]["replicas"] == desired
+
+    # the spec the planner wrote IS a CR the controller converges
+    kube = FakeKube()
+    kube.create("DynamoDeployment", "serving", new_cr)
+    Reconciler(kube).reconcile_all("serving")
+    dep = kube.get("Deployment", "serving", "graph-pool")
+    assert dep is not None
+    assert dep["spec"]["replicas"] == desired
