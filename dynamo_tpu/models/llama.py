@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.paged_attention import paged_attention_decode
+from ..ops.paged_attention import (paged_attention_decode,
+                                   paged_attention_prefill)
 from .config import ModelConfig
 
 Params = Dict[str, jax.Array]
@@ -260,6 +261,13 @@ def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         lengths = q_positions[:, 0] + 1  # padding rows: -1 → 0 → zeros out
         return paged_attention_decode(q[:, 0], k_pages, v_pages, page_table,
                                       lengths, scale=scale)[:, None]
+    if (q.shape[1] > 1 and allow_pallas and _use_pallas()
+            and os.environ.get("DYN_PREFILL_PALLAS")):
+        # opt-in flash prefill (any non-empty value, like the sibling
+        # DYN_DISABLE_PALLAS flag): pages stream through VMEM instead of
+        # the XLA path's dense [B, P*ps, KV, hd] gather per layer
+        return paged_attention_prefill(q, k_pages, v_pages, page_table,
+                                       q_positions, scale=scale)
     return _paged_attention(q, k_pages, v_pages, page_table, q_positions,
                             scale)
 

@@ -242,3 +242,122 @@ def paged_attention_decode_layered(q: jax.Array, k_pools: jax.Array,
     if return_stats:
         return out, res[1][:, :, 0], res[2][:, :, 0]
     return out
+
+
+# ------------------------------------------------------- prefill kernel
+
+
+def _prefill_kernel(ps: int, scale: float,
+                    pt_ref, len_ref,                     # scalar prefetch
+                    q_ref, qpos_ref, k_ref, v_ref, o_ref,
+                    m_ref, l_ref, acc_ref):
+    """Chunked-prefill flash attention over the paged pool.
+
+    Per (b, kv) the query chunk stays VMEM-resident while pages stream
+    in (grid innermost axis); online softmax runs per query row. The
+    causal structure is positional: kv slot j of table entry p holds
+    logical position p*ps+j, visible to query t iff <= q_position[t].
+    """
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    T, group, hd = q_ref.shape
+
+    @pl.when(p == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b]
+
+    @pl.when(p * ps < length)  # pages past the row's extent: no compute
+    def _():
+        q = q_ref[...].astype(jnp.float32).reshape(T * group, hd)
+        k = k_ref[...].astype(jnp.float32)             # [ps, hd]
+        v = v_ref[...].astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [T*group, ps]
+        s = s.reshape(T, group, ps)
+        kv_pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        q_pos = qpos_ref[...].reshape(T, 1, 1)
+        s = jnp.where(kv_pos <= q_pos, s, NEG_INF)     # causal + padding
+
+        m_prev = m_ref[...].reshape(T, group, 1)
+        l_prev = l_ref[...].reshape(T, group, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p_exp = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p_exp, axis=2, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_exp.reshape(T * group, ps), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [T*group, hd]
+        acc_ref[...] = (acc_ref[...] * alpha.reshape(T * group, 1) + pv)
+        m_ref[...] = m_new.reshape(T, group)
+        l_ref[...] = l_new.reshape(T, group)
+
+    @pl.when(p == pl.num_programs(2) - 1)
+    def _():
+        l = jnp.maximum(l_ref[...].reshape(T * group, 1), 1e-9)
+        o_ref[...] = (acc_ref[...] / l).reshape(T, group, hd).astype(
+            o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention_prefill(q: jax.Array, k_pages: jax.Array,
+                            v_pages: jax.Array, page_table: jax.Array,
+                            q_positions: jax.Array, *,
+                            scale: float | None = None,
+                            interpret: bool = False) -> jax.Array:
+    """Chunked-prefill paged GQA attention (flash form).
+
+    q: [B, T, H, hd] (the current chunk); k_pages/v_pages:
+    [num_pages, KV, ps, hd] — the chunk's K/V already written;
+    page_table: [B, P]; q_positions: [B, T] absolute (-1 padding).
+    Returns [B, T, H, hd] in q.dtype, numerically matching the XLA
+    gather path (models/llama.py _paged_attention) which materializes
+    a dense [B, P*ps, KV, hd] copy per layer; here pages stream through
+    VMEM once. Opt-in via DYN_PREFILL_PALLAS (see llama._attention).
+    """
+    B, T, H, hd = q.shape
+    _, KV, ps, _ = k_pages.shape
+    P = page_table.shape[1]
+    group = H // KV
+    if scale is None:
+        scale = hd ** -0.5
+    q5 = q.reshape(B, T, KV, group, hd).transpose(0, 2, 1, 3, 4)
+    # pages to visit per row: those covering [0, max position]
+    lengths = jnp.max(q_positions, axis=1) + 1  # [B]; all-pad rows → 0
+
+    def page_index(b, kv, p, pt, ln):
+        return (jnp.where(p * ps < ln[b], pt[b, p], pt[b, 0]), kv, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, P),
+        in_specs=[
+            pl.BlockSpec((None, None, T, group, hd),
+                         lambda b, kv, p, pt, ln: (b, kv, 0, 0, 0)),
+            pl.BlockSpec((None, T), lambda b, kv, p, pt, ln: (b, 0)),
+            pl.BlockSpec((None, None, ps, hd), page_index),
+            pl.BlockSpec((None, None, ps, hd), page_index),
+        ],
+        out_specs=pl.BlockSpec((None, None, T, group, hd),
+                               lambda b, kv, p, pt, ln: (b, kv, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((T, group), jnp.float32),
+            pltpu.VMEM((T, group), jnp.float32),
+            pltpu.VMEM((T * group, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, ps, scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, T, group, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q5, q_positions.astype(jnp.int32), k_pages, v_pages)
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, T, H, hd)

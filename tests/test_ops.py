@@ -116,3 +116,66 @@ def test_pool_window_merge_matches_xla():
             np.testing.assert_allclose(np.asarray(got)[:3],
                                        np.asarray(want)[:3],
                                        rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("group,hd,T", [(2, 16, 8), (4, 32, 16)])
+def test_prefill_kernel_matches_gather(group, hd, T):
+    """Flash prefill over pages == the XLA gather path: chunk starting
+    mid-sequence (prefix already cached), per-row distinct positions,
+    padding rows, trailing invalid pages."""
+    import numpy as np
+
+    from dynamo_tpu.models.llama import _paged_attention
+    from dynamo_tpu.ops.paged_attention import paged_attention_prefill
+
+    rng = np.random.RandomState(0)
+    B, KV, ps, N, P = 3, 2, 4, 32, 6
+    H = KV * group
+    q = jnp.asarray(rng.randn(B, T, H, hd), jnp.float32)
+    k_pages = jnp.asarray(rng.randn(N, KV, ps, hd), jnp.float32)
+    v_pages = jnp.asarray(rng.randn(N, KV, ps, hd), jnp.float32)
+    table = np.zeros((B, P), np.int32)
+    table[0, :4] = [3, 7, 2, 9]          # 2 prefix pages + chunk pages
+    table[1, :2] = [11, 4]
+    # row 2: padding row (all positions -1)
+    positions = np.full((B, T), -1, np.int32)
+    positions[0] = np.arange(8, 8 + T)   # chunk starts at position 8
+    positions[1] = np.arange(T)
+    q_pos = jnp.asarray(positions)
+
+    want = _paged_attention(q, k_pages, v_pages, jnp.asarray(table),
+                            q_pos, 0.3)
+    got = paged_attention_prefill(q, k_pages, v_pages, jnp.asarray(table),
+                                  q_pos, scale=0.3, interpret=True)
+    # padding rows: XLA path masks everything -> softmax over -inf gives
+    # uniform garbage; the kernel returns zeros. Compare live rows only,
+    # and assert the kernel's padding rows are exactly zero.
+    np.testing.assert_allclose(np.asarray(got[:2]), np.asarray(want[:2]),
+                               rtol=2e-5, atol=2e-5)
+    assert np.all(np.asarray(got[2]) == 0.0)
+
+
+def test_prefill_kernel_bf16():
+    import numpy as np
+
+    from dynamo_tpu.models.llama import _paged_attention
+    from dynamo_tpu.ops.paged_attention import paged_attention_prefill
+
+    rng = np.random.RandomState(1)
+    B, KV, group, ps, hd, N, P, T = 2, 2, 2, 4, 16, 16, 4, 8
+    H = KV * group
+    q = jnp.asarray(rng.randn(B, T, H, hd), jnp.bfloat16)
+    k_pages = jnp.asarray(rng.randn(N, KV, ps, hd), jnp.bfloat16)
+    v_pages = jnp.asarray(rng.randn(N, KV, ps, hd), jnp.bfloat16)
+    table = np.zeros((B, P), np.int32)
+    table[0, :3] = [1, 5, 9]
+    table[1, :2] = [2, 8]
+    positions = np.stack([np.arange(4, 4 + T), np.arange(T)])
+    want = _paged_attention(q, k_pages, v_pages, jnp.asarray(table),
+                            jnp.asarray(positions), 0.25)
+    got = paged_attention_prefill(q, k_pages, v_pages, jnp.asarray(table),
+                                  jnp.asarray(positions), scale=0.25,
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=2e-2, atol=2e-2)
