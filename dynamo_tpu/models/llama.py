@@ -261,13 +261,22 @@ def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         lengths = q_positions[:, 0] + 1  # padding rows: -1 → 0 → zeros out
         return paged_attention_decode(q[:, 0], k_pages, v_pages, page_table,
                                       lengths, scale=scale)[:, None]
-    if (q.shape[1] > 1 and allow_pallas and _use_pallas()
-            and os.environ.get("DYN_PREFILL_PALLAS")):
+    # CPU test hook: DYN_PALLAS_INTERPRET drives the kernel-in-engine
+    # path in interpret mode — but NEVER on a real TPU backend (a
+    # lingering env var must not silently interpret-mode a hardware
+    # bench), and never past the DYN_DISABLE_PALLAS kill switch
+    interp = (bool(os.environ.get("DYN_PALLAS_INTERPRET"))
+              and not os.environ.get("DYN_DISABLE_PALLAS")
+              and not _use_pallas())
+    if (q.shape[1] > 1 and allow_pallas
+            and os.environ.get("DYN_PREFILL_PALLAS")
+            and (_use_pallas() or interp)):
         # opt-in flash prefill (any non-empty value, like the sibling
         # DYN_DISABLE_PALLAS flag): pages stream through VMEM instead of
         # the XLA path's dense [B, P*ps, KV, hd] gather per layer
         return paged_attention_prefill(q, k_pages, v_pages, page_table,
-                                       q_positions, scale=scale)
+                                       q_positions, scale=scale,
+                                       interpret=interp)
     return _paged_attention(q, k_pages, v_pages, page_table, q_positions,
                             scale)
 
