@@ -225,14 +225,11 @@ class JaxEngine:
             self.params = shard_params(self.params, model_cfg, mesh)
             self.kv_k, self.kv_v = shard_kv_cache(self.kv_k, self.kv_v,
                                                   model_cfg, mesh)
-        # prefill + K=1 decode: the raw pallas_call has no GSPMD
-        # partitioning rule, so those paths keep the XLA fallback when the
-        # pool is mesh-sharded. The fused decode WINDOW (the serving hot
-        # path) keeps the kernel under TP via a shard_map over the head
-        # axis (paged_attention_decode_sharded).
-        allow_pallas = mesh is None or mesh.size == 1
+        # all three attention paths (prefill, K=1 decode, fused decode
+        # window) keep the Pallas kernel under a mesh via shard_map over
+        # the head axis (ops/paged_attention.py *_sharded wrappers)
         self.prefill_fn, self.decode_fn = model.make_step_fns(
-            model_cfg, allow_pallas=allow_pallas)
+            model_cfg, mesh=mesh)
         if mesh is not None and mesh.size > 1:
             d = mesh.shape.get("data", 1)
             bad = [b for b in self.ecfg.batch_buckets if b % d]
@@ -248,7 +245,7 @@ class JaxEngine:
                 model_cfg, True, self.ecfg.max_top_k, mesh=mesh)
         else:
             self.decode_multi_fn = _make_decode_multi(
-                model, model_cfg, allow_pallas, self.ecfg.max_top_k)
+                model, model_cfg, self.ecfg.max_top_k, mesh=mesh)
         # sequence-parallel long-prefill (ring attention over the mesh's
         # "seq" axis) — the serving wire-up of parallel/ring_attention.py
         # (r2 built it but nothing reached it; VERDICT r2 missing #5)
@@ -1420,8 +1417,8 @@ class RemoteReservation:
         return self.cached_tokens // self.page_size
 
 
-def _make_decode_multi(model, cfg: ModelConfig, allow_pallas: bool,
-                       max_top_k: int):
+def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
+                       mesh=None):
     """Fused K-step decode: forward → on-device sample → feed back, K
     times inside one jitted program, with the sequence carry (tok, pos,
     done, steps, remaining) staying on device so windows pipeline without
@@ -1456,7 +1453,7 @@ def _make_decode_multi(model, cfg: ModelConfig, allow_pallas: bool,
             slot = jnp.where(active, page * ps + pos % ps, DROP_SLOT)
             h, kv_k, kv_v = model.forward(
                 params, cfg, tok[:, None], pos[:, None], kv_k, kv_v,
-                page_table, slot[:, None], allow_pallas=allow_pallas)
+                page_table, slot[:, None], mesh=mesh)
             logits = logits_at(params, cfg, h, jnp.zeros(B, jnp.int32))
             nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
                                 steps, max_top_k=max_top_k)

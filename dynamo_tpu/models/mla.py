@@ -150,11 +150,11 @@ def _mla_attention(q_lat, q_rope, c_pages, r_pages, page_table,
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             positions: jax.Array, kv_lat: jax.Array, kv_rope: jax.Array,
             page_table: jax.Array, flat_slots: jax.Array,
-            allow_pallas: bool = True,
+            allow_pallas: bool = True, mesh=None,
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Same signature/contract as llama.forward; (kv_k, kv_v) ≡
     (latent pool, rope pool)."""
-    del allow_pallas  # latent attention is XLA-einsum based throughout
+    del allow_pallas, mesh  # latent attention is XLA-einsum throughout
     inv_freq = rope_freqs(cfg, dim=cfg.qk_rope_head_dim)
     H = cfg.num_heads
     r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
@@ -216,9 +216,12 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return h, new_c, new_r
 
 
-def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True):
-    """Jitted (prefill_step, decode_step); same contract as llama."""
-    del allow_pallas
+def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True, mesh=None):
+    """Jitted (prefill_step, decode_step); same contract as llama.
+    Latent attention is XLA-einsum based throughout, so the pallas/mesh
+    kernel knobs are accepted for interface parity and ignored (GSPMD
+    shards the einsums directly)."""
+    del allow_pallas, mesh
 
     @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
     def prefill_step(params, tokens, positions, kv_k, kv_v, page_table,

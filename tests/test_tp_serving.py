@@ -226,3 +226,93 @@ def test_long_prompt_takes_ring_path(run_async):
 
     run_async(gen_short(engine2))
     assert engine2.long_prefills_total == 0
+
+
+def _prefill_inputs(B, P, T, ps):
+    """Shared prefill batch: distinct pages per row (page 0 reserved)."""
+    toks = np.tile(np.arange(2, T + 2, dtype=np.int32)[None], (B, 1))
+    pos = np.tile(np.arange(T, dtype=np.int32)[None], (B, 1))
+    table = np.zeros((B, P), np.int32)
+    slots = np.zeros((B, T), np.int32)
+    for b in range(B):
+        table[b] = np.arange(1 + b * P, 1 + (b + 1) * P)
+        posn = np.arange(T)
+        slots[b] = table[b][posn // ps] * ps + posn % ps
+    return (jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(table),
+            jnp.asarray(slots), jnp.full(B, T - 1, jnp.int32))
+
+
+def test_sharded_prefill_kernel_matches_unsharded(monkeypatch):
+    """Flash prefill kernel under TP (VERDICT r3 task 5): prefill_step on
+    a data=2 x model=2 mesh routes through
+    paged_attention_prefill_sharded (interpret mode) and its logits + KV
+    pool writes match the unsharded XLA gather path."""
+    monkeypatch.setenv("DYN_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("DYN_PREFILL_PALLAS", "1")
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=64,
+                           hidden_size=64, vocab_size=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    spec = llama.KVCacheSpec(num_pages=64, page_size=4)
+    B, P, T = 4, 4, 12
+    toks, pos, table, slots, last = _prefill_inputs(B, P, T, 4)
+
+    kv_k, kv_v = llama.init_kv_cache(cfg, spec)
+    pre_ref, _ = llama.make_step_fns(cfg, allow_pallas=False)
+    lg_ref, kv_k_ref, kv_v_ref = pre_ref(params, toks, pos, kv_k, kv_v,
+                                         table, slots, last)
+
+    mesh = MeshSpec(data=2, model=2).build()
+    sp = shard_params(params, cfg, mesh)
+    kv_k2, kv_v2 = shard_kv_cache(*llama.init_kv_cache(cfg, spec), cfg, mesh)
+    pre_tp, _ = llama.make_step_fns(cfg, mesh=mesh)
+    sb = shard_batch(mesh, tokens=toks, positions=pos, page_table=table,
+                     flat_slots=slots, last_idx=last)
+    lg_tp, kv_k_tp, kv_v_tp = pre_tp(sp, sb["tokens"], sb["positions"],
+                                     kv_k2, kv_v2, sb["page_table"],
+                                     sb["flat_slots"], sb["last_idx"])
+
+    np.testing.assert_allclose(np.asarray(lg_tp), np.asarray(lg_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(kv_k_tp), np.asarray(kv_k_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(kv_v_tp), np.asarray(kv_v_ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sharded_k1_decode_kernel_matches_unsharded(monkeypatch):
+    """K=1 decode kernel under TP (VERDICT r3 task 5): decode_step on a
+    data=2 x model=2 mesh routes through paged_attention_decode_sharded
+    (interpret mode) and matches the unsharded XLA path."""
+    monkeypatch.setenv("DYN_PALLAS_INTERPRET", "1")
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=64,
+                           hidden_size=64, vocab_size=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    spec = llama.KVCacheSpec(num_pages=64, page_size=4)
+    B, P, T = 4, 4, 12
+    toks, pos, table, slots, last = _prefill_inputs(B, P, T, 4)
+
+    def seed(kv):
+        pre, _ = llama.make_step_fns(cfg, allow_pallas=False)
+        _, k, v = pre(params, toks, pos, *kv, table, slots, last)
+        return k, v
+
+    d_toks = jnp.asarray(np.arange(5, 5 + B, dtype=np.int32))
+    d_pos = jnp.full(B, T, jnp.int32)
+    d_slots = jnp.asarray(np.asarray(table)[:, T // 4] * 4 + T % 4,
+                          jnp.int32)
+
+    kv_ref = seed(llama.init_kv_cache(cfg, spec))
+    _, dec_ref = llama.make_step_fns(cfg, allow_pallas=False)
+    lg_ref, _, _ = dec_ref(params, d_toks, d_pos, *kv_ref, table, d_slots)
+
+    mesh = MeshSpec(data=2, model=2).build()
+    sp = shard_params(params, cfg, mesh)
+    kv_tp = shard_kv_cache(*seed(llama.init_kv_cache(cfg, spec)), cfg, mesh)
+    _, dec_tp = llama.make_step_fns(cfg, mesh=mesh)
+    sb = shard_batch(mesh, tokens=d_toks, positions=d_pos, page_table=table,
+                     flat_slots=d_slots)
+    lg_tp, _, _ = dec_tp(sp, sb["tokens"], sb["positions"], *kv_tp,
+                         sb["page_table"], sb["flat_slots"])
+
+    np.testing.assert_allclose(np.asarray(lg_tp), np.asarray(lg_ref),
+                               rtol=2e-5, atol=2e-5)
