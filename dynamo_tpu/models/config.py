@@ -48,6 +48,12 @@ class ModelConfig:
     hidden_act: str = "silu"       # "silu" | "gelu_tanh"
     query_pre_attn_scalar: Optional[float] = None  # attn scale override
     final_logit_softcap: Optional[float] = None
+    # Gemma-2 only: sandwich norms (post-attention + pre/post-feedforward
+    # norms around each residual add), tanh softcap on attention logits,
+    # and sliding-window attention on even-indexed layers
+    sandwich_norms: bool = False
+    attn_logit_softcap: Optional[float] = None
+    sliding_window: Optional[int] = None
     dtype: str = "bfloat16"
 
     @property
@@ -111,16 +117,16 @@ class ModelConfig:
             c.hidden_act = "gelu_tanh"
             c.tie_word_embeddings = cfg.get("tie_word_embeddings", True)
             if mt == "gemma2":
-                # Gemma-2 additionally uses sandwich norms (pre/post
-                # feed-forward layernorms, post-attention norm AFTER the
-                # residual), sliding-window attention on alternate
-                # layers, and attention-logit softcapping — none of
-                # which the Llama stack implements. Loading it here would
-                # produce silently-wrong logits, so refuse outright.
-                raise NotImplementedError(
-                    "gemma2 checkpoints are not supported (sandwich "
-                    "norms + sliding-window attention + attention "
-                    "softcap are unimplemented); gemma-1 is")
+                # Gemma-2 adds sandwich norms (post-attention norm on the
+                # attention output, pre/post-feedforward norms), sliding-
+                # window attention on even layers, logit softcaps, and an
+                # explicit attention-scale denominator
+                c.model_type = "gemma2"
+                c.sandwich_norms = True
+                c.sliding_window = cfg.get("sliding_window", 4096)
+                c.attn_logit_softcap = cfg.get("attn_logit_softcapping")
+                c.final_logit_softcap = cfg.get("final_logit_softcapping")
+                c.query_pre_attn_scalar = cfg.get("query_pre_attn_scalar")
         return c
 
     @classmethod

@@ -107,6 +107,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
         p["bq"] = jnp.zeros((L, H * hd), dtype)
         p["bk"] = jnp.zeros((L, KV * hd), dtype)
         p["bv"] = jnp.zeros((L, KV * hd), dtype)
+    if cfg.sandwich_norms:  # Gemma-2 post-attention/feedforward norms
+        p["ln_attn_post"] = norm_init(ks[8], L, D)
+        p["ln_mlp_post"] = norm_init(ks[8], L, D)
     if not cfg.tie_word_embeddings:
         p["lm_head"] = w_init(ks[9], D, V)
     if cfg.num_experts > 0:
@@ -251,10 +254,36 @@ def _use_pallas() -> bool:
         return False
 
 
+def _softcap_mask(scores: jax.Array, visible: jax.Array,
+                  softcap: Optional[float]) -> jax.Array:
+    """Gemma-2 attention-score postprocess: tanh softcap (BEFORE masking —
+    -1e30 through tanh would collapse to -softcap and unmask), then the
+    visibility mask. ``visible`` broadcasts against ``scores``."""
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
+    return jnp.where(visible, scores, -1e30)
+
+
+def _visible(kv_pos: jax.Array, q_pos: jax.Array,
+             window: Optional[int], is_sliding) -> jax.Array:
+    """Causal visibility of kv position j to query position t, with the
+    optional Gemma-2 sliding window: on sliding layers only the last
+    ``window`` positions (j > t - window) are visible. ``is_sliding`` is
+    a traced bool scalar (layer parity under lax.scan)."""
+    vis = kv_pos <= q_pos
+    if window is not None:
+        in_win = kv_pos > q_pos - window
+        vis = jnp.logical_and(vis, jnp.logical_or(
+            jnp.logical_not(is_sliding), in_win))
+    return vis
+
+
 def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                page_table: jax.Array, q_positions: jax.Array,
                scale: float, allow_pallas: bool = True,
-               mesh=None) -> jax.Array:
+               mesh=None, softcap: Optional[float] = None,
+               window: Optional[int] = None,
+               is_sliding=False) -> jax.Array:
     """Dispatch: decode (T==1) on TPU → Pallas flash kernel over pages;
     otherwise the XLA gather path. With a >1-device ``mesh`` the kernel
     runs per model-shard via shard_map (heads follow their kv heads —
@@ -271,7 +300,10 @@ def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     B, T, H, hd = q.shape
     KV = k_pages.shape[1]
     sharded = mesh is not None and mesh.size > 1
-    pallas_ok = allow_pallas and (_use_pallas() or interp)
+    # the Pallas kernels implement plain causal GQA only; Gemma-2's score
+    # softcap / sliding window take the XLA gather path
+    pallas_ok = (allow_pallas and (_use_pallas() or interp)
+                 and softcap is None and window is None)
     if sharded:
         # shard_map needs whole GQA groups and whole batch rows per shard;
         # shapes are static at trace time so this is a compile-time choice
@@ -302,12 +334,15 @@ def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                                        q_positions, scale=scale,
                                        interpret=interp)
     return _paged_attention(q, k_pages, v_pages, page_table, q_positions,
-                            scale)
+                            scale, softcap=softcap, window=window,
+                            is_sliding=is_sliding)
 
 
 def _paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                      page_table: jax.Array, q_positions: jax.Array,
-                     scale: float) -> jax.Array:
+                     scale: float, softcap: Optional[float] = None,
+                     window: Optional[int] = None,
+                     is_sliding=False) -> jax.Array:
     """Gather-based paged GQA attention (XLA path; the Pallas kernel in
     dynamo_tpu/ops/paged_attention.py replaces this on TPU hot paths).
 
@@ -335,8 +370,10 @@ def _paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     scores = jnp.einsum("btkgh,bskh->bkgts", qg, k,
                         preferred_element_type=jnp.float32) * scale
     # mask [B, T, S]: slot j (logical position) visible iff j <= query pos
-    mask = (jnp.arange(S)[None, None, :] <= q_positions[:, :, None])
-    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    # (and within the sliding window on Gemma-2 sliding layers)
+    mask = _visible(jnp.arange(S)[None, None, :], q_positions[:, :, None],
+                    window, is_sliding)
+    scores = _softcap_mask(scores, mask[:, None, None, :, :], softcap)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
@@ -348,6 +385,40 @@ def _paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 
 def _mlp(h: jax.Array, w_gate, w_up, w_down, act=jax.nn.silu) -> jax.Array:
     return (act(h @ w_gate) * (h @ w_up)) @ w_down
+
+
+def _layer_keys(cfg: ModelConfig) -> list:
+    """Per-layer param names scanned over the stacked-layer axis — the
+    single source for every forward variant (paged, fused window, full)."""
+    keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+            "ln_attn", "ln_mlp"]
+    if cfg.num_experts > 0:
+        keys.append("w_router")
+    if cfg.attn_bias:
+        keys += ["bq", "bk", "bv"]
+    if cfg.sandwich_norms:
+        keys += ["ln_attn_post", "ln_mlp_post"]
+    return keys
+
+
+def _residual_add(h: jax.Array, out: jax.Array, lp, post_key: str,
+                  cfg: ModelConfig) -> jax.Array:
+    """Residual add, with the Gemma-2 sandwich norm on the branch output
+    (post_attention_layernorm / post_feedforward_layernorm) when the
+    config uses them."""
+    if cfg.sandwich_norms:
+        out = rms_norm(out, lp[post_key], cfg.rms_norm_eps,
+                       cfg.norm_unit_offset)
+    return h + out
+
+
+def _sliding_flag(cfg: ModelConfig, l_idx):
+    """Traced per-layer sliding-window flag: Gemma-2 applies the window on
+    even-indexed layers only (HF Gemma2DecoderLayer
+    ``is_sliding = not bool(layer_idx % 2)``)."""
+    if cfg.sliding_window is None:
+        return False
+    return (l_idx % 2) == 0
 
 
 def _moe_mlp(h: jax.Array, w_router, w_gate, w_up, w_down,
@@ -398,16 +469,10 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     act = _act(cfg)
     safe_pos = jnp.maximum(positions, 0)
 
-    layer_keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                  "ln_attn", "ln_mlp"]
-    if cfg.num_experts > 0:
-        layer_keys.append("w_router")
-    if cfg.attn_bias:
-        layer_keys += ["bq", "bk", "bv"]
-    layer_params = {k: params[k] for k in layer_keys}
+    layer_params = {k: params[k] for k in _layer_keys(cfg)}
 
     def layer(h, xs):
-        lp, k_layer, v_layer = xs
+        lp, l_idx, k_layer, v_layer = xs
         x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.norm_unit_offset)
         xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
         if cfg.attn_bias:
@@ -424,17 +489,23 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             k_layer = _scatter_pages(k_layer, k, flat_slots)
             v_layer = _scatter_pages(v_layer, v, flat_slots)
         attn = _attention(q, k_layer, v_layer, page_table, positions, scale,
-                          allow_pallas=allow_pallas, mesh=mesh)
-        h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
+                          allow_pallas=allow_pallas, mesh=mesh,
+                          softcap=cfg.attn_logit_softcap,
+                          window=cfg.sliding_window,
+                          is_sliding=_sliding_flag(cfg, l_idx))
+        h = _residual_add(h, attn.reshape(B, T, H * hd) @ lp["wo"], lp,
+                          "ln_attn_post", cfg)
         x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
         if cfg.num_experts > 0:
-            h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
-                             lp["w_down"], cfg.num_experts_per_tok)
+            mlp_out = _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
+                               lp["w_down"], cfg.num_experts_per_tok)
         else:
-            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"], act)
+            mlp_out = _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"], act)
+        h = _residual_add(h, mlp_out, lp, "ln_mlp_post", cfg)
         return h, (k_layer, v_layer)
 
-    h, (new_k, new_v) = lax.scan(layer, h, (layer_params, kv_k, kv_v))
+    h, (new_k, new_v) = lax.scan(
+        layer, h, (layer_params, jnp.arange(cfg.num_layers), kv_k, kv_v))
     h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     return h, new_k, new_v
 
@@ -557,17 +628,12 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
     # path in interpret mode for CPU parity tests.
     tp = mesh.shape.get("model", 1) if mesh is not None else 1
     sharded = mesh is not None and mesh.size > 1
+    # Gemma-2's score softcap / sliding window aren't implemented in the
+    # Pallas kernel; those configs decode on the XLA pool+window path
     use_pallas = (allow_pallas and (_use_pallas() or pallas_interpret)
-                  and cfg.num_kv_heads % max(tp, 1) == 0)
-
-    def _layer_keys():
-        keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                "ln_attn", "ln_mlp"]
-        if cfg.num_experts > 0:
-            keys.append("w_router")
-        if cfg.attn_bias:
-            keys += ["bq", "bk", "bv"]
-        return keys
+                  and cfg.num_kv_heads % max(tp, 1) == 0
+                  and cfg.attn_logit_softcap is None
+                  and cfg.sliding_window is None)
 
     @partial(jax.jit, static_argnames=("k_steps",),
              donate_argnames=("kv_k", "kv_v"))
@@ -581,7 +647,7 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
         wdt = kv_k.dtype
         wk = jnp.zeros((L, B, k_steps, KV, hd), wdt)
         wv = jnp.zeros((L, B, k_steps, KV, hd), wdt)
-        layer_params = {k: params[k] for k in _layer_keys()}
+        layer_params = {k: params[k] for k in _layer_keys(cfg)}
 
         act = _act(cfg)
 
@@ -614,16 +680,22 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
                 else:
                     attn = _pool_window_attention(
                         q, kv_k[l_idx], kv_v[l_idx], page_table, start,
-                        wk_l, wv_l, i, scale)
-                h = h + attn.reshape(B, 1, H * hd) @ lp["wo"]
+                        wk_l, wv_l, i, scale,
+                        softcap=cfg.attn_logit_softcap,
+                        window=cfg.sliding_window,
+                        is_sliding=_sliding_flag(cfg, l_idx),
+                        q_pos=safe_pos[:, 0])
+                h = _residual_add(h, attn.reshape(B, 1, H * hd) @ lp["wo"],
+                                  lp, "ln_attn_post", cfg)
                 x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
                 if cfg.num_experts > 0:
-                    h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"],
-                                     lp["w_up"], lp["w_down"],
-                                     cfg.num_experts_per_tok)
+                    mlp_out = _moe_mlp(x, lp["w_router"], lp["w_gate"],
+                                       lp["w_up"], lp["w_down"],
+                                       cfg.num_experts_per_tok)
                 else:
-                    h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"],
-                                 act)
+                    mlp_out = _mlp(x, lp["w_gate"], lp["w_up"],
+                                   lp["w_down"], act)
+                h = _residual_add(h, mlp_out, lp, "ln_mlp_post", cfg)
                 return h, (wk_l, wv_l)
 
             h, (wk, wv) = lax.scan(
@@ -714,12 +786,17 @@ def _pool_window_attention_pallas(q, k_pools, v_pools, l_idx, page_table,
 
 
 def _pool_window_attention(q, k_pool_l, v_pool_l, page_table, start,
-                           wk_l, wv_l, i: int, scale):
+                           wk_l, wv_l, i: int, scale,
+                           softcap=None, window=None, is_sliding=False,
+                           q_pos=None):
     """Decode attention reading the (frozen) paged pool for positions
     < start plus the in-flight window for positions start..start+i.
 
     q: [B, 1, H, hd]; *_pool_l: [pages, KV, ps, hd]; wk_l/wv_l:
-    [B, K, KV, hd]; start: [B]; i: static step index."""
+    [B, K, KV, hd]; start: [B]; i: static step index. The Gemma-2 knobs
+    (score softcap, sliding window on is_sliding layers, with ``q_pos``
+    [B] the current query position) ride this XLA path — the Pallas
+    window kernel doesn't implement them."""
     B, _, H, hd = q.shape
     _, KV, ps, _ = k_pool_l.shape
     K = wk_l.shape[1]
@@ -736,8 +813,16 @@ def _pool_window_attention(q, k_pool_l, v_pool_l, page_table, start,
                     wk_l.astype(jnp.float32)) * scale  # [B,KV,G,1,K]
     mask_p = (jnp.arange(S)[None, :] < start[:, None])  # start<0 → all off
     mask_w = (jnp.arange(K)[None, :] <= i) & (start[:, None] >= 0)
-    sp = jnp.where(mask_p[:, None, None, None, :], sp, -1e30)
-    sw = jnp.where(mask_w[:, None, None, None, :], sw, -1e30)
+    if window is not None:
+        # sliding layers see only kv positions > q_pos - window; pool
+        # slot j holds logical position j, window slot w holds start + w
+        keep = jnp.logical_not(is_sliding)
+        mask_p &= keep | (jnp.arange(S)[None, :]
+                          > (q_pos - window)[:, None])
+        mask_w &= keep | ((start[:, None] + jnp.arange(K)[None, :])
+                          > (q_pos - window)[:, None])
+    sp = _softcap_mask(sp, mask_p[:, None, None, None, :], softcap)
+    sw = _softcap_mask(sw, mask_w[:, None, None, None, :], softcap)
     s = jnp.concatenate([sp, sw], axis=-1)
     p = jax.nn.softmax(s, axis=-1)
     pp, pw = p[..., :S], p[..., S:]
@@ -752,11 +837,13 @@ def _pool_window_attention(q, k_pool_l, v_pool_l, page_table, start,
 
 def full_attention_layer(cfg: ModelConfig, h: jax.Array, lp: Params,
                          pos: jax.Array, inv_freq: jax.Array,
-                         scale: float) -> jax.Array:
+                         scale: float, is_sliding=False) -> jax.Array:
     """One transformer layer with plain causal full attention (no paged
     cache). The single source of the layer math for every non-paged
     consumer: ``reference_forward`` (test oracle) and the
-    pipeline-parallel stage body (parallel/pipeline_parallel.py)."""
+    pipeline-parallel stage body (parallel/pipeline_parallel.py).
+    ``is_sliding`` is the traced Gemma-2 per-layer window flag (the
+    caller owns the layer-parity bookkeeping — see _sliding_flag)."""
     B, T = h.shape[:2]
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.norm_unit_offset)
@@ -769,19 +856,22 @@ def full_attention_layer(cfg: ModelConfig, h: jax.Array, lp: Params,
     qg = q.reshape(B, T, KV, H // KV, hd)
     scores = jnp.einsum("btkgh,bskh->bkgts", qg.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
-    causal = jnp.tril(jnp.ones((T, T), bool))
-    scores = jnp.where(causal[None, None, None], scores, -1e30)
+    mask = _visible(jnp.arange(T)[None, None, :],
+                    jnp.arange(T)[None, :, None],
+                    cfg.sliding_window, is_sliding)  # [1, T, T]
+    scores = _softcap_mask(scores, mask[:, None, None],
+                           cfg.attn_logit_softcap)
     probs = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum("bkgts,bskh->btkgh", probs, v.astype(jnp.float32))
     attn = attn.reshape(B, T, H * hd).astype(h.dtype)
-    h = h + attn @ lp["wo"]
+    h = _residual_add(h, attn @ lp["wo"], lp, "ln_attn_post", cfg)
     x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     if cfg.num_experts > 0:
-        h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
-                         lp["w_down"], cfg.num_experts_per_tok)
+        mlp_out = _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
+                           lp["w_down"], cfg.num_experts_per_tok)
     else:
-        h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"], _act(cfg))
-    return h
+        mlp_out = _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"], _act(cfg))
+    return _residual_add(h, mlp_out, lp, "ln_mlp_post", cfg)
 
 
 def reference_forward(params: Params, cfg: ModelConfig,
@@ -794,17 +884,15 @@ def reference_forward(params: Params, cfg: ModelConfig,
     pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     h = embed_tokens(params, cfg, tokens)
 
-    layer_keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                  "ln_attn", "ln_mlp"]
-    if cfg.num_experts > 0:
-        layer_keys.append("w_router")
-    if cfg.attn_bias:
-        layer_keys += ["bq", "bk", "bv"]
-    layer_params = {k: params[k] for k in layer_keys}
+    layer_params = {k: params[k] for k in _layer_keys(cfg)}
 
-    def layer(h, lp):
-        return full_attention_layer(cfg, h, lp, pos, inv_freq, scale), None
+    def layer(h, xs):
+        lp, l_idx = xs
+        return full_attention_layer(cfg, h, lp, pos, inv_freq, scale,
+                                    is_sliding=_sliding_flag(cfg, l_idx)), \
+            None
 
-    h, _ = lax.scan(layer, h, layer_params)
+    h, _ = lax.scan(layer, h,
+                    (layer_params, jnp.arange(cfg.num_layers)))
     h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     return project_logits(params, cfg, h)

@@ -66,7 +66,19 @@ def load_params(path: str, cfg: Optional[ModelConfig] = None,
         return np.stack([fn(fmt.format(i)) for i in range(L)])
 
     p["ln_attn"] = stack("model.layers.{}.input_layernorm.weight", get)
-    p["ln_mlp"] = stack("model.layers.{}.post_attention_layernorm.weight", get)
+    if cfg.sandwich_norms:
+        # Gemma-2: post_attention_layernorm normalizes the ATTENTION
+        # OUTPUT (before its residual add); the pre-MLP norm is
+        # pre_feedforward_layernorm
+        p["ln_mlp"] = stack(
+            "model.layers.{}.pre_feedforward_layernorm.weight", get)
+        p["ln_attn_post"] = stack(
+            "model.layers.{}.post_attention_layernorm.weight", get)
+        p["ln_mlp_post"] = stack(
+            "model.layers.{}.post_feedforward_layernorm.weight", get)
+    else:
+        p["ln_mlp"] = stack(
+            "model.layers.{}.post_attention_layernorm.weight", get)
     if cfg.is_mla:
         _load_mla_attention(cfg, p, stack, linear, get)
     else:

@@ -80,6 +80,8 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
         "wo": P(None, "model", None),
         "ln_attn": P(None, None),
         "ln_mlp": P(None, None),
+        "ln_attn_post": P(None, None),  # Gemma-2 sandwich norms
+        "ln_mlp_post": P(None, None),
         "ln_final": P(None),
         "lm_head": P(None, "model"),
     }

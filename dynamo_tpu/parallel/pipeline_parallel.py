@@ -34,13 +34,15 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..models.llama import (Params, embed_tokens, full_attention_layer,
+from ..models.llama import (Params, _layer_keys, _sliding_flag,
+                            embed_tokens, full_attention_layer,
                             project_logits, rms_norm, rope_freqs)
 
 # params stacked on a leading layer axis get that axis stage-sharded;
 # everything else (embed, final norm, head) is replicated
 _STACKED = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-            "ln_attn", "ln_mlp", "bq", "bk", "bv", "w_router")
+            "ln_attn", "ln_mlp", "ln_attn_post", "ln_mlp_post",
+            "bq", "bk", "bv", "w_router")
 
 
 def pp_param_specs(params: Params) -> Dict[str, P]:
@@ -75,23 +77,28 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh,
     inv_freq = rope_freqs(cfg)
     scale = cfg.attn_scale
 
-    def _local_layers(h, lp_stack):
+    def _local_layers(h, lp_stack, layer_off):
         """Run this stage's layer slice (leading axis L/S) over h
-        [b, T, D] — the shared full-attention layer body."""
+        [b, T, D] — the shared full-attention layer body. ``layer_off``
+        is the stage's global layer offset (Gemma-2's sliding-window
+        parity is indexed by GLOBAL layer, not stage-local)."""
         b, T = h.shape[:2]
+        n_local = cfg.num_layers // S
         pos = jnp.broadcast_to(jnp.arange(T)[None, :], (b, T))
 
-        def layer(h, lp):
-            return full_attention_layer(cfg, h, lp, pos, inv_freq,
-                                        scale), None
+        def layer(h, xs):
+            lp, li = xs
+            return full_attention_layer(
+                cfg, h, lp, pos, inv_freq, scale,
+                is_sliding=_sliding_flag(cfg, layer_off + li)), None
 
-        h, _ = lax.scan(layer, h, lp_stack)
+        h, _ = lax.scan(layer, h,
+                        (lp_stack, jnp.arange(n_local)))
         return h
 
-    stacked_keys = [k for k in _STACKED
-                    if k in ("wq", "wk", "wv", "wo", "w_gate", "w_up",
-                             "w_down", "ln_attn", "ln_mlp")
-                    or (cfg.attn_bias and k in ("bq", "bk", "bv"))]
+    # the per-layer key set is owned by llama._layer_keys — PP stages
+    # scan exactly the params the shared layer body consumes
+    stacked_keys = _layer_keys(cfg)
 
     def _fwd(params, tokens):
         """Per-stage body (under shard_map over 'stage'): tokens
@@ -110,7 +117,8 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh,
             emb = embed_tokens(params, cfg,
                                tokens[jnp.clip(t, 0, M - 1)])
             my_in = jnp.where(ax == 0, emb, recv)
-            out = _local_layers(my_in, lp_stack)
+            out = _local_layers(my_in, lp_stack,
+                                ax * (cfg.num_layers // S))
             # last stage collects microbatch t-(S-1) once it emerges
             oidx = t - (S - 1)
             oidx_c = jnp.clip(oidx, 0, M - 1)
