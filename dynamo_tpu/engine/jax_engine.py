@@ -253,13 +253,20 @@ class JaxEngine:
         self.long_prefills_total = 0
         if (self.ecfg.long_prefill_threshold is not None
                 and mesh is not None and mesh.shape.get("seq", 1) > 1):
-            if model_cfg.is_mla:
+            if (model_cfg.sliding_window is not None
+                    or model_cfg.attn_logit_softcap is not None):
                 raise ValueError(
-                    "ring long-prefill is not implemented for MLA models "
-                    "(make_long_prefill_fn builds the GQA Llama stack); "
-                    "unset long_prefill_threshold")
-            from ..parallel.ring_attention import make_long_prefill_fn
-            self.long_prefill_fn = make_long_prefill_fn(model_cfg, mesh)
+                    "ring long-prefill implements global causal attention "
+                    "only; Gemma-2's sliding window / score softcap are "
+                    "not wired through the ring exchange — unset "
+                    "long_prefill_threshold")
+            from ..parallel.ring_attention import (make_long_prefill_fn,
+                                                   make_mla_long_prefill_fn)
+            # MLA takes the latent-only ring exchange (only the shared
+            # compressed stream rotates on ICI); GQA rotates per-head K/V
+            builder = (make_mla_long_prefill_fn if model_cfg.is_mla
+                       else make_long_prefill_fn)
+            self.long_prefill_fn = builder(model_cfg, mesh)
             self._seq_par = mesh.shape["seq"]
         self.pm = PageManager(self.ecfg.num_pages, self.ecfg.page_size,
                               host_pages=self.ecfg.host_pages)

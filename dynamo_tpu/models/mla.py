@@ -110,6 +110,19 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
 # ----------------------------------------------------------------- forward
 
 
+def _mla_layer_keys(cfg: ModelConfig) -> list:
+    """Per-layer param names scanned over the stacked-layer axis — shared
+    by forward, reference_forward, and the MLA ring long-prefill
+    (parallel/ring_attention.make_mla_long_prefill_fn)."""
+    keys = ["w_dkv", "kv_norm", "w_uk", "w_uv", "w_o", "w_gate",
+            "w_up", "w_down", "ln_attn", "ln_mlp"]
+    keys += (["w_dq", "q_norm", "w_uq"] if cfg.q_lora_rank > 0
+             else ["w_q"])
+    if cfg.num_experts > 0:
+        keys.append("w_router")
+    return keys
+
+
 def _scatter_rows(cache_layer: jax.Array, new: jax.Array,
                   flat_slots: jax.Array) -> jax.Array:
     """cache_layer: [pages, 1, ps, d]; new: [B, T, d]; flat_slots [B, T]
@@ -165,13 +178,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     h = params["embed"][tokens]
     safe_pos = jnp.maximum(positions, 0)
 
-    layer_keys = ["w_dkv", "kv_norm", "w_uk", "w_uv", "w_o", "w_gate",
-                  "w_up", "w_down", "ln_attn", "ln_mlp"]
-    layer_keys += (["w_dq", "q_norm", "w_uq"] if cfg.q_lora_rank > 0
-                   else ["w_q"])
-    if cfg.num_experts > 0:
-        layer_keys.append("w_router")
-    layer_params = {k: params[k] for k in layer_keys}
+    layer_params = {k: params[k] for k in _mla_layer_keys(cfg)}
 
     def layer(h, xs):
         lp, c_layer, r_layer = xs
@@ -261,13 +268,7 @@ def reference_forward(params: Params, cfg: ModelConfig,
     pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     h = params["embed"][tokens]
 
-    layer_keys = ["w_dkv", "kv_norm", "w_uk", "w_uv", "w_o", "w_gate",
-                  "w_up", "w_down", "ln_attn", "ln_mlp"]
-    layer_keys += (["w_dq", "q_norm", "w_uq"] if cfg.q_lora_rank > 0
-                   else ["w_q"])
-    if cfg.num_experts > 0:
-        layer_keys.append("w_router")
-    layer_params = {k: params[k] for k in layer_keys}
+    layer_params = {k: params[k] for k in _mla_layer_keys(cfg)}
 
     def layer(h, lp):
         x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)

@@ -50,16 +50,20 @@ def _ring_attention_inner(q, k, v, q_pos, kv_pos, *, axis_name: str,
     """Per-device body (runs under shard_map over ``axis_name``).
 
     q: [B, Tq, KV, G, hd] local query chunk (grouped GQA heads);
-    k/v: [B, Tk, KV, hd] local key/value chunk; q_pos/kv_pos: [B, T]
-    absolute positions (-1 = padding). Returns [B, Tq, KV, G, hd].
+    k: [B, Tk, KV, hd]; v: [B, Tk, KV, dv] local key/value chunks —
+    dv may differ from hd (MLA rides this kernel with keys
+    [c_kv | k_rope] of width r+dr and values c_kv of width r);
+    q_pos/kv_pos: [B, T] absolute positions (-1 = padding).
+    Returns [B, Tq, KV, G, dv].
     """
     n = lax.psum(1, axis_name)
     B, Tq, KV, G, hd = q.shape
+    dv = v.shape[-1]
     qf = q.astype(jnp.float32)
 
     m0 = jnp.full((B, KV, G, Tq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, KV, G, Tq), jnp.float32)
-    acc0 = jnp.zeros((B, KV, G, Tq, hd), jnp.float32)
+    acc0 = jnp.zeros((B, KV, G, Tq, dv), jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, _):
@@ -117,6 +121,35 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         out_specs=qspec, check_vma=False,
     )(qg, k, v, positions, positions)
     return out.reshape(B, T, H, hd)
+
+
+def ring_attention_mqa(q: jax.Array, k: jax.Array, v: jax.Array,
+                       positions: jax.Array, mesh: Mesh, *,
+                       scale: float, seq_axis: str = "seq") -> jax.Array:
+    """Ring attention with ONE shared key/value stream (MQA form) — the
+    MLA latent exchange: every query head attends to the same compressed
+    stream, so only [B, T, dk] keys + [B, T, dv] values rotate on ICI
+    (~an order of magnitude less ring traffic than per-head GQA K/V).
+
+    q: [B, T, H, dk]; k: [B, T, dk]; v: [B, T, dv]; positions [B, T]
+    absolute (-1 padding). Query heads shard over "model" (scores are
+    per-head); the shared stream replicates across TP shards — it has no
+    head axis to split. Returns [B, T, H, dv].
+    """
+    B, T, H, dk = q.shape
+    qg = q.reshape(B, T, 1, H, dk)  # KV=1, G=H
+
+    qspec = P("data", seq_axis, None, "model", None)
+    kvspec = P("data", seq_axis, None, None)
+    pspec = P("data", seq_axis)
+
+    inner = partial(_ring_attention_inner, axis_name=seq_axis, scale=scale)
+    out = shard_map(
+        inner, mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec, pspec, pspec),
+        out_specs=qspec, check_vma=False,
+    )(qg, k[:, :, None], v[:, :, None], positions, positions)
+    return out.reshape(B, T, H, -1)
 
 
 # -------------------------------------------- sequence-parallel prefill fn
@@ -186,6 +219,95 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
         last_idx = jnp.argmax(positions, axis=1)
         h_last = h[jnp.arange(B), last_idx]
         return project_logits(params, cfg, h_last), k_all, v_all
+
+    return long_prefill
+
+
+def make_mla_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
+                             seq_axis: str = "seq"):
+    """Sequence-parallel long prefill for the MLA family
+    (models/mla.py): the latent-only ring exchange. Only the shared
+    compressed stream (c_kv [B, T, r] + k_rope [B, T, dr]) rotates on
+    the ring — per-head K/V are never materialized, matching the
+    absorbed decode form.
+
+    Same contract as :func:`make_long_prefill_fn`: ``fn(params, tokens,
+    positions) -> (logits [B, V], c_all, r_all)`` with c_all/r_all
+    [L, B, T, 1, r|dr] — KV-head axis fixed at 1 exactly like the MLA
+    paged pools (mla.cache_shapes), so the engine's generic
+    :func:`scatter_prefill_kv` commits them unchanged.
+    """
+    import math
+
+    from ..models.llama import apply_rope, rms_norm, rope_freqs
+    from ..models.mla import _mla_layer_keys
+    from ..models.llama import _mlp, _moe_mlp, project_logits
+
+    inv_freq = rope_freqs(cfg, dim=cfg.qk_rope_head_dim)
+    H = cfg.num_heads
+    r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+    scale = 1.0 / math.sqrt(dn + dr)
+
+    act_spec = NamedSharding(mesh, P("data", seq_axis, None))
+
+    @jax.jit
+    def long_prefill(params, tokens, positions):
+        B, T = tokens.shape
+        h = params["embed"][tokens]
+        h = lax.with_sharding_constraint(h, act_spec)
+        safe_pos = jnp.maximum(positions, 0)
+        layer_params = {k: params[k] for k in _mla_layer_keys(cfg)}
+
+        def layer(h, lp):
+            x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+            if cfg.q_lora_rank > 0:
+                q_all = rms_norm(x @ lp["w_dq"], lp["q_norm"],
+                                 cfg.rms_norm_eps) @ lp["w_uq"]
+            else:
+                q_all = x @ lp["w_q"]
+            q_all = q_all.reshape(B, T, H, dn + dr)
+            q_nope, q_rope = q_all[..., :dn], q_all[..., dn:]
+            q_rope = apply_rope(q_rope, safe_pos, inv_freq)
+            ckr = x @ lp["w_dkv"]
+            c_kv = rms_norm(ckr[..., :r], lp["kv_norm"], cfg.rms_norm_eps)
+            k_rope = apply_rope(ckr[..., None, r:], safe_pos,
+                                inv_freq)[..., 0, :]
+            # absorbed queries + concatenated shared stream: scores =
+            # q_lat·c + q_rope·k_rope in ONE MQA ring pass
+            w_uk = lp["w_uk"].reshape(r, H, dn)
+            q_lat = jnp.einsum("bthd,rhd->bthr",
+                               q_nope.astype(jnp.float32),
+                               w_uk.astype(jnp.float32))
+            q_cat = jnp.concatenate(
+                [q_lat, q_rope.astype(jnp.float32)], axis=-1)
+            k_cat = jnp.concatenate(
+                [c_kv.astype(jnp.float32),
+                 k_rope.astype(jnp.float32)], axis=-1)
+            out_lat = ring_attention_mqa(
+                q_cat, k_cat, c_kv.astype(jnp.float32), positions, mesh,
+                scale=scale, seq_axis=seq_axis)  # [B, T, H, r]
+            w_uv = lp["w_uv"].reshape(r, H, dv)
+            out = jnp.einsum("bthr,rhd->bthd", out_lat,
+                             w_uv.astype(jnp.float32))
+            h = h + out.reshape(B, T, H * dv).astype(h.dtype) @ lp["w_o"]
+            x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+            if cfg.num_experts > 0:
+                h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"],
+                                 lp["w_up"], lp["w_down"],
+                                 cfg.num_experts_per_tok)
+            else:
+                h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+            h = lax.with_sharding_constraint(h, act_spec)
+            return h, (c_kv.astype(h.dtype), k_rope.astype(h.dtype))
+
+        h, (c_all, r_all) = lax.scan(layer, h, layer_params)
+        h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
+        last_idx = jnp.argmax(positions, axis=1)
+        h_last = h[jnp.arange(B), last_idx]
+        # KV-head axis = 1, matching the MLA paged pools
+        return (project_logits(params, cfg, h_last),
+                c_all[:, :, :, None], r_all[:, :, :, None])
 
     return long_prefill
 
