@@ -155,6 +155,13 @@ def parse_args():
     ap.add_argument("--turns", type=int, default=4)
     ap.add_argument("--max-batch", type=int, default=None,
                     help="override engine max_batch (and batch buckets)")
+    ap.add_argument("--sweep", default=None,
+                    help="batch-geometry sweep (VERDICT r3 task 3): comma-"
+                         "separated conc:max_batch:decode_steps triples, "
+                         "e.g. '32:64:4,64:64:8,128:128:16' — runs the "
+                         "headline workload at each point, prints one "
+                         "result line per point to stderr and a summary "
+                         "table, then the best point's record as THE line")
     return ap.parse_args()
 
 
@@ -479,6 +486,55 @@ async def run_disagg(args):
     return report
 
 
+def _run_sweep(args) -> dict:
+    """Batch-geometry sweep over (concurrency, max_batch, decode_steps):
+    one engine per distinct (max_batch, decode_steps) — separately warmed
+    and torn down so pools don't stack in HBM — measuring the headline
+    workload at each point. Proves (or spends) the 'remaining headroom is
+    batch geometry' claim from the round-3 notes with data instead of a
+    roofline argument."""
+    import copy
+
+    points = []
+    for spec in args.sweep.split(","):
+        conc, mb, ds = (int(x) for x in spec.strip().split(":"))
+        points.append((conc, mb, ds))
+    rows = []
+    for conc, mb, ds in points:
+        a = copy.copy(args)
+        a.concurrency, a.max_batch, a.decode_steps = conc, mb, ds
+        # more requests than 2 concurrency waves so steady-state dominates
+        a.requests = max(args.requests, 2 * conc)
+        print(f"--- sweep point conc={conc} max_batch={mb} "
+              f"decode_steps={ds} ---", file=sys.stderr)
+        try:
+            rep = asyncio.run(run_bench(a))
+        except Exception as e:  # one bad point must not kill the sweep
+            print(f"sweep point failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            continue
+        rows.append({"concurrency": conc, "max_batch": mb,
+                     "decode_steps": ds, **rep})
+        print(json.dumps(rows[-1]), file=sys.stderr)
+    if not rows:
+        raise RuntimeError("every sweep point failed")
+    hdr = (f"{'conc':>5} {'max_b':>5} {'K':>3} {'out tok/s':>10} "
+           f"{'ttft_p50':>9} {'itl_p50':>8} {'err':>4}")
+    print(hdr, file=sys.stderr)
+    for r in rows:
+        print(f"{r['concurrency']:>5} {r['max_batch']:>5} "
+              f"{r['decode_steps']:>3} {r['output_tok_per_s']:>10} "
+              f"{r['ttft_p50_ms']:>9} {r['itl_p50_ms']:>8} "
+              f"{r['errors']:>4}", file=sys.stderr)
+    best = max(rows, key=lambda r: r["output_tok_per_s"])
+    return {"metric": "output tokens/s, best of batch-geometry sweep "
+                      f"(ISL~{args.isl}/OSL {args.osl}, {args.model} "
+                      "llama, 1 chip)",
+            "value": best["output_tok_per_s"], "unit": "tok/s",
+            "vs_baseline": 1.0,
+            "detail": {"best": best, "sweep": rows}}
+
+
 def main():
     args = parse_args()
     watchdog = None
@@ -514,6 +570,8 @@ def main():
 
 
 def _run_scenario(args) -> dict:
+    if args.sweep:
+        return _run_sweep(args)
     if args.scenario == "multiturn":
         report = asyncio.run(run_multiturn(args))
         return {"metric": metric_name(args),

@@ -61,16 +61,31 @@ def _owned_fields_drifted(want: Any, have: Any) -> bool:
         # drift.
         if not isinstance(have, list):
             return True
-        if want and all(isinstance(w, dict) and "name" in w for w in want):
-            # named-element lists (containers, env, ports): match by name
-            # like server-side-apply, so a webhook PRE/APPENDING an
-            # element (injected sidecar) doesn't misalign the comparison
-            # or read as drift
+        if want and all(isinstance(w, dict) for w in want):
+            # object lists (containers, env, ports): match by name like
+            # server-side-apply, so a webhook PRE/APPENDING an element
+            # (injected sidecar) doesn't misalign the comparison or read
+            # as drift. "name" is OPTIONAL on some of these (single-port
+            # Services) — unnamed wanted elements match in order against
+            # the observed unnamed elements, so a server-appended named
+            # element never re-reads as drift on every reconcile tick
+            # (which would hot-loop replaces against the apiserver)
             by_name = {h.get("name"): h for h in have
                        if isinstance(h, dict)}
-            return any(w["name"] not in by_name
-                       or _owned_fields_drifted(w, by_name[w["name"]])
-                       for w in want)
+            unnamed_have = [h for h in have
+                            if not (isinstance(h, dict) and "name" in h)]
+            ui = 0
+            for w in want:
+                if "name" in w:
+                    if (w["name"] not in by_name
+                            or _owned_fields_drifted(w, by_name[w["name"]])):
+                        return True
+                else:
+                    if (ui >= len(unnamed_have)
+                            or _owned_fields_drifted(w, unnamed_have[ui])):
+                        return True
+                    ui += 1
+            return False
         # scalar/unnamed lists (args, command): the server never appends
         # to these, so any length change — including a kubectl-edit that
         # appends a flag — is drift to heal

@@ -47,6 +47,11 @@ class NoRespondersError(DcpError):
     pass
 
 
+class CasConflict(DcpError):
+    """kv_cas lost the race: the key's mod_rev moved. Raised off the
+    server's structured ``conflict`` flag, not the error text."""
+
+
 class DcpClient:
     """One connection to the DCP server, usable concurrently from many tasks."""
 
@@ -159,6 +164,8 @@ class DcpClient:
             self._pending.pop(seq, None)
         if not resp.get("ok", True):
             err = resp.get("error", "unknown")
+            if resp.get("conflict"):
+                raise CasConflict(err)
             if "no responders" in str(err):
                 raise NoRespondersError(err)
             raise DcpError(err)
@@ -201,10 +208,8 @@ class DcpClient:
             await self._call("kv_put", key=key, value=value, lease=lease,
                              prev_rev=prev_rev)
             return True
-        except DcpError as e:
-            if "cas conflict" in str(e):
-                return False
-            raise
+        except CasConflict:
+            return False
 
     async def kv_get_prefix(self, prefix: str) -> List[KvItem]:
         resp = await self._call("kv_get_prefix", prefix=prefix)
@@ -240,28 +245,13 @@ class DcpClient:
     async def lease_revoke(self, lease: int) -> None:
         await self._call("lease_revoke", lease=lease)
 
-    def spawn_keepalive(self, lease: int, ttl: float,
-                        cancel: Optional[asyncio.Event] = None) -> asyncio.Task:
-        """Background keep-alive tied to a cancel event (reference
-        transports/etcd/lease.rs: keep-alive tied to CancellationToken).
-
-        NOTE: this task lives on the caller's event loop, so synchronous
-        work that blocks the loop for multiples of the TTL (XLA warmup,
-        big host transfers) starves it and the lease expires — use
-        :class:`KeepaliveThread` for leases that must survive loop
-        stalls (DistributedRuntime's primary lease does)."""
-
-        async def _loop():
-            interval = max(ttl / 3.0, 0.1)
-            while cancel is None or not cancel.is_set():
-                await asyncio.sleep(interval)
-                try:
-                    await self.lease_keepalive(lease)
-                except DcpError:
-                    log.warning("lease %x keepalive failed", lease)
-                    return
-
-        return asyncio.create_task(_loop())
+    # NOTE: there is deliberately no loop-resident keepalive helper. An
+    # asyncio-task renewal starves whenever synchronous work blocks the
+    # loop for multiples of the TTL (XLA warmup, bulk host transfers) and
+    # the lease expires — the exact failure the r3 bench hit. Every lease
+    # that must stay alive renews via :class:`KeepaliveThread` (its own
+    # thread + connection); DistributedRuntime's primary lease — the one
+    # all instance/endpoint records attach to — does.
 
     # ----------------------------------------------------------- pub/sub API
 

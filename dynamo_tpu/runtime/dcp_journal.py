@@ -12,9 +12,16 @@ What is durable and what is deliberately NOT:
 
 - **Unleased KV** (model registry, deployment specs, planner advisories,
   router config): durable.
-- **Work queues** (disagg prefill queue): durable — every append and
-  every pop is journaled, so a crash between put and pull loses nothing
-  and double-delivers nothing.
+- **Work queues** (disagg prefill queue): durable at-rest, at-most-once
+  across a crash. Queued items survive restarts (appends and pops are
+  journaled), and nothing is ever double-delivered — but an item IN
+  FLIGHT at the crash can be lost: the pop is journaled before the
+  reply frame flushes, and a put handed directly to a blocked puller
+  never enters the journal at all. The reference's NATS JetStream queue
+  is at-least-once via consumer acks; our single consumer (the prefill
+  worker pool) already treats a lost remote prefill as a local-prefill
+  fallback (llm/disagg/decode.py remote_fallbacks), so redelivery
+  machinery would buy nothing the fallback doesn't.
 - **Leases + lease-attached keys** (endpoint instances, service records):
   ephemeral BY DESIGN. A lease exists to say "this worker is alive right
   now"; the restarted server has no live keep-alive sessions, so

@@ -300,3 +300,36 @@ def test_helm_chart_structure():
     for resource in ("dynamodeployments", "deployments", "services",
                      "configmaps", "dynamodeployments/status"):
         assert resource in rbac_text
+
+
+def test_mixed_and_unnamed_port_lists_tolerate_server_additions():
+    """ADVICE r3: Service port lists where `name` is optional must not
+    re-read a webhook/server-appended element as drift on every tick
+    (that hot-loops replaces against the apiserver)."""
+    from dynamo_tpu.k8s.controller import _owned_fields_drifted
+
+    # single unnamed wanted port; server appended a named metrics port
+    want = {"ports": [{"port": 80, "targetPort": 8080}]}
+    have = {"ports": [{"port": 80, "targetPort": 8080, "protocol": "TCP"},
+                      {"name": "metrics", "port": 9090}]}
+    assert not _owned_fields_drifted(want, have)
+
+    # mixed list: named element matched by name regardless of order
+    want = {"ports": [{"name": "http", "port": 80},
+                      {"port": 7070}]}
+    have = {"ports": [{"port": 7070, "protocol": "TCP"},
+                      {"name": "http", "port": 80},
+                      {"name": "injected", "port": 1}]}
+    assert not _owned_fields_drifted(want, have)
+
+    # a real edit to the unnamed element IS drift
+    have_edited = {"ports": [{"port": 7171, "protocol": "TCP"},
+                             {"name": "http", "port": 80}]}
+    assert _owned_fields_drifted(want, have_edited)
+
+    # a missing named element IS drift
+    assert _owned_fields_drifted(
+        want, {"ports": [{"port": 7070}]})
+
+    # scalar lists stay strict: an appended arg is drift to heal
+    assert _owned_fields_drifted({"args": ["-a"]}, {"args": ["-a", "-b"]})
