@@ -521,10 +521,14 @@ def _run_sweep(args) -> dict:
     hdr = (f"{'conc':>5} {'max_b':>5} {'K':>3} {'out tok/s':>10} "
            f"{'ttft_p50':>9} {'itl_p50':>8} {'err':>4}")
     print(hdr, file=sys.stderr)
+
+    def cell(v, w):  # all-error points report their percentiles as None
+        return f"{'-' if v is None else v:>{w}}"
+
     for r in rows:
         print(f"{r['concurrency']:>5} {r['max_batch']:>5} "
-              f"{r['decode_steps']:>3} {r['output_tok_per_s']:>10} "
-              f"{r['ttft_p50_ms']:>9} {r['itl_p50_ms']:>8} "
+              f"{r['decode_steps']:>3} {cell(r['output_tok_per_s'], 10)} "
+              f"{cell(r['ttft_p50_ms'], 9)} {cell(r['itl_p50_ms'], 8)} "
               f"{r['errors']:>4}", file=sys.stderr)
     best = max(rows, key=lambda r: r["output_tok_per_s"])
     return {"metric": "output tokens/s, best of batch-geometry sweep "

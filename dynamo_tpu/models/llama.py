@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.paged_attention import (paged_attention_decode,
+from ..ops.paged_attention import (effective_window,
+                                   paged_attention_decode,
                                    paged_attention_decode_sharded,
                                    paged_attention_prefill,
                                    paged_attention_prefill_sharded)
@@ -300,28 +301,37 @@ def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     B, T, H, hd = q.shape
     KV = k_pages.shape[1]
     sharded = mesh is not None and mesh.size > 1
-    # the Pallas kernels implement plain causal GQA only; Gemma-2's score
-    # softcap / sliding window take the XLA gather path
-    pallas_ok = (allow_pallas and (_use_pallas() or interp)
-                 and softcap is None and window is None)
+    pallas_ok = allow_pallas and (_use_pallas() or interp)
     if sharded:
         # shard_map needs whole GQA groups and whole batch rows per shard;
         # shapes are static at trace time so this is a compile-time choice
         tp = mesh.shape.get("model", 1)
         dp = mesh.shape.get("data", 1)
         pallas_ok = pallas_ok and KV % tp == 0 and B % dp == 0
+    # Gemma-2 knobs for the kernels: per-row effective window (huge on
+    # global layers — is_sliding is traced layer parity) and the static
+    # score softcap
+    eff = None
+    if window is not None:
+        eff = effective_window(window, is_sliding, B)
     if T == 1 and pallas_ok:
         lengths = q_positions[:, 0] + 1  # padding rows: -1 → 0 → zeros out
+        lower = None
+        if eff is not None:
+            # first visible position; clamped so at least one position of
+            # a live row stays in view (the index map indexes pt[lo//ps])
+            lower = jnp.clip(lengths - eff, 0, jnp.maximum(lengths - 1, 0))
         if sharded:
             out = paged_attention_decode_sharded(
                 q[:, 0], k_pages[None], v_pages[None], 0, page_table,
                 lengths, mesh=mesh, scale=scale, interpret=interp,
-                return_stats=False)
+                return_stats=False, softcap=softcap, lower=lower)
             return out[:, None]
         if _use_pallas():  # unsharded K=1: hardware kernel only (no
             return paged_attention_decode(  # interpret hook needed here)
                 q[:, 0], k_pages, v_pages, page_table,
-                lengths, scale=scale)[:, None]
+                lengths, scale=scale, softcap=softcap,
+                lower=lower)[:, None]
     if (T > 1 and pallas_ok and os.environ.get("DYN_PREFILL_PALLAS")):
         # opt-in flash prefill (any non-empty value, like the sibling
         # DYN_DISABLE_PALLAS flag): pages stream through VMEM instead of
@@ -329,10 +339,12 @@ def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         if sharded:
             return paged_attention_prefill_sharded(
                 q, k_pages, v_pages, page_table, q_positions, mesh=mesh,
-                scale=scale, interpret=interp)
+                scale=scale, interpret=interp, softcap=softcap,
+                eff_win=eff)
         return paged_attention_prefill(q, k_pages, v_pages, page_table,
                                        q_positions, scale=scale,
-                                       interpret=interp)
+                                       interpret=interp, softcap=softcap,
+                                       eff_win=eff)
     return _paged_attention(q, k_pages, v_pages, page_table, q_positions,
                             scale, softcap=softcap, window=window,
                             is_sliding=is_sliding)
@@ -628,12 +640,14 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
     # path in interpret mode for CPU parity tests.
     tp = mesh.shape.get("model", 1) if mesh is not None else 1
     sharded = mesh is not None and mesh.size > 1
-    # Gemma-2's score softcap / sliding window aren't implemented in the
-    # Pallas kernel; those configs decode on the XLA pool+window path
+    # the same CPU test hook _attention honors: engine-level window tests
+    # drive the kernel path in interpret mode (never on a real TPU)
+    pallas_interpret = pallas_interpret or (
+        bool(os.environ.get("DYN_PALLAS_INTERPRET"))
+        and not os.environ.get("DYN_DISABLE_PALLAS")
+        and not _use_pallas())
     use_pallas = (allow_pallas and (_use_pallas() or pallas_interpret)
-                  and cfg.num_kv_heads % max(tp, 1) == 0
-                  and cfg.attn_logit_softcap is None
-                  and cfg.sliding_window is None)
+                  and cfg.num_kv_heads % max(tp, 1) == 0)
 
     @partial(jax.jit, static_argnames=("k_steps",),
              donate_argnames=("kv_k", "kv_v"))
@@ -676,7 +690,11 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
                         q, kv_k, kv_v, l_idx, page_table, start, wk_l,
                         wv_l, i, scale,
                         interpret=pallas_interpret,
-                        mesh=mesh if sharded else None)
+                        mesh=mesh if sharded else None,
+                        softcap=cfg.attn_logit_softcap,
+                        window=cfg.sliding_window,
+                        is_sliding=_sliding_flag(cfg, l_idx),
+                        q_pos=safe_pos[:, 0])
                 else:
                     attn = _pool_window_attention(
                         q, kv_k[l_idx], kv_v[l_idx], page_table, start,
@@ -738,7 +756,9 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
 
 def _pool_window_attention_pallas(q, k_pools, v_pools, l_idx, page_table,
                                   start, wk_l, wv_l, i: int, scale,
-                                  interpret: bool = False, mesh=None):
+                                  interpret: bool = False, mesh=None,
+                                  softcap=None, window=None,
+                                  is_sliding=False, q_pos=None):
     """Decode attention for one fused-window step: the (frozen) paged pool
     via the Pallas flash kernel (stats returned, layer selected by index
     map — no layer-slice materialization), merged with the in-flight
@@ -746,7 +766,11 @@ def _pool_window_attention_pallas(q, k_pools, v_pools, l_idx, page_table,
     the pool; positions start..start+i in the buffer.
 
     q: [B, 1, H, hd]; *_pools: [L, pages, KV, ps, hd]; l_idx: scalar;
-    wk_l/wv_l: [B, K, KV, hd]; start: [B]; i: static step index."""
+    wk_l/wv_l: [B, K, KV, hd]; start: [B]; i: static step index. The
+    Gemma-2 knobs (score softcap; sliding window on is_sliding layers
+    with ``q_pos`` [B] the current query position) apply to BOTH sides:
+    the kernel takes a per-row lower bound — and skips pages the window
+    already slid past — while the buffer side masks in XLA."""
     from ..ops.paged_attention import (NEG_INF,
                                        paged_attention_decode_layered,
                                        paged_attention_decode_sharded)
@@ -756,18 +780,36 @@ def _pool_window_attention_pallas(q, k_pools, v_pools, l_idx, page_table,
     G = H // KV
     K = wk_l.shape[1]
     lengths = jnp.maximum(start, 0)  # pool extent; padding rows (-1) → 0
+    lower = None
+    eff = None
+    if window is not None:
+        eff = effective_window(window, is_sliding, B)
+        # pool side sees [lower, start); a window that slid past the whole
+        # pool (q_pos + 1 - eff >= start) leaves an empty view, which the
+        # kernel's valid-masking returns as (m=NEG_INF, l=0) — the merge
+        # below weights that side by l_p = 0
+        lower = jnp.clip(q_pos + 1 - eff, 0, lengths)
     if mesh is not None:
         out_p, m_p, l_p = paged_attention_decode_sharded(
             q[:, 0], k_pools, v_pools, l_idx, page_table, lengths,
-            mesh=mesh, scale=scale, interpret=interpret)
+            mesh=mesh, scale=scale, interpret=interpret,
+            softcap=softcap, lower=lower)
     else:
         out_p, m_p, l_p = paged_attention_decode_layered(
             q[:, 0], k_pools, v_pools, l_idx, page_table, lengths,
-            scale=scale, return_stats=True, interpret=interpret)
+            scale=scale, return_stats=True, interpret=interpret,
+            softcap=softcap, lower=lower)
     qg = q.reshape(B, KV, G, hd).astype(jnp.float32)
     sw = jnp.einsum("bkgh,bwkh->bkgw", qg,
                     wk_l.astype(jnp.float32)) * scale  # [B, KV, G, K]
+    if softcap:
+        sw = softcap * jnp.tanh(sw / softcap)
     mask_w = (jnp.arange(K)[None, :] <= i) & (start[:, None] >= 0)
+    if eff is not None:
+        # buffer slot w holds position start + w; slot i (the current
+        # token) always stays visible since eff >= 1
+        mask_w &= (start[:, None] + jnp.arange(K)[None, :]
+                   > (q_pos - eff)[:, None])
     sw = jnp.where(mask_w[:, None, None, :], sw, NEG_INF)
     m_w = jnp.max(sw, axis=-1)                         # [B, KV, G]
     p_w = jnp.exp(sw - m_w[..., None])

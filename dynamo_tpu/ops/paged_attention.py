@@ -37,12 +37,23 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # finite "masked" value: keeps exp() NaN-free
+NO_WINDOW = 1 << 30  # "infinite" effective sliding window (int32-safe)
+
+
+def effective_window(window, is_sliding, B: int):
+    """Per-row effective sliding window for the kernels: ``window`` on
+    sliding layers, :data:`NO_WINDOW` on global ones. ``is_sliding`` is
+    a traced scalar bool (Gemma-2 layer parity under lax.scan)."""
+    return jnp.broadcast_to(
+        jnp.where(is_sliding, jnp.int32(window), jnp.int32(NO_WINDOW)),
+        (B,))
 
 
 def _decode_kernel(ps: int, scale: float, return_stats: bool,
+                   softcap: float | None,
                    # scalar prefetch (leading extras ignored: the layered
                    # variant prefetches the layer index first)
-                   pt_ref, len_ref,
+                   pt_ref, len_ref, lo_ref,
                    # blocks (leading dims squeezed by BlockSpec None-dims)
                    q_ref, k_ref, v_ref, o_ref, *rest):
     if return_stats:
@@ -61,8 +72,11 @@ def _decode_kernel(ps: int, scale: float, return_stats: bool,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     length = len_ref[b]
+    lower = lo_ref[b]  # first visible position (sliding window); else 0
 
-    @pl.when(p * ps < length)  # trailing invalid pages: no compute
+    # pages wholly outside [lower, length): no compute (and the index map
+    # re-points them at an already-fetched page, so no HBM traffic)
+    @pl.when(jnp.logical_and(p * ps < length, (p + 1) * ps > lower))
     def _():
         q = q_ref[...].astype(jnp.float32)            # [KV, group, hd]
         k = k_ref[...].astype(jnp.float32)            # [KV, ps, hd]
@@ -72,14 +86,20 @@ def _decode_kernel(ps: int, scale: float, return_stats: bool,
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale  # [KV, group, ps]
+        if softcap:  # Gemma-2 score softcap — BEFORE masking
+            s = softcap * jnp.tanh(s / softcap)
         pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(pos < length, s, NEG_INF)
+        valid = jnp.logical_and(pos >= lower, pos < length)
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:, :1].reshape(KV, group, 1)
         l_prev = l_ref[:, :1].reshape(KV, group, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)                # [KV, group, 1]
-        p_exp = jnp.exp(s - m_new)                     # [KV, group, ps]
+        # exp only where valid: an all-masked page (possible when the
+        # sliding window empties the pool view) would otherwise compute
+        # exp(NEG_INF - NEG_INF) = 1 and corrupt the running sum
+        p_exp = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         l_new = alpha * l_prev + jnp.sum(p_exp, axis=2, keepdims=True)
         pv = jax.lax.dot_general(
             p_exp, v, (((2,), (1,)), ((0,), (0,))),
@@ -99,20 +119,25 @@ def _decode_kernel(ps: int, scale: float, return_stats: bool,
 
 
 def _decode_kernel_layered(ps: int, scale: float, return_stats: bool,
-                           l_ref, pt_ref, len_ref, *refs):
+                           softcap: float | None,
+                           l_ref, pt_ref, len_ref, lo_ref, *refs):
     # layered variant: the layer index rides as the first scalar-prefetch
     # operand (consumed by the BlockSpec index maps); the body is identical
     del l_ref
-    return _decode_kernel(ps, scale, return_stats, pt_ref, len_ref, *refs)
+    return _decode_kernel(ps, scale, return_stats, softcap,
+                          pt_ref, len_ref, lo_ref, *refs)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "interpret", "return_stats"))
+                   static_argnames=("scale", "interpret", "return_stats",
+                                    "softcap"))
 def paged_attention_decode(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, page_table: jax.Array,
                            lengths: jax.Array, *, scale: float | None = None,
                            interpret: bool = False,
-                           return_stats: bool = False):
+                           return_stats: bool = False,
+                           softcap: float | None = None,
+                           lower: jax.Array | None = None):
     """One decode step of paged GQA attention.
 
     q: [B, H, hd]; k_pages/v_pages: [num_pages, KV, ps, hd];
@@ -130,7 +155,7 @@ def paged_attention_decode(q: jax.Array, k_pages: jax.Array,
     return paged_attention_decode_layered(
         q, k_pages[None], v_pages[None], jnp.zeros((), jnp.int32),
         page_table, lengths, scale=scale, interpret=interpret,
-        return_stats=return_stats)
+        return_stats=return_stats, softcap=softcap, lower=lower)
 
 
 def paged_attention_decode_sharded(q: jax.Array, k_pools: jax.Array,
@@ -139,7 +164,9 @@ def paged_attention_decode_sharded(q: jax.Array, k_pools: jax.Array,
                                    lengths: jax.Array, *, mesh,
                                    scale: float | None = None,
                                    interpret: bool = False,
-                                   return_stats: bool = True):
+                                   return_stats: bool = True,
+                                   softcap: float | None = None,
+                                   lower: jax.Array | None = None):
     """Tensor-parallel wrapper: runs the layered kernel per model-shard
     via shard_map over the head axis. The KV pool is sharded
     [L, pages, KV@model, ps, hd] (parallel/mesh.py kv_cache_pspec) and q
@@ -155,10 +182,13 @@ def paged_attention_decode_sharded(q: jax.Array, k_pools: jax.Array,
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    def local(q_, k_, v_, l_, t_, ln_):
+    if lower is None:
+        lower = jnp.zeros_like(lengths)
+
+    def local(q_, k_, v_, l_, t_, ln_, lo_):
         return paged_attention_decode_layered(
             q_, k_, v_, l_, t_, ln_, scale=scale, interpret=interpret,
-            return_stats=return_stats)
+            return_stats=return_stats, softcap=softcap, lower=lo_)
 
     out_specs = (P("data", "model", None), P("data", "model"),
                  P("data", "model")) if return_stats \
@@ -168,22 +198,25 @@ def paged_attention_decode_sharded(q: jax.Array, k_pools: jax.Array,
         in_specs=(P("data", "model", None),
                   P(None, None, "model", None, None),
                   P(None, None, "model", None, None),
-                  P(), P("data", None), P("data")),
+                  P(), P("data", None), P("data"), P("data")),
         out_specs=out_specs,
         check_vma=False,  # pallas_call outputs carry no vma annotation
     )(q, k_pools, v_pools, jnp.asarray(layer, jnp.int32), page_table,
-      lengths)
+      lengths, lower)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "interpret", "return_stats"))
+                   static_argnames=("scale", "interpret", "return_stats",
+                                    "softcap"))
 def paged_attention_decode_layered(q: jax.Array, k_pools: jax.Array,
                                    v_pools: jax.Array, layer: jax.Array,
                                    page_table: jax.Array,
                                    lengths: jax.Array, *,
                                    scale: float | None = None,
                                    interpret: bool = False,
-                                   return_stats: bool = False):
+                                   return_stats: bool = False,
+                                   softcap: float | None = None,
+                                   lower: jax.Array | None = None):
     """paged_attention_decode against ONE layer of the stacked pools.
 
     k_pools/v_pools: [L, num_pages, KV, ps, hd]; ``layer`` a traced int32
@@ -203,26 +236,32 @@ def paged_attention_decode_layered(q: jax.Array, k_pools: jax.Array,
     if scale is None:
         scale = hd ** -0.5
     q4 = q.reshape(B, KV, group, hd)
+    if lower is None:
+        lower = jnp.zeros_like(lengths)
 
-    def page_index(b, p, l, pt, ln):
-        return (l[0], jnp.where(p * ps < ln[b], pt[b, p], pt[b, 0]),
+    def page_index(b, p, l, pt, ln, lo):
+        # pages outside [lower, length) re-point at the first NEEDED page
+        # (index unchanged between steps → Pallas skips the fetch)
+        needed = jnp.logical_and(p * ps < ln[b], (p + 1) * ps > lo[b])
+        first = jnp.minimum(lo[b] // ps, P - 1)
+        return (l[0], jnp.where(needed, pt[b, p], pt[b, first]),
                 0, 0, 0)
 
     out_shape = [jax.ShapeDtypeStruct((B, KV, group, hd), q.dtype)]
     out_specs = [pl.BlockSpec((None, KV, group, hd),
-                              lambda b, p, l, pt, ln: (b, 0, 0, 0))]
+                              lambda b, p, l, pt, ln, lo: (b, 0, 0, 0))]
     if return_stats:
         out_shape += [jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
                       jax.ShapeDtypeStruct((B, H, 128), jnp.float32)]
         out_specs += [pl.BlockSpec((None, H, 128),
-                                   lambda b, p, l, pt, ln: (b, 0, 0))] * 2
+                                   lambda b, p, l, pt, ln, lo: (b, 0, 0))] * 2
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B, P),
         in_specs=[
             pl.BlockSpec((None, KV, group, hd),
-                         lambda b, p, l, pt, ln: (b, 0, 0, 0)),
+                         lambda b, p, l, pt, ln, lo: (b, 0, 0, 0)),
             pl.BlockSpec((None, None, KV, ps, hd), page_index),
             pl.BlockSpec((None, None, KV, ps, hd), page_index),
         ],
@@ -234,7 +273,8 @@ def paged_attention_decode_layered(q: jax.Array, k_pools: jax.Array,
         ],
     )
     res = pl.pallas_call(
-        functools.partial(_decode_kernel_layered, ps, scale, return_stats),
+        functools.partial(_decode_kernel_layered, ps, scale, return_stats,
+                          softcap),
         grid_spec=grid_spec,
         out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
@@ -242,6 +282,7 @@ def paged_attention_decode_layered(q: jax.Array, k_pools: jax.Array,
         interpret=interpret,
     )(jnp.asarray(layer, jnp.int32).reshape(1),
       page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      lower.astype(jnp.int32),
       q4, k_pools, v_pools)
     out = res[0].reshape(B, H, hd)
     if return_stats:
@@ -254,7 +295,10 @@ def paged_attention_prefill_sharded(q: jax.Array, k_pages: jax.Array,
                                     page_table: jax.Array,
                                     q_positions: jax.Array, *, mesh,
                                     scale: float | None = None,
-                                    interpret: bool = False) -> jax.Array:
+                                    interpret: bool = False,
+                                    softcap: float | None = None,
+                                    eff_win: jax.Array | None = None
+                                    ) -> jax.Array:
     """Tensor-parallel chunked-prefill kernel: shard_map over the head
     ("model") and batch ("data") axes, same decomposition as
     paged_attention_decode_sharded — each shard runs the ordinary kernel
@@ -267,26 +311,30 @@ def paged_attention_prefill_sharded(q: jax.Array, k_pages: jax.Array,
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    def local(q_, k_, v_, t_, qp_):
+    if eff_win is None:
+        eff_win = jnp.full((q.shape[0],), jnp.int32(NO_WINDOW))
+
+    def local(q_, k_, v_, t_, qp_, win_):
         return paged_attention_prefill(q_, k_, v_, t_, qp_, scale=scale,
-                                       interpret=interpret)
+                                       interpret=interpret,
+                                       softcap=softcap, eff_win=win_)
 
     return shard_map(
         local, mesh=mesh,
         in_specs=(P("data", None, "model", None),
                   P(None, "model", None, None),
                   P(None, "model", None, None),
-                  P("data", None), P("data", None)),
+                  P("data", None), P("data", None), P("data")),
         out_specs=P("data", None, "model", None),
         check_vma=False,  # pallas_call outputs carry no vma annotation
-    )(q, k_pages, v_pages, page_table, q_positions)
+    )(q, k_pages, v_pages, page_table, q_positions, eff_win)
 
 
 # ------------------------------------------------------- prefill kernel
 
 
-def _prefill_kernel(ps: int, scale: float,
-                    pt_ref, len_ref,                     # scalar prefetch
+def _prefill_kernel(ps: int, scale: float, softcap: float | None,
+                    pt_ref, len_ref, lo_ref, win_ref,    # scalar prefetch
                     q_ref, qpos_ref, k_ref, v_ref, o_ref,
                     m_ref, l_ref, acc_ref):
     """Chunked-prefill flash attention over the paged pool.
@@ -294,7 +342,9 @@ def _prefill_kernel(ps: int, scale: float,
     Per (b, kv) the query chunk stays VMEM-resident while pages stream
     in (grid innermost axis); online softmax runs per query row. The
     causal structure is positional: kv slot j of table entry p holds
-    logical position p*ps+j, visible to query t iff <= q_position[t].
+    logical position p*ps+j, visible to query t iff within
+    (q_position[t] - window, q_position[t]] — window is the per-row
+    effective sliding window (huge when the layer is global).
     """
     b = pl.program_id(0)
     p = pl.program_id(2)
@@ -307,8 +357,11 @@ def _prefill_kernel(ps: int, scale: float,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     length = len_ref[b]
+    lower = lo_ref[b]  # first position any query of the row can see
+    win = win_ref[b]
 
-    @pl.when(p * ps < length)  # pages past the row's extent: no compute
+    # pages wholly outside [lower, length): no compute, no fetch
+    @pl.when(jnp.logical_and(p * ps < length, (p + 1) * ps > lower))
     def _():
         q = q_ref[...].astype(jnp.float32).reshape(T * group, hd)
         k = k_ref[...].astype(jnp.float32)             # [ps, hd]
@@ -318,15 +371,21 @@ def _prefill_kernel(ps: int, scale: float,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [T*group, ps]
         s = s.reshape(T, group, ps)
+        if softcap:  # Gemma-2 score softcap — BEFORE masking
+            s = softcap * jnp.tanh(s / softcap)
         kv_pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
         q_pos = qpos_ref[...].reshape(T, 1, 1)
-        s = jnp.where(kv_pos <= q_pos, s, NEG_INF)     # causal + padding
+        valid = jnp.logical_and(kv_pos <= q_pos,       # causal + padding
+                                kv_pos > q_pos - win)  # sliding window
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[...].reshape(T, group, 1)
         l_prev = l_ref[...].reshape(T, group, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p_exp = jnp.exp(s - m_new)
+        # exp only where valid: an all-masked (t, page) pair (window
+        # already slid past the page) would otherwise add exp(0)=1 rows
+        p_exp = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         l_new = alpha * l_prev + jnp.sum(p_exp, axis=2, keepdims=True)
         pv = jax.lax.dot_general(
             p_exp.reshape(T * group, ps), v, (((1,), (0,)), ((), ())),
@@ -342,12 +401,15 @@ def _prefill_kernel(ps: int, scale: float,
             o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("scale", "interpret",
+                                             "softcap"))
 def paged_attention_prefill(q: jax.Array, k_pages: jax.Array,
                             v_pages: jax.Array, page_table: jax.Array,
                             q_positions: jax.Array, *,
                             scale: float | None = None,
-                            interpret: bool = False) -> jax.Array:
+                            interpret: bool = False,
+                            softcap: float | None = None,
+                            eff_win: jax.Array | None = None) -> jax.Array:
     """Chunked-prefill paged GQA attention (flash form).
 
     q: [B, T, H, hd] (the current chunk); k_pages/v_pages:
@@ -365,24 +427,36 @@ def paged_attention_prefill(q: jax.Array, k_pages: jax.Array,
     if scale is None:
         scale = hd ** -0.5
     q5 = q.reshape(B, T, KV, group, hd).transpose(0, 2, 1, 3, 4)
-    # pages to visit per row: those covering [0, max position]
+    # pages to visit per row: those covering [lower, max position]
     lengths = jnp.max(q_positions, axis=1) + 1  # [B]; all-pad rows → 0
+    if eff_win is None:
+        eff_win = jnp.full((B,), jnp.int32(NO_WINDOW))
+    # first position visible to ANY query of the row: min valid q_pos
+    # minus the window; pages before it are skipped outright
+    minq = jnp.min(jnp.where(q_positions >= 0, q_positions, NO_WINDOW),
+                   axis=1)
+    lower = jnp.clip(minq + 1 - eff_win, 0, jnp.maximum(lengths - 1, 0))
 
-    def page_index(b, kv, p, pt, ln):
-        return (jnp.where(p * ps < ln[b], pt[b, p], pt[b, 0]), kv, 0, 0)
+    def page_index(b, kv, p, pt, ln, lo, win):
+        needed = jnp.logical_and(p * ps < ln[b], (p + 1) * ps > lo[b])
+        first = jnp.minimum(lo[b] // ps, P - 1)
+        return (jnp.where(needed, pt[b, p], pt[b, first]), kv, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4,
         grid=(B, KV, P),
         in_specs=[
             pl.BlockSpec((None, None, T, group, hd),
-                         lambda b, kv, p, pt, ln: (b, kv, 0, 0, 0)),
-            pl.BlockSpec((None, T), lambda b, kv, p, pt, ln: (b, 0)),
+                         lambda b, kv, p, pt, ln, lo, win:
+                         (b, kv, 0, 0, 0)),
+            pl.BlockSpec((None, T),
+                         lambda b, kv, p, pt, ln, lo, win: (b, 0)),
             pl.BlockSpec((None, None, ps, hd), page_index),
             pl.BlockSpec((None, None, ps, hd), page_index),
         ],
         out_specs=pl.BlockSpec((None, None, T, group, hd),
-                               lambda b, kv, p, pt, ln: (b, kv, 0, 0, 0)),
+                               lambda b, kv, p, pt, ln, lo, win:
+                               (b, kv, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((T, group), jnp.float32),
             pltpu.VMEM((T, group), jnp.float32),
@@ -390,12 +464,13 @@ def paged_attention_prefill(q: jax.Array, k_pages: jax.Array,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_prefill_kernel, ps, scale),
+        functools.partial(_prefill_kernel, ps, scale, softcap),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, T, group, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      lower.astype(jnp.int32), eff_win.astype(jnp.int32),
       q5, q_positions.astype(jnp.int32), k_pages, v_pages)
     return out.transpose(0, 2, 1, 3, 4).reshape(B, T, H, hd)

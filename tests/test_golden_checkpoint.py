@@ -249,12 +249,19 @@ def test_gemma2_logits_match_transformers(gemma2_checkpoint):
     np.testing.assert_allclose(ours, theirs, rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.parametrize("kernels", [False, True])
 def test_gemma2_engine_generation_matches_transformers(gemma2_checkpoint,
-                                                       run_async):
-    """Full serving path (paged chunked prefill + fused-window decode,
-    both on the XLA attention fallback the softcap/window force) on a
-    Gemma-2 checkpoint greedy-matches transformers.generate across the
-    sliding-window boundary."""
+                                                       run_async,
+                                                       monkeypatch,
+                                                       kernels):
+    """Full serving path on a Gemma-2 checkpoint greedy-matches
+    transformers.generate across the sliding-window boundary — on the
+    XLA attention paths AND on the Pallas kernel paths (flash prefill +
+    fused-window decode in interpret mode), which implement the score
+    softcap and per-layer sliding window natively."""
+    if kernels:
+        monkeypatch.setenv("DYN_PALLAS_INTERPRET", "1")
+        monkeypatch.setenv("DYN_PREFILL_PALLAS", "1")
     from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
     from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
                                                  SamplingOptions,

@@ -179,3 +179,110 @@ def test_prefill_kernel_bf16():
     np.testing.assert_allclose(np.asarray(got, np.float32),
                                np.asarray(want, np.float32),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_decode_kernel_softcap_and_window_match_gather():
+    """Gemma-2 semantics in the decode kernel: tanh score softcap and a
+    per-row lower bound (sliding window) match the XLA path — including
+    the degenerate all-masked-page case the valid-mask guards."""
+    from dynamo_tpu.models.llama import _paged_attention
+
+    KV, group, hd, ps = 2, 2, 32, 8
+    H = KV * group
+    B, P, num_pages = 4, 4, 32
+    key = jax.random.PRNGKey(7)
+    kq, kp = jax.random.split(key)
+    q = jax.random.normal(kq, (B, H, hd), jnp.float32)
+    k_pages, v_pages = _random_pages(kp, num_pages, ps, KV, hd)
+
+    rng = np.random.RandomState(7)
+    table = np.zeros((B, P), np.int32)
+    lengths = np.array([ps + 3, 2 * ps, P * ps, 5], np.int32)
+    for b in range(B):
+        npages = -(-int(lengths[b]) // ps)
+        table[b, :npages] = rng.choice(
+            np.arange(1, num_pages), npages, replace=False)
+
+    scale = hd ** -0.5
+    window, softcap = 6, 15.0
+    eff = np.full(B, window, np.int32)
+    lower = np.clip(lengths - eff, 0, np.maximum(lengths - 1, 0))
+    got = paged_attention_decode(
+        q, k_pages, v_pages, jnp.asarray(table), jnp.asarray(lengths),
+        scale=scale, interpret=True, softcap=softcap,
+        lower=jnp.asarray(lower))
+
+    positions = jnp.asarray(lengths - 1)[:, None]
+    want = _paged_attention(q[:, None], k_pages, v_pages,
+                            jnp.asarray(table), positions, scale,
+                            softcap=softcap, window=window,
+                            is_sliding=True)[:, 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_kernel_softcap_and_window_match_gather():
+    """Gemma-2 semantics in the flash prefill kernel: softcap + per-row
+    effective window (with page skipping below the window) match the XLA
+    gather path over a chunk longer than the window."""
+    from dynamo_tpu.models.llama import _paged_attention
+    from dynamo_tpu.ops.paged_attention import paged_attention_prefill
+
+    KV, group, hd, ps, T = 2, 2, 32, 8, 24
+    H = KV * group
+    B, P, num_pages = 2, 4, 32
+    key = jax.random.PRNGKey(8)
+    kq, kp = jax.random.split(key)
+    q = jax.random.normal(kq, (B, T, H, hd), jnp.float32)
+    k_pages, v_pages = _random_pages(kp, num_pages, ps, KV, hd)
+
+    rng = np.random.RandomState(8)
+    table = np.zeros((B, P), np.int32)
+    for b in range(B):
+        table[b] = rng.choice(np.arange(1, num_pages), P, replace=False)
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32), (B, T))
+
+    scale = hd ** -0.5
+    window, softcap = 7, 12.0
+    got = paged_attention_prefill(
+        q, k_pages, v_pages, jnp.asarray(table), jnp.asarray(positions),
+        scale=scale, interpret=True, softcap=softcap,
+        eff_win=jnp.full((B,), window, jnp.int32))
+    want = _paged_attention(q, k_pages, v_pages, jnp.asarray(table),
+                            jnp.asarray(positions), scale,
+                            softcap=softcap, window=window,
+                            is_sliding=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_kernel_window_second_chunk_page_skip():
+    """Chunked prefill whose second chunk starts past the window: pages
+    wholly below the window's reach are skipped by the lower-bound guard
+    yet the output still matches the XLA path."""
+    from dynamo_tpu.models.llama import _paged_attention
+    from dynamo_tpu.ops.paged_attention import paged_attention_prefill
+
+    KV, group, hd, ps, T = 1, 2, 32, 4, 8
+    H = KV * group
+    B, P, num_pages = 1, 8, 32
+    key = jax.random.PRNGKey(9)
+    kq, kp = jax.random.split(key)
+    q = jax.random.normal(kq, (B, T, H, hd), jnp.float32)
+    k_pages, v_pages = _random_pages(kp, num_pages, ps, KV, hd)
+    table = np.arange(1, P + 1, dtype=np.int32)[None]
+    # chunk covers positions 20..27; window 6 → nothing below pos 15 is
+    # visible, so pages 0..2 (positions 0..11) are skippable
+    positions = (20 + np.arange(T, dtype=np.int32))[None]
+
+    scale = hd ** -0.5
+    window = 6
+    got = paged_attention_prefill(
+        q, k_pages, v_pages, jnp.asarray(table), jnp.asarray(positions),
+        scale=scale, interpret=True,
+        eff_win=jnp.full((B,), window, jnp.int32))
+    want = _paged_attention(q, k_pages, v_pages, jnp.asarray(table),
+                            jnp.asarray(positions), scale,
+                            window=window, is_sliding=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
