@@ -40,6 +40,7 @@ class ModelConfig:
     qk_rope_head_dim: int = 64
     v_head_dim: int = 128
     attn_bias: bool = False        # qkv projection bias (Qwen2-style)
+    qk_norm: bool = False          # per-head RMSNorm on q/k pre-RoPE (Qwen3)
     # Gemma-family knobs (model_type "gemma"/"gemma2"): scaled embeddings,
     # (1 + w) RMSNorm, GeGLU activation, explicit attention scale, and the
     # Gemma-2 final-logit softcap
@@ -109,6 +110,31 @@ class ModelConfig:
         if mt == "qwen2":
             c.model_type = "llama"  # same decoder shape (GQA + SwiGLU)
             c.attn_bias = True      # qwen2 keeps bias on q/k/v projections
+        if mt in ("qwen3", "qwen3_moe"):
+            # Qwen3 = Llama GQA + per-head q/k RMSNorm (no qkv bias);
+            # the MoE variant routes Mixtral-style (softmax-then-top-k ==
+            # top-k-then-softmax after renorm) with its own expert width
+            c.model_type = "qwen3"
+            c.qk_norm = True
+            if mt == "qwen3_moe":
+                if not cfg.get("norm_topk_prob", False):
+                    # our dense-over-experts MoE normalizes the top-k
+                    # weights (softmax over the selected logits); the
+                    # un-renormalized variant would silently diverge
+                    raise NotImplementedError(
+                        "qwen3_moe with norm_topk_prob=false is not "
+                        "supported (router weights are renormalized)")
+                if (cfg.get("decoder_sparse_step", 1) != 1
+                        or cfg.get("mlp_only_layers")):
+                    # every layer is treated as MoE; interleaved dense
+                    # layers would need per-layer MLP selection
+                    raise NotImplementedError(
+                        "qwen3_moe with dense layers interleaved "
+                        "(decoder_sparse_step != 1 or mlp_only_layers) "
+                        "is not supported")
+                c.num_experts = cfg.get("num_experts", 128)
+                c.num_experts_per_tok = cfg.get("num_experts_per_tok", 8)
+                c.intermediate_size = cfg["moe_intermediate_size"]
         if mt in ("gemma", "gemma2"):
             # Gemma rides the Llama GQA stack with four semantic switches
             c.model_type = "gemma"

@@ -111,6 +111,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
     if cfg.sandwich_norms:  # Gemma-2 post-attention/feedforward norms
         p["ln_attn_post"] = norm_init(ks[8], L, D)
         p["ln_mlp_post"] = norm_init(ks[8], L, D)
+    if cfg.qk_norm:  # Qwen3 per-head q/k norms
+        p["q_norm"] = norm_init(ks[8], L, hd)
+        p["k_norm"] = norm_init(ks[8], L, hd)
     if not cfg.tie_word_embeddings:
         p["lm_head"] = w_init(ks[9], D, V)
     if cfg.num_experts > 0:
@@ -410,6 +413,8 @@ def _layer_keys(cfg: ModelConfig) -> list:
         keys += ["bq", "bk", "bv"]
     if cfg.sandwich_norms:
         keys += ["ln_attn_post", "ln_mlp_post"]
+    if cfg.qk_norm:
+        keys += ["q_norm", "k_norm"]
     return keys
 
 
@@ -422,6 +427,15 @@ def _residual_add(h: jax.Array, out: jax.Array, lp, post_key: str,
         out = rms_norm(out, lp[post_key], cfg.rms_norm_eps,
                        cfg.norm_unit_offset)
     return h + out
+
+
+def _qk_headnorm(q, k, lp, cfg: ModelConfig):
+    """Qwen3 per-head RMSNorm on q/k before RoPE: weights [hd] broadcast
+    over [..., H|KV, hd]. No-op unless cfg.qk_norm."""
+    if not cfg.qk_norm:
+        return q, k
+    return (rms_norm(q, lp["q_norm"], cfg.rms_norm_eps),
+            rms_norm(k, lp["k_norm"], cfg.rms_norm_eps))
 
 
 def _sliding_flag(cfg: ModelConfig, l_idx):
@@ -492,6 +506,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         q = xq.reshape(B, T, H, hd)
         k = xk.reshape(B, T, KV, hd)
         v = xv.reshape(B, T, KV, hd)
+        q, k = _qk_headnorm(q, k, lp, cfg)
         q = apply_rope(q, safe_pos, inv_freq)
         k = apply_rope(k, safe_pos, inv_freq)
         if page_slots is not None:
@@ -680,8 +695,10 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
                 if cfg.attn_bias:
                     xq, xk, xv = (xq + lp["bq"], xk + lp["bk"],
                                   xv + lp["bv"])
-                q = apply_rope(xq.reshape(B, 1, H, hd), safe_pos, inv_freq)
-                k = apply_rope(xk.reshape(B, 1, KV, hd), safe_pos, inv_freq)
+                q, k = _qk_headnorm(xq.reshape(B, 1, H, hd),
+                                    xk.reshape(B, 1, KV, hd), lp, cfg)
+                q = apply_rope(q, safe_pos, inv_freq)
+                k = apply_rope(k, safe_pos, inv_freq)
                 v = xv.reshape(B, 1, KV, hd)
                 wk_l = wk_l.at[:, i].set(k[:, 0].astype(wdt))
                 wv_l = wv_l.at[:, i].set(v[:, 0].astype(wdt))
@@ -892,8 +909,10 @@ def full_attention_layer(cfg: ModelConfig, h: jax.Array, lp: Params,
     xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
     if cfg.attn_bias:
         xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
-    q = apply_rope(xq.reshape(B, T, H, hd), pos, inv_freq)
-    k = apply_rope(xk.reshape(B, T, KV, hd), pos, inv_freq)
+    q, k = _qk_headnorm(xq.reshape(B, T, H, hd),
+                        xk.reshape(B, T, KV, hd), lp, cfg)
+    q = apply_rope(q, pos, inv_freq)
+    k = apply_rope(k, pos, inv_freq)
     v = xv.reshape(B, T, KV, hd)
     qg = q.reshape(B, T, KV, H // KV, hd)
     scores = jnp.einsum("btkgh,bskh->bkgts", qg.astype(jnp.float32),

@@ -90,25 +90,37 @@ def load_params(path: str, cfg: Optional[ModelConfig] = None,
             p["bq"] = stack("model.layers.{}.self_attn.q_proj.bias", get)
             p["bk"] = stack("model.layers.{}.self_attn.k_proj.bias", get)
             p["bv"] = stack("model.layers.{}.self_attn.v_proj.bias", get)
+        if cfg.qk_norm:  # Qwen3 per-head q/k norms
+            p["q_norm"] = stack("model.layers.{}.self_attn.q_norm.weight",
+                                get)
+            p["k_norm"] = stack("model.layers.{}.self_attn.k_norm.weight",
+                                get)
     if cfg.num_experts > 0 and cfg.is_mla:
         raise NotImplementedError(
             "DeepSeek-MoE checkpoint loading (shared experts + dense-first "
             "layers) is not wired yet; dense MLA and Mixtral MoE are")
     if cfg.num_experts > 0:
         E = cfg.num_experts
+        # HF names the MoE block differently per family: Mixtral uses
+        # block_sparse_moe with w1/w3/w2, Qwen3-MoE uses mlp with
+        # gate/up/down_proj
+        if cfg.model_type == "qwen3":
+            moe, w1, w3, w2 = "mlp", "gate_proj", "up_proj", "down_proj"
+        else:
+            moe, w1, w3, w2 = "block_sparse_moe", "w1", "w3", "w2"
         p["w_router"] = stack(
-            "model.layers.{}.block_sparse_moe.gate.weight")
+            "model.layers.{}.%s.gate.weight" % moe)
 
         def experts(proj: str) -> np.ndarray:
             return np.stack([
                 np.stack([linear(
-                    f"model.layers.{i}.block_sparse_moe.experts.{e}.{proj}.weight")
+                    f"model.layers.{i}.{moe}.experts.{e}.{proj}.weight")
                     for e in range(E)])
                 for i in range(L)])
 
-        p["w_gate"] = experts("w1")
-        p["w_up"] = experts("w3")
-        p["w_down"] = experts("w2")
+        p["w_gate"] = experts(w1)
+        p["w_up"] = experts(w3)
+        p["w_down"] = experts(w2)
     else:
         p["w_gate"] = stack("model.layers.{}.mlp.gate_proj.weight")
         p["w_up"] = stack("model.layers.{}.mlp.up_proj.weight")

@@ -82,6 +82,8 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
         "ln_mlp": P(None, None),
         "ln_attn_post": P(None, None),  # Gemma-2 sandwich norms
         "ln_mlp_post": P(None, None),
+        "q_norm": P(None, None),        # Qwen3 per-head q/k norms
+        "k_norm": P(None, None),
         "ln_final": P(None),
         "lm_head": P(None, "model"),
     }

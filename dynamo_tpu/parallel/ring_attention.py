@@ -166,9 +166,9 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
     :func:`scatter_prefill_kv`, or ship to the decode mesh via the disagg
     transfer plane). ``positions`` are absolute; -1 marks padding.
     """
-    from ..models.llama import (_act, _mlp, _moe_mlp, apply_rope,
-                                embed_tokens, project_logits, rms_norm,
-                                rope_freqs)
+    from ..models.llama import (_act, _layer_keys, _mlp, _moe_mlp,
+                                _qk_headnorm, apply_rope, embed_tokens,
+                                project_logits, rms_norm, rope_freqs)
 
     inv_freq = rope_freqs(cfg)
     scale = cfg.attn_scale
@@ -183,21 +183,17 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
         h = lax.with_sharding_constraint(h, act_spec)
         safe_pos = jnp.maximum(positions, 0)
 
-        keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                "ln_attn", "ln_mlp"]
-        if cfg.num_experts > 0:
-            keys.append("w_router")
-        if cfg.attn_bias:
-            keys += ["bq", "bk", "bv"]
-        layer_params = {kk: params[kk] for kk in keys}
+        layer_params = {kk: params[kk] for kk in _layer_keys(cfg)}
 
         def layer(h, lp):
             x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.norm_unit_offset)
             xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
             if cfg.attn_bias:  # Qwen2-style qkv bias (matches llama.forward)
                 xq, xk, xv = xq + lp["bq"], xk + lp["bk"], xv + lp["bv"]
-            q = apply_rope(xq.reshape(B, T, H, hd), safe_pos, inv_freq)
-            k = apply_rope(xk.reshape(B, T, KV, hd), safe_pos, inv_freq)
+            q, k = _qk_headnorm(xq.reshape(B, T, H, hd),
+                                xk.reshape(B, T, KV, hd), lp, cfg)
+            q = apply_rope(q, safe_pos, inv_freq)
+            k = apply_rope(k, safe_pos, inv_freq)
             v = xv.reshape(B, T, KV, hd)
             attn = ring_attention(q, k, v, positions, mesh, scale=scale,
                                   seq_axis=seq_axis)
