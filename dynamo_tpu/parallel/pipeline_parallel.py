@@ -40,9 +40,12 @@ from ..models.llama import (Params, _layer_keys, _sliding_flag,
 
 # params stacked on a leading layer axis get that axis stage-sharded;
 # everything else (embed, final norm, head) is replicated
+# every per-layer param name any config can produce (superset of
+# llama._layer_keys across configs — pp_param_specs has no cfg in hand,
+# it shards whatever per-layer keys are present in the pytree)
 _STACKED = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
             "ln_attn", "ln_mlp", "ln_attn_post", "ln_mlp_post",
-            "bq", "bk", "bv", "w_router")
+            "q_norm", "k_norm", "bq", "bk", "bv", "w_router")
 
 
 def pp_param_specs(params: Params) -> Dict[str, P]:

@@ -57,3 +57,33 @@ def test_pp_rejects_indivisible_layers():
     mesh = MeshSpec(stage=4).build()
     with pytest.raises(ValueError, match="not divisible"):
         make_pp_forward(cfg, mesh)
+
+
+def test_pp_forward_qwen3_qk_norm():
+    """Regression: per-layer q/k norm weights must stage-shard with the
+    rest of the layer stack (a replicated [L, hd] entry desyncs the
+    stage body's lax.scan leading axes)."""
+    import numpy as np
+
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshSpec
+    from dynamo_tpu.parallel.pipeline_parallel import (make_pp_forward,
+                                                       shard_params_pp)
+
+    cfg = ModelConfig.tiny(model_type="qwen3", qk_norm=True, num_layers=4,
+                           num_heads=4, num_kv_heads=2, head_dim=16,
+                           hidden_size=32, vocab_size=128)
+    params_host = llama.init_params(cfg, jax.random.PRNGKey(6))
+    # make the norms non-trivial so a dropped/misapplied norm shows up
+    params_host["q_norm"] = params_host["q_norm"] * 1.5
+    params_host["k_norm"] = params_host["k_norm"] * 0.5
+    mesh = MeshSpec(stage=4, data=2).build()
+    params = shard_params_pp(params_host, mesh)
+    tokens = jnp.asarray(
+        np.random.RandomState(6).randint(1, 100, (4, 8)), jnp.int32)
+    fn = make_pp_forward(cfg, mesh, num_microbatches=2)
+    got = fn(params, tokens)
+    ref = llama.reference_forward(params_host, cfg, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

@@ -1,0 +1,55 @@
+#!/bin/bash
+# One-shot TPU measurement session: runs every queued hardware measurement
+# in VERDICT-priority order, each time-boxed, so a mid-session relay wedge
+# loses the tail instead of everything. Results land in bench_results/
+# (one JSON file per step — the last line of each bench run) plus a full
+# transcript per step.
+#
+# Usage:  bash tools/chip_session.sh [outdir]        (defaults bench_results)
+# Env:    PYTHONPATH must include /root/.axon_site; JAX_PLATFORMS=axon.
+#
+# Priority order (VERDICT r3 "Next round"):
+#   1. headline    — the driver-verified number everything flows through
+#   2. prefill A/B — flash prefill kernel ±DYN_PREFILL_PALLAS (task 2)
+#   3. sweep       — batch geometry roofline (task 3)
+#   4. multiturn   — host-tier TTFT with the overlapped restores (task 4a)
+#   5. disagg      — on-chip A/B with transfer breakdown (task 4b)
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-bench_results}
+mkdir -p "$OUT"
+export PYTHONPATH=${PYTHONPATH:-/root/repo:/root/.axon_site}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-axon}
+
+run_step() {  # name timeout_s args...
+    local name=$1 tmo=$2; shift 2
+    echo "=== [$name] python bench.py $* (timeout ${tmo}s) ==="
+    timeout "$tmo" python bench.py "$@" \
+        > "$OUT/$name.stdout" 2> "$OUT/$name.stderr"
+    local rc=$?
+    tail -1 "$OUT/$name.stdout" > "$OUT/$name.json" 2>/dev/null
+    echo "[$name] rc=$rc  $(cat "$OUT/$name.json" 2>/dev/null | head -c 300)"
+    # keep going regardless: later steps still matter after one failure
+    return 0
+}
+
+# 1. headline (driver workload, defaults)
+run_step headline 1200
+
+# 2. flash prefill kernel A/B (same workload, kernel prefill on)
+DYN_PREFILL_PALLAS=1 run_step prefill_pallas 1200
+
+# 3. batch-geometry sweep (each distinct max_batch:K pays one warmup)
+run_step sweep 4200 --sweep \
+    "32:64:4,32:64:16,64:64:8,64:64:16,128:64:16,64:128:8,128:128:8,128:128:16"
+
+# 4. multiturn host-tier TTFT: no-tier baseline, then the tier
+run_step multiturn_base 1500 --scenario multiturn --host-pages 0
+run_step multiturn_tier 2400 --scenario multiturn --host-pages 4096
+
+# 5. disagg A/B with the transfer breakdown
+run_step disagg 2400 --scenario disagg
+
+echo "=== chip session complete; results in $OUT/ ==="
+grep -h . "$OUT"/*.json 2>/dev/null | head -20
