@@ -316,3 +316,66 @@ def test_sharded_k1_decode_kernel_matches_unsharded(monkeypatch):
 
     np.testing.assert_allclose(np.asarray(lg_tp), np.asarray(lg_ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_sharded_window_kernel_gemma2_matches_xla(monkeypatch):
+    """The sharded pool+window kernel path with Gemma-2 semantics (score
+    softcap + sliding window with its per-row lower bound crossing shard_map
+    as a new operand) is token-identical to the unsharded XLA window."""
+    monkeypatch.setenv("DYN_PALLAS_INTERPRET", "1")
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=64,
+                           hidden_size=64, vocab_size=256,
+                           model_type="gemma2", sandwich_norms=True,
+                           attn_logit_softcap=20.0, sliding_window=6,
+                           query_pre_attn_scalar=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    spec = llama.KVCacheSpec(num_pages=64, page_size=4)
+    B, P, K = 4, 4, 3
+
+    def prefill(kv_k, kv_v):
+        pre, _ = llama.make_step_fns(cfg, allow_pallas=False)
+        T = 12
+        toks = jnp.asarray(np.tile(np.arange(2, T + 2, dtype=np.int32)[None],
+                                   (B, 1)))
+        pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (B, 1))
+        table = np.zeros((B, P), np.int32)
+        for b in range(B):
+            table[b] = np.arange(1 + b * P, 1 + (b + 1) * P)
+        slots = np.zeros((B, T), np.int32)
+        for b in range(B):
+            posn = np.arange(T)
+            slots[b] = table[b][posn // 4] * 4 + posn % 4
+        _, kv_k, kv_v = pre(params, toks, pos, kv_k, kv_v,
+                            jnp.asarray(table), jnp.asarray(slots),
+                            jnp.full(B, T - 1, jnp.int32))
+        return kv_k, kv_v
+
+    monkeypatch.setenv("DYN_DISABLE_PALLAS", "1")  # XLA reference window
+    kv_k, kv_v = prefill(*llama.init_kv_cache(cfg, spec))
+    ref_fn = llama.make_decode_window_fn(cfg, allow_pallas=False)
+    a = _window_args(cfg, params, kv_k, kv_v, B, P)
+    ref_toks, ref_carry, _, _ = ref_fn(
+        params, a["tokens"], a["positions"], a["done"], a["steps"],
+        a["remaining"], a["kv_k"], a["kv_v"], a["page_table"],
+        a["temperature"], a["top_k"], a["top_p"], a["seeds"],
+        a["eos_table"], k_steps=K)
+    monkeypatch.delenv("DYN_DISABLE_PALLAS")
+
+    mesh = MeshSpec(data=2, model=2).build()
+    kv_k2, kv_v2 = prefill(*llama.init_kv_cache(cfg, spec))
+    kv_k2, kv_v2 = shard_kv_cache(kv_k2, kv_v2, cfg, mesh)
+    sp = shard_params(params, cfg, mesh)
+    tp_fn = llama.make_decode_window_fn(cfg, allow_pallas=True, mesh=mesh,
+                                        pallas_interpret=True)
+    a = _window_args(cfg, sp, kv_k2, kv_v2, B, P)
+    sb = shard_batch(mesh, tokens=a["tokens"], positions=a["positions"],
+                     page_table=a["page_table"])
+    got_toks, got_carry, _, _ = tp_fn(
+        sp, sb["tokens"], sb["positions"], a["done"], a["steps"],
+        a["remaining"], kv_k2, kv_v2, sb["page_table"],
+        a["temperature"], a["top_k"], a["top_p"], a["seeds"],
+        a["eos_table"], k_steps=K)
+
+    np.testing.assert_array_equal(np.asarray(got_toks), np.asarray(ref_toks))
+    np.testing.assert_array_equal(np.asarray(got_carry[1]),
+                                  np.asarray(ref_carry[1]))
