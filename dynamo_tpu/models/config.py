@@ -41,6 +41,24 @@ class ModelConfig:
     v_head_dim: int = 128
     attn_bias: bool = False        # qkv projection bias (Qwen2-style)
     qk_norm: bool = False          # per-head RMSNorm on q/k pre-RoPE (Qwen3)
+    # DeepSeek-MoE (V2/V3): dense first-k layers, shared experts riding
+    # beside the routed ones, and family-specific routing — "deepseek_v2"
+    # (softmax scores, optional max-per-group limiting, scale) or
+    # "deepseek_v3" (sigmoid scores + selection bias, top-2-sum groups,
+    # optional renorm, scale). moe_intermediate_size is the EXPERT width;
+    # intermediate_size stays the dense-layer width.
+    moe_router: str = "mixtral"
+    n_shared_experts: int = 0
+    first_k_dense_replace: int = 0
+    moe_intermediate_size: Optional[int] = None
+    routed_scaling_factor: float = 1.0
+    n_group: int = 0               # 0 = no group-limited routing
+    topk_group: int = 0
+    norm_topk_prob: bool = False
+    # real DeepSeek checkpoints store rope dims INTERLEAVED (pairs
+    # (2i, 2i+1)); the loader permutes those weight columns to our
+    # split-half rope convention (scores are permutation-invariant)
+    rope_interleave: bool = False
     # Gemma-family knobs (model_type "gemma"/"gemma2"): scaled embeddings,
     # (1 + w) RMSNorm, GeGLU activation, explicit attention scale, and the
     # Gemma-2 final-logit softcap
@@ -107,6 +125,22 @@ class ModelConfig:
             c.v_head_dim = cfg.get("v_head_dim", 128)
             c.num_experts = cfg.get("n_routed_experts") or 0
             c.num_experts_per_tok = cfg.get("num_experts_per_tok", 2)
+            c.rope_interleave = cfg.get("rope_interleave", True)
+            if c.num_experts > 0:
+                c.moe_router = mt
+                c.n_shared_experts = cfg.get("n_shared_experts") or 0
+                c.first_k_dense_replace = cfg.get("first_k_dense_replace",
+                                                  0)
+                c.moe_intermediate_size = cfg.get("moe_intermediate_size")
+                c.routed_scaling_factor = cfg.get("routed_scaling_factor",
+                                                  1.0)
+                c.norm_topk_prob = cfg.get("norm_topk_prob", False)
+                if mt == "deepseek_v3" or cfg.get(
+                        "topk_method", "greedy") != "greedy":
+                    # v2 "greedy" routes without group limiting; v3 is
+                    # always group-limited (noaux_tc)
+                    c.n_group = cfg.get("n_group") or 0
+                    c.topk_group = cfg.get("topk_group") or 0
         if mt == "qwen2":
             c.model_type = "llama"  # same decoder shape (GQA + SwiGLU)
             c.attn_bias = True      # qwen2 keeps bias on q/k/v projections

@@ -96,10 +96,8 @@ def load_params(path: str, cfg: Optional[ModelConfig] = None,
             p["k_norm"] = stack("model.layers.{}.self_attn.k_norm.weight",
                                 get)
     if cfg.num_experts > 0 and cfg.is_mla:
-        raise NotImplementedError(
-            "DeepSeek-MoE checkpoint loading (shared experts + dense-first "
-            "layers) is not wired yet; dense MLA and Mixtral MoE are")
-    if cfg.num_experts > 0:
+        _load_deepseek_moe(cfg, p, linear, get)
+    elif cfg.num_experts > 0:
         E = cfg.num_experts
         # HF names the MoE block differently per family: Mixtral uses
         # block_sparse_moe with w1/w3/w2, Qwen3-MoE uses mlp with
@@ -129,6 +127,60 @@ def load_params(path: str, cfg: Optional[ModelConfig] = None,
     return jax.tree.map(lambda a: jnp.asarray(a, dtype), p)
 
 
+def _rope_perm(dr: int) -> np.ndarray:
+    """Interleaved → split-half rope column permutation: DeepSeek
+    checkpoints store rope dims as (pair0_re, pair0_im, pair1_re, ...);
+    our apply_rope expects all real parts first. Applying the SAME
+    permutation to the q and k rope columns leaves q·k scores exactly
+    invariant (HF's apply_rotary_pos_emb_interleave is this permutation
+    followed by split-half rope)."""
+    return np.concatenate([np.arange(0, dr, 2), np.arange(1, dr, 2)])
+
+
+def _load_deepseek_moe(cfg: ModelConfig, p: Dict[str, np.ndarray],
+                       linear, get) -> None:
+    """DeepSeek-V2/V3 MoE weights → models/mla.py segmented layout:
+    dense first-k layers (mlp.{gate,up,down}_proj → w_*_d), then routed
+    experts (mlp.experts.N.* → w_*_e [Lm, E, D, Im], router mlp.gate →
+    w_router, V3 e_score_correction_bias → router_bias) plus the
+    always-on shared experts (mlp.shared_experts.* → w_*_s)."""
+    L, E, kd = cfg.num_layers, cfg.num_experts, cfg.first_k_dense_replace
+
+    def seg(fmt, rng, fn=linear):
+        return np.stack([fn(fmt.format(i)) for i in rng])
+
+    if kd > 0:
+        p["w_gate_d"] = seg("model.layers.{}.mlp.gate_proj.weight",
+                            range(kd))
+        p["w_up_d"] = seg("model.layers.{}.mlp.up_proj.weight", range(kd))
+        p["w_down_d"] = seg("model.layers.{}.mlp.down_proj.weight",
+                            range(kd))
+    moe_rng = range(kd, L)
+    p["w_router"] = seg("model.layers.{}.mlp.gate.weight", moe_rng)
+    if cfg.moe_router == "deepseek_v3":
+        p["router_bias"] = seg(
+            "model.layers.{}.mlp.gate.e_score_correction_bias", moe_rng,
+            get)
+
+    def experts(proj):
+        return np.stack([
+            np.stack([linear(
+                f"model.layers.{i}.mlp.experts.{e}.{proj}.weight")
+                for e in range(E)])
+            for i in moe_rng])
+
+    p["w_gate_e"] = experts("gate_proj")
+    p["w_up_e"] = experts("up_proj")
+    p["w_down_e"] = experts("down_proj")
+    if cfg.n_shared_experts > 0:
+        p["w_gate_s"] = seg(
+            "model.layers.{}.mlp.shared_experts.gate_proj.weight", moe_rng)
+        p["w_up_s"] = seg(
+            "model.layers.{}.mlp.shared_experts.up_proj.weight", moe_rng)
+        p["w_down_s"] = seg(
+            "model.layers.{}.mlp.shared_experts.down_proj.weight", moe_rng)
+
+
 def _load_mla_attention(cfg: ModelConfig, p: Dict[str, np.ndarray],
                         stack, linear, get) -> None:
     """DeepSeek-V2/V3 MLA attention weights → models/mla.py layout:
@@ -142,6 +194,11 @@ def _load_mla_attention(cfg: ModelConfig, p: Dict[str, np.ndarray],
     p["w_dkv"] = stack("model.layers.{}.self_attn.kv_a_proj_with_mqa.weight")
     p["kv_norm"] = stack("model.layers.{}.self_attn.kv_a_layernorm.weight",
                          get)
+    dr = cfg.qk_rope_head_dim
+    if cfg.rope_interleave:
+        perm = _rope_perm(dr)
+        p["w_dkv"] = np.concatenate(
+            [p["w_dkv"][..., :r], p["w_dkv"][..., r:][..., perm]], axis=-1)
     uk, uv = [], []
     for i in range(L):
         b = linear(f"model.layers.{i}.self_attn.kv_b_proj.weight")
@@ -156,5 +213,14 @@ def _load_mla_attention(cfg: ModelConfig, p: Dict[str, np.ndarray],
         p["q_norm"] = stack("model.layers.{}.self_attn.q_a_layernorm.weight",
                             get)
         p["w_uq"] = stack("model.layers.{}.self_attn.q_b_proj.weight")
+        qk = "w_uq"
     else:
         p["w_q"] = stack("model.layers.{}.self_attn.q_proj.weight")
+        qk = "w_q"
+    if cfg.rope_interleave:
+        # per-head layout [dn | dr]: permute each head's rope block
+        w = p[qk]
+        shp = w.shape
+        w = w.reshape(*shp[:-1], H, dn + cfg.qk_rope_head_dim)
+        w = np.concatenate([w[..., :dn], w[..., dn:][..., perm]], axis=-1)
+        p[qk] = np.ascontiguousarray(w.reshape(shp))

@@ -32,8 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .config import ModelConfig
-from .llama import (DROP_SLOT, KVCacheSpec, _mlp, _moe_mlp, apply_rope,
-                    logits_at, rms_norm, rope_freqs)
+from .llama import (DROP_SLOT, KVCacheSpec, _mlp, apply_rope, logits_at,
+                    rms_norm, rope_freqs)
 
 Params = Dict[str, jax.Array]
 
@@ -99,28 +99,122 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
     if not cfg.tie_word_embeddings:
         p["lm_head"] = w_init(ks[10], D, V)
     if cfg.num_experts > 0:
-        E = cfg.num_experts
-        p["w_router"] = w_init(ks[11], L, D, E)
-        p["w_gate"] = w_init(ks[5], L, E, D, I)
-        p["w_up"] = w_init(ks[6], L, E, D, I)
-        p["w_down"] = w_init(ks[7], L, E, I, D)
+        # DeepSeek-MoE layout: dense first-k layers keep w_*_d; MoE
+        # layers carry routed experts (+ optional shared experts/bias)
+        E, kd = cfg.num_experts, cfg.first_k_dense_replace
+        Lm = L - kd
+        Im = cfg.moe_intermediate_size or I
+        del p["w_gate"], p["w_up"], p["w_down"]
+        if kd > 0:
+            p["w_gate_d"] = w_init(ks[5], kd, D, I)
+            p["w_up_d"] = w_init(ks[6], kd, D, I)
+            p["w_down_d"] = w_init(ks[7], kd, I, D)
+        p["w_router"] = w_init(ks[11], Lm, D, E)
+        p["w_gate_e"] = w_init(ks[5], Lm, E, D, Im)
+        p["w_up_e"] = w_init(ks[6], Lm, E, D, Im)
+        p["w_down_e"] = w_init(ks[7], Lm, E, Im, D)
+        if cfg.moe_router == "deepseek_v3":
+            p["router_bias"] = jnp.zeros((Lm, E), dtype)
+        if cfg.n_shared_experts > 0:
+            Is = Im * cfg.n_shared_experts
+            p["w_gate_s"] = w_init(ks[12], Lm, D, Is)
+            p["w_up_s"] = w_init(ks[13], Lm, D, Is)
+            p["w_down_s"] = w_init(ks[12], Lm, Is, D)
     return p
 
 
 # ----------------------------------------------------------------- forward
 
 
+def _mla_attn_keys(cfg: ModelConfig) -> list:
+    """Attention-side per-layer param names (stacked over ALL layers,
+    sliced per dense/MoE segment)."""
+    keys = ["w_dkv", "kv_norm", "w_uk", "w_uv", "w_o", "ln_attn",
+            "ln_mlp"]
+    keys += (["w_dq", "q_norm", "w_uq"] if cfg.q_lora_rank > 0
+             else ["w_q"])
+    return keys
+
+
 def _mla_layer_keys(cfg: ModelConfig) -> list:
     """Per-layer param names scanned over the stacked-layer axis — shared
     by forward, reference_forward, and the MLA ring long-prefill
-    (parallel/ring_attention.make_mla_long_prefill_fn)."""
-    keys = ["w_dkv", "kv_norm", "w_uk", "w_uv", "w_o", "w_gate",
-            "w_up", "w_down", "ln_attn", "ln_mlp"]
-    keys += (["w_dq", "q_norm", "w_uq"] if cfg.q_lora_rank > 0
-             else ["w_q"])
-    if cfg.num_experts > 0:
-        keys.append("w_router")
-    return keys
+    (parallel/ring_attention.make_mla_long_prefill_fn). DENSE configs
+    only; DeepSeek-MoE configs segment their params (see forward)."""
+    return _mla_attn_keys(cfg) + ["w_gate", "w_up", "w_down"]
+
+
+def _moe_layer_params(cfg: ModelConfig, params: Params) -> dict:
+    """The MoE segment's per-layer params (stacked over layers
+    [first_k_dense_replace, L))."""
+    lp = {"w_router": params["w_router"], "w_gate_e": params["w_gate_e"],
+          "w_up_e": params["w_up_e"], "w_down_e": params["w_down_e"]}
+    if cfg.moe_router == "deepseek_v3":
+        lp["router_bias"] = params["router_bias"]
+    if cfg.n_shared_experts > 0:
+        lp.update({k: params[k] for k in ("w_gate_s", "w_up_s",
+                                          "w_down_s")})
+    return lp
+
+
+def _deepseek_gate(x32, w_router, bias, cfg: ModelConfig):
+    """DeepSeek router → dense over-experts gate [B, T, E] (float32).
+
+    v2 (HF DeepseekV2MoEGate): softmax scores; optional group limiting by
+    the MAX score per group; top-k; weights scaled (NOT renormalized).
+    v3 (HF DeepseekV3TopkRouter): sigmoid scores; selection by scores +
+    e_score_correction_bias with groups ranked by their top-2 SUM; the
+    applied weights are the ORIGINAL sigmoid scores of the selected
+    experts, optionally renormalized, then scaled."""
+    E = w_router.shape[-1]
+    k = cfg.num_experts_per_tok
+    logits = x32 @ w_router.astype(jnp.float32)
+    if cfg.moe_router == "deepseek_v3":
+        scores = jax.nn.sigmoid(logits)
+        choice = scores + bias.astype(jnp.float32)
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
+        choice = scores
+    if cfg.n_group > 0 and cfg.topk_group > 0:
+        G = cfg.n_group
+        cg = choice.reshape(*choice.shape[:-1], G, E // G)
+        if cfg.moe_router == "deepseek_v3":
+            g_scores = jnp.sum(lax.top_k(cg, 2)[0], axis=-1)
+        else:
+            g_scores = jnp.max(cg, axis=-1)
+        _, g_idx = lax.top_k(g_scores, cfg.topk_group)
+        g_mask = jnp.sum(jax.nn.one_hot(g_idx, G, dtype=jnp.float32),
+                         axis=-2)
+        choice = jnp.where(g_mask[..., :, None] > 0, cg,
+                           0.0).reshape(choice.shape)
+    _, topi = lax.top_k(choice, k)
+    w = jnp.take_along_axis(scores, topi, axis=-1)
+    if cfg.moe_router == "deepseek_v3" and cfg.norm_topk_prob:
+        w = w / (jnp.sum(w, axis=-1, keepdims=True) + 1e-20)
+    w = w * cfg.routed_scaling_factor
+    return jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32)
+                   * w[..., None], axis=-2)
+
+
+def _deepseek_moe_mlp(x: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
+    """Routed experts (dense-over-experts, TPU-friendly static shapes)
+    plus the always-on shared experts."""
+    x32 = x.astype(jnp.float32)
+    gate = _deepseek_gate(x32, lp["w_router"],
+                          lp.get("router_bias"), cfg)
+    ge = jnp.einsum("btd,edi->btei", x32,
+                    lp["w_gate_e"].astype(jnp.float32))
+    up = jnp.einsum("btd,edi->btei", x32,
+                    lp["w_up_e"].astype(jnp.float32))
+    act = jax.nn.silu(ge) * up
+    down = jnp.einsum("btei,eid->bted", act,
+                      lp["w_down_e"].astype(jnp.float32))
+    out = jnp.einsum("bted,bte->btd", down, gate)
+    if cfg.n_shared_experts > 0:
+        out = out + _mlp(x32, lp["w_gate_s"].astype(jnp.float32),
+                         lp["w_up_s"].astype(jnp.float32),
+                         lp["w_down_s"].astype(jnp.float32))
+    return out.astype(x.dtype)
 
 
 def _scatter_rows(cache_layer: jax.Array, new: jax.Array,
@@ -178,47 +272,76 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     h = params["embed"][tokens]
     safe_pos = jnp.maximum(positions, 0)
 
-    layer_params = {k: params[k] for k in _mla_layer_keys(cfg)}
+    def layer_with(mlp_apply):
+        def layer(h, xs):
+            lp, c_layer, r_layer = xs
+            x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+            # queries
+            if cfg.q_lora_rank > 0:
+                q_all = rms_norm(x @ lp["w_dq"], lp["q_norm"],
+                                 cfg.rms_norm_eps) @ lp["w_uq"]
+            else:
+                q_all = x @ lp["w_q"]
+            q_all = q_all.reshape(B, T, H, dn + dr)
+            q_nope, q_rope = q_all[..., :dn], q_all[..., dn:]
+            q_rope = apply_rope(q_rope, safe_pos, inv_freq)
+            # kv latent + shared rope key
+            ckr = x @ lp["w_dkv"]  # [B, T, r + dr]
+            c_kv = rms_norm(ckr[..., :r], lp["kv_norm"], cfg.rms_norm_eps)
+            k_rope = apply_rope(ckr[..., None, r:], safe_pos,
+                                inv_freq)[..., 0, :]  # one shared rope head
+            c_layer = _scatter_rows(c_layer, c_kv, flat_slots)
+            r_layer = _scatter_rows(r_layer, k_rope, flat_slots)
+            # absorbed attention: q_lat = q_nope · W_UK (per head)
+            w_uk = lp["w_uk"].reshape(r, H, dn)
+            q_lat = jnp.einsum("bthd,rhd->bthr",
+                               q_nope.astype(jnp.float32),
+                               w_uk.astype(jnp.float32))
+            out_lat = _mla_attention(q_lat, q_rope, c_layer, r_layer,
+                                     page_table, positions, scale)
+            # up-project latent context per head: out = out_lat · W_UV
+            w_uv = lp["w_uv"].reshape(r, H, dv)
+            out = jnp.einsum("bthr,rhd->bthd", out_lat,
+                             w_uv.astype(jnp.float32))
+            h2 = h + out.reshape(B, T, H * dv).astype(h.dtype) @ lp["w_o"]
+            x = rms_norm(h2, lp["ln_mlp"], cfg.rms_norm_eps)
+            return h2 + mlp_apply(x, lp), (c_layer, r_layer)
 
-    def layer(h, xs):
-        lp, c_layer, r_layer = xs
-        x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
-        # queries
-        if cfg.q_lora_rank > 0:
-            q_all = rms_norm(x @ lp["w_dq"], lp["q_norm"],
-                             cfg.rms_norm_eps) @ lp["w_uq"]
-        else:
-            q_all = x @ lp["w_q"]
-        q_all = q_all.reshape(B, T, H, dn + dr)
-        q_nope, q_rope = q_all[..., :dn], q_all[..., dn:]
-        q_rope = apply_rope(q_rope, safe_pos, inv_freq)
-        # kv latent + shared rope key
-        ckr = x @ lp["w_dkv"]  # [B, T, r + dr]
-        c_kv = rms_norm(ckr[..., :r], lp["kv_norm"], cfg.rms_norm_eps)
-        k_rope = apply_rope(ckr[..., None, r:], safe_pos,
-                            inv_freq)[..., 0, :]  # single shared rope head
-        c_layer = _scatter_rows(c_layer, c_kv, flat_slots)
-        r_layer = _scatter_rows(r_layer, k_rope, flat_slots)
-        # absorbed attention: q_lat = q_nope · W_UK (per head)
-        w_uk = lp["w_uk"].reshape(r, H, dn)
-        q_lat = jnp.einsum("bthd,rhd->bthr", q_nope.astype(jnp.float32),
-                           w_uk.astype(jnp.float32))
-        out_lat = _mla_attention(q_lat, q_rope, c_layer, r_layer,
-                                 page_table, positions, scale)
-        # up-project latent context per head: out = out_lat · W_UV
-        w_uv = lp["w_uv"].reshape(r, H, dv)
-        out = jnp.einsum("bthr,rhd->bthd", out_lat,
-                         w_uv.astype(jnp.float32))
-        h = h + out.reshape(B, T, H * dv).astype(h.dtype) @ lp["w_o"]
-        x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
-        if cfg.num_experts > 0:
-            h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
-                             lp["w_down"], cfg.num_experts_per_tok)
-        else:
-            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
-        return h, (c_layer, r_layer)
+        return layer
 
-    h, (new_c, new_r) = lax.scan(layer, h, (layer_params, kv_lat, kv_rope))
+    if cfg.num_experts == 0:
+        layer_params = {k: params[k] for k in _mla_layer_keys(cfg)}
+        dense = layer_with(
+            lambda x, lp: _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"]))
+        h, (new_c, new_r) = lax.scan(dense, h,
+                                     (layer_params, kv_lat, kv_rope))
+    else:
+        # DeepSeek-MoE: dense first-k layers, then MoE layers — two scans
+        # over layer segments (per-segment param stacks; the pools are
+        # sliced/concatenated, an extra copy the small latent cache
+        # affords)
+        kd = cfg.first_k_dense_replace
+        attn = {k: params[k] for k in _mla_attn_keys(cfg)}
+        seg_a = jax.tree.map(lambda a: a[:kd], attn)
+        seg_b = jax.tree.map(lambda a: a[kd:], attn)
+        new_c_parts, new_r_parts = [], []
+        if kd > 0:
+            seg_a.update({k: params[f"{k}_d"]
+                          for k in ("w_gate", "w_up", "w_down")})
+            dense = layer_with(lambda x, lp: _mlp(
+                x, lp["w_gate"], lp["w_up"], lp["w_down"]))
+            h, (c_a, r_a) = lax.scan(dense, h,
+                                     (seg_a, kv_lat[:kd], kv_rope[:kd]))
+            new_c_parts.append(c_a)
+            new_r_parts.append(r_a)
+        seg_b.update(_moe_layer_params(cfg, params))
+        moe = layer_with(lambda x, lp: _deepseek_moe_mlp(x, lp, cfg))
+        h, (c_b, r_b) = lax.scan(moe, h,
+                                 (seg_b, kv_lat[kd:], kv_rope[kd:]))
+        new_c_parts.append(c_b)
+        new_r_parts.append(r_b)
+        new_c = jnp.concatenate(new_c_parts, axis=0)
+        new_r = jnp.concatenate(new_r_parts, axis=0)
     h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
     return h, new_c, new_r
 
@@ -268,9 +391,7 @@ def reference_forward(params: Params, cfg: ModelConfig,
     pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     h = params["embed"][tokens]
 
-    layer_params = {k: params[k] for k in _mla_layer_keys(cfg)}
-
-    def layer(h, lp):
+    def layer(h, lp, mlp_apply):
         x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
         if cfg.q_lora_rank > 0:
             q_all = rms_norm(x @ lp["w_dq"], lp["q_norm"],
@@ -299,14 +420,26 @@ def reference_forward(params: Params, cfg: ModelConfig,
         out = jnp.einsum("bhts,bshd->bthd", probs, v)
         h = h + out.reshape(B, T, H * dv).astype(h.dtype) @ lp["w_o"]
         x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
-        if cfg.num_experts > 0:
-            h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
-                             lp["w_down"], cfg.num_experts_per_tok)
-        else:
-            h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
-        return h, None
+        return h + mlp_apply(x, lp)
 
-    h, _ = lax.scan(layer, h, layer_params)
+    # oracle path: plain per-layer Python loop (unrolled trace; test-sized)
+    dense_mlp = lambda x, lp: _mlp(x, lp["w_gate"], lp["w_up"],
+                                   lp["w_down"])
+    for li in range(cfg.num_layers):
+        if cfg.num_experts == 0:
+            lp = {k: params[k][li] for k in _mla_layer_keys(cfg)}
+            h = layer(h, lp, dense_mlp)
+        elif li < cfg.first_k_dense_replace:
+            lp = {k: params[k][li] for k in _mla_attn_keys(cfg)}
+            lp.update({k: params[f"{k}_d"][li]
+                       for k in ("w_gate", "w_up", "w_down")})
+            h = layer(h, lp, dense_mlp)
+        else:
+            mi = li - cfg.first_k_dense_replace
+            lp = {k: params[k][li] for k in _mla_attn_keys(cfg)}
+            lp.update({k: v[mi]
+                       for k, v in _moe_layer_params(cfg, params).items()})
+            h = layer(h, lp, lambda x, lp: _deepseek_moe_mlp(x, lp, cfg))
     h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:

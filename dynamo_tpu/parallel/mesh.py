@@ -100,6 +100,19 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
             "w_dq": P(None, None, None),
             "q_norm": P(None, None),
             "w_uq": P(None, None, "model"),
+            # DeepSeek-MoE segments (models/mla.py): routed experts over
+            # the expert axis (TP inside each expert), dense-first and
+            # shared-expert MLPs megatron-style
+            "w_gate_d": P(None, None, "model"),
+            "w_up_d": P(None, None, "model"),
+            "w_down_d": P(None, "model", None),
+            "w_gate_e": P(None, "expert", None, "model"),
+            "w_up_e": P(None, "expert", None, "model"),
+            "w_down_e": P(None, "expert", "model", None),
+            "w_gate_s": P(None, None, "model"),
+            "w_up_s": P(None, None, "model"),
+            "w_down_s": P(None, "model", None),
+            "router_bias": P(None, None),
         })
     if cfg.attn_bias:
         specs.update({"bq": P(None, "model"), "bk": P(None, "model"),

@@ -237,6 +237,12 @@ def make_mla_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
 
     from ..models.llama import apply_rope, rms_norm, rope_freqs
     from ..models.mla import _mla_layer_keys
+
+    if cfg.num_experts > 0:
+        raise ValueError(
+            "MLA ring long-prefill covers dense MLA only; the DeepSeek-"
+            "MoE segmented stack is not wired through the ring — unset "
+            "long_prefill_threshold")
     from ..models.llama import _mlp, _moe_mlp, project_logits
 
     inv_freq = rope_freqs(cfg, dim=cfg.qk_rope_head_dim)

@@ -111,7 +111,11 @@ def test_loader_mla_roundtrip(tmp_path):
         "q_lora_rank": cfg.q_lora_rank, "kv_lora_rank": cfg.kv_lora_rank,
         "qk_nope_head_dim": cfg.qk_nope_head_dim,
         "qk_rope_head_dim": cfg.qk_rope_head_dim,
-        "v_head_dim": cfg.v_head_dim})
+        "v_head_dim": cfg.v_head_dim,
+        # this synthetic checkpoint stores rope dims in OUR split-half
+        # convention; real DeepSeek checkpoints interleave (and default
+        # True), which the loader un-permutes — declare it off here
+        "rope_interleave": False})
     loaded = load_params(str(tmp_path), dtype=np.float32)
     _assert_tree_close(loaded, p)
 
