@@ -135,6 +135,16 @@ class ModelConfig:
                 c.routed_scaling_factor = cfg.get("routed_scaling_factor",
                                                   1.0)
                 c.norm_topk_prob = cfg.get("norm_topk_prob", False)
+                if mt == "deepseek_v2" and c.norm_topk_prob:
+                    # The installed transformers DeepseekV2MoEGate ignores
+                    # this flag (always scales, never renormalizes) while
+                    # DeepSeek's remote-code gate renormalizes instead of
+                    # scaling — two conflicting oracles, and no published
+                    # V2 checkpoint sets it. Reject loudly rather than
+                    # silently diverging from either.
+                    raise NotImplementedError(
+                        "deepseek_v2 with norm_topk_prob=true is not "
+                        "supported (conflicting reference semantics)")
                 if mt == "deepseek_v3" or cfg.get(
                         "topk_method", "greedy") != "greedy":
                     # v2 "greedy" routes without group limiting; v3 is

@@ -189,6 +189,9 @@ def _deepseek_gate(x32, w_router, bias, cfg: ModelConfig):
                            0.0).reshape(choice.shape)
     _, topi = lax.top_k(choice, k)
     w = jnp.take_along_axis(scores, topi, axis=-1)
+    # v3 (HF DeepseekV3TopkRouter): optional renorm, then ALWAYS scaled.
+    # v2: transformers' DeepseekV2MoEGate ignores norm_topk_prob (always
+    # scales); configs setting it are rejected at ModelConfig load.
     if cfg.moe_router == "deepseek_v3" and cfg.norm_topk_prob:
         w = w / (jnp.sum(w, axis=-1, keepdims=True) + 1e-20)
     w = w * cfg.routed_scaling_factor

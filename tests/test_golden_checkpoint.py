@@ -472,6 +472,24 @@ def test_deepseek_v2_dense_logits_match_transformers(tmp_path_factory):
     np.testing.assert_allclose(ours, theirs, rtol=3e-4, atol=3e-4)
 
 
+def test_deepseek_v2_norm_topk_prob_rejected():
+    """transformers' DeepseekV2MoEGate ignores norm_topk_prob while
+    DeepSeek's remote-code gate renormalizes-instead-of-scales — with
+    conflicting oracles (and no published V2 checkpoint setting it) the
+    config must be rejected loudly, not silently served either way."""
+    from dynamo_tpu.models.config import ModelConfig
+
+    hf = dict(model_type="deepseek_v2", vocab_size=160, hidden_size=64,
+              intermediate_size=128, num_hidden_layers=2,
+              num_attention_heads=4, num_key_value_heads=4,
+              kv_lora_rank=16, n_routed_experts=8, num_experts_per_tok=2,
+              moe_intermediate_size=32, norm_topk_prob=True)
+    with pytest.raises(NotImplementedError, match="norm_topk_prob"):
+        ModelConfig.from_hf_config(hf)
+    hf["norm_topk_prob"] = False
+    assert ModelConfig.from_hf_config(hf).moe_router == "deepseek_v2"
+
+
 def test_deepseek_v2_moe_serving_matches_transformers(tmp_path_factory,
                                                       run_async):
     """DeepSeek-V2 MoE (dense first-k layers, shared experts, group-
