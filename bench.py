@@ -31,12 +31,17 @@ import time
 faulthandler.register(signal.SIGUSR1)
 
 
+def _model_tag(args) -> str:
+    dt = getattr(args, "dtype", "bf16")
+    return args.model if dt == "bf16" else f"{args.model}-{dt}"
+
+
 def metric_name(args) -> str:
     """The driver-facing metric label — built in ONE place so success and
     chip-unavailable records for the same invocation always match."""
     if getattr(args, "sweep", None):
         return ("output tokens/s, best of batch-geometry sweep "
-                f"(ISL~{args.isl}/OSL {args.osl}, {args.model} "
+                f"(ISL~{args.isl}/OSL {args.osl}, {_model_tag(args)} "
                 "llama, 1 chip)")
     if args.scenario == "multiturn":
         return (f"TTFT p50 (later turns), multiturn {args.users}u x "
@@ -46,21 +51,27 @@ def metric_name(args) -> str:
                 f"{args.disagg_threshold})")
     return ("output tokens/s, synthetic ShareGPT "
             f"(ISL~{args.isl}/OSL {args.osl}, {args.requests} reqs, "
-            f"conc {args.concurrency}, {args.model} llama, 1 chip)")
+            f"conc {args.concurrency}, {_model_tag(args)} llama, 1 chip)")
+
+
+def metric_unit(args) -> str:
+    """Companion to metric_name(): the record's unit, with the same
+    sweep-outranks-scenario precedence — ONE encoding of which record
+    shape an invocation emits (success, sweep, and chip-unavailable
+    paths all call this)."""
+    if getattr(args, "sweep", None):
+        return "tok/s"
+    return {"multiturn": "ms", "disagg": "ratio"}.get(args.scenario,
+                                                      "tok/s")
 
 
 def emit_unavailable(args, reason: str) -> None:
     """Print the ONE parseable JSON record the driver expects, flagging the
     chip as unavailable instead of dying with a stack trace (round-3 gate
     failure mode: BENCH_r03.json rc=1, parsed=null)."""
-    if getattr(args, "sweep", None):  # sweep outranks scenario, as in
-        unit = "tok/s"                # metric_name()/_run_scenario()
-    else:
-        unit = {"multiturn": "ms",
-                "disagg": "ratio"}.get(args.scenario, "tok/s")
     print(json.dumps({
         "metric": metric_name(args),
-        "value": None, "unit": unit, "vs_baseline": None,
+        "value": None, "unit": metric_unit(args), "vs_baseline": None,
         "error": f"chip unavailable: {reason}",
     }))
 
@@ -140,7 +151,10 @@ def parse_args():
     ap.add_argument("--isl", type=int, default=512, help="mean input len")
     ap.add_argument("--osl", type=int, default=128, help="output len")
     ap.add_argument("--cpu", action="store_true", help="CPU smoke mode")
-    ap.add_argument("--model", default="1b", choices=["1b", "tiny"])
+    ap.add_argument("--model", default="1b", choices=["1b", "8b", "tiny"])
+    ap.add_argument("--dtype", default="bf16", choices=["bf16", "int8"],
+                    help="int8 = weight-only quantization (models/quant.py);"
+                         " required for --model 8b on a 16 GB chip")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--decode-steps", type=int, default=16,
                     help="fused decode window (amortizes dispatch latency)")
@@ -185,6 +199,25 @@ def build_engine(args):
                             prefill_chunk=128, prefill_buckets=(128,),
                             batch_buckets=(4, 16), page_buckets=(16,),
                             decode_steps=args.decode_steps)
+    elif args.model == "8b":
+        # Llama-3-8B-shaped — the size BASELINE.md's north-star metric is
+        # defined at. bf16 weights (16 GB) exceed a v5e's HBM, so this
+        # config requires --dtype int8 (~8 GB weights + scales).
+        if args.dtype != "int8":
+            raise SystemExit("--model 8b needs --dtype int8 on a 16 GB "
+                             "chip (bf16 weights alone are 16 GB)")
+        cfg = ModelConfig(vocab_size=128256, hidden_size=4096,
+                          intermediate_size=14336, num_layers=32,
+                          num_heads=32, num_kv_heads=8, head_dim=128,
+                          rope_theta=500000.0, dtype="bfloat16")
+        # KV: 2*32L*8KV*128hd*2B = 128 KB/token → 512 pages x 64 tok
+        # = 32K cached tokens ≈ 4 GB; ~8 GB weights + ~4 GB KV leaves
+        # headroom for decode-window transients on 16 GB
+        ecfg = EngineConfig(page_size=64, num_pages=512, max_batch=16,
+                            prefill_chunk=1024, prefill_buckets=(512, 1024),
+                            batch_buckets=(8, 16), page_buckets=(16, 32),
+                            decode_steps=args.decode_steps,
+                            host_pages=args.host_pages)
     else:
         # Llama-3.2-1B-shaped: ~2.5 GB bf16 params + KV pool on one v5e chip
         cfg = ModelConfig(vocab_size=128256, hidden_size=2048,
@@ -213,7 +246,8 @@ def build_engine(args):
         ecfg.num_pages = min(ecfg.num_pages, 10 * args.users)
         ecfg.host_pages = args.host_pages
     print(f"devices: {jax.devices()}", file=sys.stderr)
-    engine = JaxEngine(cfg, ecfg, seed=args.seed)
+    engine = JaxEngine(cfg, ecfg, seed=args.seed,
+                       quant="int8" if args.dtype == "int8" else None)
     return engine, cfg
 
 
@@ -338,7 +372,7 @@ async def measure(engine, reqs, concurrency):
                 results.append({
                     "tokens_in": len(token_ids), "tokens_out": 0,
                     "ttft": None, "elapsed": req_timeout, "itl": None,
-                    "error": True,
+                    "gaps": [], "error": True,
                 })
 
     async def _one_inner(ctx, token_ids, osl):
@@ -349,7 +383,7 @@ async def measure(engine, reqs, concurrency):
             eos_token_ids=[])
         t_start = time.monotonic()
         t_first = None
-        stamps = []
+        chunk_stamps = []
         n_out = 0
         finish = None
         async for out in engine.generate(pre, ctx):
@@ -357,7 +391,7 @@ async def measure(engine, reqs, concurrency):
             if out.token_ids:
                 if t_first is None:
                     t_first = now
-                stamps.extend([now] * len(out.token_ids))
+                chunk_stamps.append(now)
                 n_out += len(out.token_ids)
             if out.finish_reason:
                 finish = out.finish_reason
@@ -368,12 +402,17 @@ async def measure(engine, reqs, concurrency):
         # window and ~window-time at boundaries (the r1/r2 itl_p50=0
         # artifact). The honest per-request number is the mean
         # inter-token interval over the whole stream.
-        itl = ((stamps[-1] - stamps[0]) / (n_out - 1)
+        itl = ((chunk_stamps[-1] - chunk_stamps[0]) / (n_out - 1)
                if n_out > 1 else None)
         results.append({
             "tokens_in": len(token_ids), "tokens_out": n_out,
             "ttft": (t_first - t_start) if t_first else None,
             "elapsed": t_end - t_start, "itl": itl,
+            # raw inter-CHUNK arrival gaps: what a streaming client
+            # actually experiences between deliveries (with decode_steps
+            # K>1 these are ~K-token strides — report them alongside the
+            # amortized figure, not instead of it; VERDICT r4 weak #6)
+            "gaps": [b - a for a, b in zip(chunk_stamps, chunk_stamps[1:])],
             "error": finish == "error",
         })
 
@@ -387,6 +426,9 @@ async def measure(engine, reqs, concurrency):
     total_in = sum(r["tokens_in"] for r in results)
     ttfts = sorted(r["ttft"] for r in results if r["ttft"] is not None)
     itls = sorted(r["itl"] for r in results if r["itl"] is not None)
+    # pooled raw inter-chunk gaps across all requests (client-observed
+    # stream cadence — the un-amortized truth the window-ITL smooths)
+    gaps = sorted(g for r in results for g in r["gaps"])
 
     def pct(v, p):
         return v[min(int(len(v) * p / 100), len(v) - 1)] if v else None
@@ -401,6 +443,10 @@ async def measure(engine, reqs, concurrency):
         "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 1) if ttfts else None,
         "itl_p50_ms": round(pct(itls, 50) * 1000, 2) if itls else None,
         "itl_p99_ms": round(pct(itls, 99) * 1000, 2) if itls else None,
+        "itl_raw_chunk_p50_ms": (round(pct(gaps, 50) * 1000, 2)
+                                 if gaps else None),
+        "itl_raw_chunk_p99_ms": (round(pct(gaps, 99) * 1000, 2)
+                                 if gaps else None),
     }
 
 
@@ -540,7 +586,7 @@ def _run_sweep(args) -> dict:
               f"{r['errors']:>4}", file=sys.stderr)
     best = max(rows, key=lambda r: r["output_tok_per_s"])
     return {"metric": metric_name(args),
-            "value": best["output_tok_per_s"], "unit": "tok/s",
+            "value": best["output_tok_per_s"], "unit": metric_unit(args),
             "vs_baseline": 1.0,
             "detail": {"best": best, "sweep": rows}}
 
@@ -586,12 +632,14 @@ def _run_scenario(args) -> dict:
         report = asyncio.run(run_multiturn(args))
         return {"metric": metric_name(args),
                 "value": report["ttft_later_turns_p50_ms"],
-                "unit": "ms", "vs_baseline": 1.0, "detail": report}
+                "unit": metric_unit(args), "vs_baseline": 1.0,
+                "detail": report}
     if args.scenario == "disagg":
         report = asyncio.run(run_disagg(args))
         return {"metric": metric_name(args),
                 "value": report["disagg_over_agg_req_per_s"],
-                "unit": "ratio", "vs_baseline": 1.0, "detail": report}
+                "unit": metric_unit(args), "vs_baseline": 1.0,
+                "detail": report}
     report = asyncio.run(run_bench(args))
     # vs_baseline: reference publishes no absolute numbers —
     # BASELINE.json.published == {} — so round-over-round ratio
@@ -605,7 +653,7 @@ def _run_scenario(args) -> dict:
             prev = None
     value = report["output_tok_per_s"]
     return {"metric": metric_name(args), "value": value,
-            "unit": "tok/s",
+            "unit": metric_unit(args),
             "vs_baseline": round(value / prev, 3) if prev else 1.0,
             "detail": report}
 

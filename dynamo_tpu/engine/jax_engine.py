@@ -210,12 +210,24 @@ class JaxEngine:
     """AsyncEngine over the JAX model (token-level core engine)."""
 
     def __init__(self, model_cfg: ModelConfig, engine_cfg: Optional[EngineConfig]
-                 = None, params=None, seed: int = 0, dtype=None, mesh=None):
+                 = None, params=None, seed: int = 0, dtype=None, mesh=None,
+                 quant: Optional[str] = None):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
         model = get_model_module(model_cfg)
         if params is None:
-            params = model.init_params(model_cfg, jax.random.PRNGKey(seed))
+            if quant == "int8":
+                # init + quantize on host CPU so the bf16 tree never
+                # exists in HBM (how 8B-shaped weights start on a 16 GB
+                # chip); see models/quant.py
+                from ..models.quant import host_init_quantized
+                params = host_init_quantized(model, model_cfg, seed)
+            else:
+                params = model.init_params(model_cfg,
+                                           jax.random.PRNGKey(seed))
+        elif quant == "int8":
+            from ..models.quant import quantize_params
+            params = quantize_params(params)
         self.params = params
         spec = KVCacheSpec(self.ecfg.num_pages, self.ecfg.page_size)
         self.kv_k, self.kv_v = model.init_kv_cache(model_cfg, spec, dtype)
@@ -253,13 +265,9 @@ class JaxEngine:
         self.long_prefills_total = 0
         if (self.ecfg.long_prefill_threshold is not None
                 and mesh is not None and mesh.shape.get("seq", 1) > 1):
-            if (model_cfg.sliding_window is not None
-                    or model_cfg.attn_logit_softcap is not None):
-                raise ValueError(
-                    "ring long-prefill implements global causal attention "
-                    "only; Gemma-2's sliding window / score softcap are "
-                    "not wired through the ring exchange — unset "
-                    "long_prefill_threshold")
+            # Gemma-2's sliding window / softcap thread through the ring
+            # as position predicates (parallel/ring_attention.py) — all
+            # three model families take this path (VERDICT r4 task 7)
             from ..parallel.ring_attention import (make_long_prefill_fn,
                                                    make_mla_long_prefill_fn)
             # MLA takes the latent-only ring exchange (only the shared

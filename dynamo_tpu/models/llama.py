@@ -447,17 +447,127 @@ def _sliding_flag(cfg: ModelConfig, l_idx):
     return (l_idx % 2) == 0
 
 
+def _dyn_expert(w, e):
+    """One expert's weight from the stacked [E, ...] tensor by traced
+    index — dequantizing after the slice when quantized, so the scan body
+    only reads the ACTIVE expert's int8 bytes from HBM."""
+    from .quant import QuantInt8
+
+    if isinstance(w, QuantInt8):
+        return QuantInt8(lax.dynamic_index_in_dim(w.q, e, 0, False),
+                         lax.dynamic_index_in_dim(w.s, e, 0, False)
+                         ).dequant(jnp.float32)
+    return lax.dynamic_index_in_dim(w, e, 0, False).astype(jnp.float32)
+
+
+def moe_experts_blocked(x: jax.Array, weights: jax.Array, idx: jax.Array,
+                        w_gate, w_up, w_down, block: int = 256,
+                        act=jax.nn.silu) -> jax.Array:
+    """Sparse top-k expert dispatch with static shapes and NO token drops.
+
+    x: [N, D] (f32) flattened tokens; weights/idx: [N, k] routing output.
+    Sort the N*k (token, expert) pairs by expert, pad each expert's group
+    to a multiple of ``block``, and scan fixed-size blocks — each block
+    belongs to ONE expert, fetched by traced index (a dynamic-slice, so
+    HBM only streams the active experts' weights). Cost ≈ (k/E +
+    padding) of the dense-over-experts einsum; exact same math (the
+    per-expert MLP is linear in which rows are present — padded rows are
+    zero and are never scattered back).
+
+    TPU-first shape rationale: argsort/cumsum/gather are bandwidth-bound
+    O(N·k·D); each scanned block is a [block, D]×[D, I] MXU matmul.
+    Reference analog: vLLM's fused_moe dispatch (the reference serves
+    Mixtral through vLLM); this is the XLA-native equivalent.
+    """
+    N, D = x.shape
+    k = idx.shape[-1]
+    E = w_gate.shape[0]
+    NK = N * k
+    nb = (NK + block - 1) // block + E  # static worst-case block count
+
+    pair_e = idx.reshape(-1)                          # [NK]
+    pair_t = jnp.repeat(jnp.arange(N, dtype=jnp.int32), k)
+    pair_w = weights.reshape(-1).astype(jnp.float32)
+    order = jnp.argsort(pair_e, stable=True)
+    se, st, sw = pair_e[order], pair_t[order], pair_w[order]
+
+    counts = jnp.sum(jax.nn.one_hot(pair_e, E, dtype=jnp.int32), axis=0)
+    start = jnp.cumsum(counts) - counts               # exclusive, [E]
+    padded = ((counts + block - 1) // block) * block
+    pend = jnp.cumsum(padded)                         # padded group ends
+    pstart = pend - padded
+    pos = jnp.arange(NK, dtype=jnp.int32) - start[se]
+    dest = pstart[se] + pos                           # [NK], < nb*block
+
+    buf = jnp.zeros((nb * block, D), jnp.float32).at[dest].set(x[st])
+    # block j covers rows [j*block, (j+1)*block) of exactly one padded
+    # group; slack blocks past the last group stay all-zero (clamped
+    # expert index — their output is discarded by the scatter-back)
+    bstart = jnp.arange(nb, dtype=jnp.int32) * block
+    block_e = jnp.minimum(
+        jnp.sum(bstart[:, None] >= pend[None, :], axis=1), E - 1)
+
+    def body(_, inp):
+        xb, be = inp
+        wg, wu, wd = (_dyn_expert(w, be) for w in (w_gate, w_up, w_down))
+        return None, (act(xb @ wg) * (xb @ wu)) @ wd
+
+    _, yb = lax.scan(body, None, (buf.reshape(nb, block, D), block_e))
+    contrib = yb.reshape(nb * block, D)[dest] * sw[:, None]
+    return jnp.zeros((N, D), jnp.float32).at[st].add(contrib)
+
+
+# scanned block height for the sorted dispatch (MXU-friendly; also the
+# per-expert padding quantum, so it enters the cost model below)
+_MOE_BLOCK = int(os.environ.get("DYN_MOE_BLOCK", "256"))
+
+
+def _moe_use_blocked(mesh, n_tokens: int, n_experts: int,
+                     top_k: int, block: int) -> bool:
+    """Blocked dispatch only where its cost model actually wins, and
+    only on UNSHARDED execution.
+
+    Cost in row-MLPs: blocked pays worst-case ``N*k + E*block`` (every
+    pair once, plus up to one padded block per expert — slack blocks are
+    scanned too); dense-over-experts pays ``N*E``. Require blocked to be
+    at least 2x cheaper so the argsort/one-hot/scatter overhead can't
+    eat the margin — a flat token threshold would mis-fire near the
+    boundary (e.g. Mixtral E=8, k=2 at N=256: blocked is ~1.25x DENSE).
+
+    Under any >1-device mesh the tokens/experts are GSPMD-sharded and
+    the sort/scatter would turn into cross-device gathers — there the
+    dense einsum (whose E axis shards cleanly over the "expert" mesh
+    axis) stays the right program."""
+    return (n_experts > 1
+            and n_tokens * top_k + n_experts * block
+            <= (n_tokens * n_experts) // 2
+            and (mesh is None or mesh.size == 1))
+
+
 def _moe_mlp(h: jax.Array, w_router, w_gate, w_up, w_down,
-             top_k: int) -> jax.Array:
-    """Mixtral-style MoE MLP: token-choice top-k routing, computed as a
-    dense einsum over all experts weighted by the routing mask (TPU-friendly:
-    static shapes, MXU-dominated; expert-parallel sharding splits the E axis
-    over the "expert"/"model" mesh axis)."""
+             top_k: int, mesh=None) -> jax.Array:
+    """Mixtral-style MoE MLP: token-choice top-k routing.
+
+    Two execution strategies, chosen at trace time (shapes are static
+    under jit):
+    - ``moe_experts_blocked`` sorted dispatch — ~top_k/E of the dense
+      FLOPs; default for big dispatches on an unsharded expert axis.
+    - dense einsum over ALL experts weighted by the routing mask —
+      decode-sized dispatches (sort overhead dominates) and
+      expert-parallel meshes (GSPMD shards the E axis of the einsum;
+      the blocked scan's dynamic expert indexing would all-gather).
+    """
     B, T, D = h.shape
-    E = w_router.shape[-1]
+    E = w_gate.shape[0]
     logits = (h @ w_router).astype(jnp.float32)  # [B, T, E]
     weights, idx = lax.top_k(logits, top_k)  # [B, T, k]
     weights = jax.nn.softmax(weights, axis=-1)
+    if _moe_use_blocked(mesh, B * T, E, top_k, _MOE_BLOCK):
+        out = moe_experts_blocked(
+            h.reshape(B * T, D).astype(jnp.float32),
+            weights.reshape(B * T, top_k), idx.reshape(B * T, top_k),
+            w_gate, w_up, w_down, block=_MOE_BLOCK)
+        return out.reshape(B, T, D).astype(h.dtype)
     full_gate = jnp.sum(
         jax.nn.one_hot(idx, E, dtype=jnp.float32) * weights[..., None], axis=2)
     # dense-over-experts: out = sum_e gate[...,e] * mlp_e(h)
@@ -525,7 +635,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
         if cfg.num_experts > 0:
             mlp_out = _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
-                               lp["w_down"], cfg.num_experts_per_tok)
+                               lp["w_down"], cfg.num_experts_per_tok,
+                               mesh=mesh)
         else:
             mlp_out = _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"], act)
         h = _residual_add(h, mlp_out, lp, "ln_mlp_post", cfg)
@@ -726,7 +837,7 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
                 if cfg.num_experts > 0:
                     mlp_out = _moe_mlp(x, lp["w_router"], lp["w_gate"],
                                        lp["w_up"], lp["w_down"],
-                                       cfg.num_experts_per_tok)
+                                       cfg.num_experts_per_tok, mesh=mesh)
                 else:
                     mlp_out = _mlp(x, lp["w_gate"], lp["w_up"],
                                    lp["w_down"], act)
@@ -896,11 +1007,15 @@ def _pool_window_attention(q, k_pool_l, v_pool_l, page_table, start,
 
 def full_attention_layer(cfg: ModelConfig, h: jax.Array, lp: Params,
                          pos: jax.Array, inv_freq: jax.Array,
-                         scale: float, is_sliding=False) -> jax.Array:
+                         scale: float, is_sliding=False,
+                         mesh=None) -> jax.Array:
     """One transformer layer with plain causal full attention (no paged
     cache). The single source of the layer math for every non-paged
     consumer: ``reference_forward`` (test oracle) and the
-    pipeline-parallel stage body (parallel/pipeline_parallel.py).
+    pipeline-parallel stage body (parallel/pipeline_parallel.py) —
+    inside the latter's shard_map all values are device-local, so the
+    default mesh=None (which may pick the blocked MoE dispatch) is
+    correct there too.
     ``is_sliding`` is the traced Gemma-2 per-layer window flag (the
     caller owns the layer-parity bookkeeping — see _sliding_flag)."""
     B, T = h.shape[:2]
@@ -929,7 +1044,7 @@ def full_attention_layer(cfg: ModelConfig, h: jax.Array, lp: Params,
     x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     if cfg.num_experts > 0:
         mlp_out = _moe_mlp(x, lp["w_router"], lp["w_gate"], lp["w_up"],
-                           lp["w_down"], cfg.num_experts_per_tok)
+                           lp["w_down"], cfg.num_experts_per_tok, mesh=mesh)
     else:
         mlp_out = _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"], _act(cfg))
     return _residual_add(h, mlp_out, lp, "ln_mlp_post", cfg)

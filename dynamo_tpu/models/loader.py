@@ -35,8 +35,12 @@ def _index(path: str) -> Dict[str, str]:
 
 
 def load_params(path: str, cfg: Optional[ModelConfig] = None,
-                dtype=None) -> Dict[str, jax.Array]:
-    """Load and restack a local HF checkpoint; returns the params pytree."""
+                dtype=None, quant: Optional[str] = None
+                ) -> Dict[str, jax.Array]:
+    """Load and restack a local HF checkpoint; returns the params pytree.
+
+    ``quant="int8"`` quantizes the projection weights on the host
+    (models/quant.py) so only int8 + scales ever reach the device."""
     from safetensors import safe_open
 
     cfg = cfg or ModelConfig.from_local_path(path)
@@ -124,6 +128,15 @@ def load_params(path: str, cfg: Optional[ModelConfig] = None,
         p["w_up"] = stack("model.layers.{}.mlp.up_proj.weight")
         p["w_down"] = stack("model.layers.{}.mlp.down_proj.weight")
 
+    if quant == "int8":
+        from .quant import QuantInt8, quantize_params
+
+        p = quantize_params(p)
+        return {k: (QuantInt8(jnp.asarray(v.q), jnp.asarray(v.s))
+                    if isinstance(v, QuantInt8) else jnp.asarray(v, dtype))
+                for k, v in p.items()}
+    if quant is not None:
+        raise ValueError(f"unknown quant mode {quant!r} (expected 'int8')")
     return jax.tree.map(lambda a: jnp.asarray(a, dtype), p)
 
 

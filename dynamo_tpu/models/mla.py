@@ -158,7 +158,7 @@ def _moe_layer_params(cfg: ModelConfig, params: Params) -> dict:
 
 
 def _deepseek_gate(x32, w_router, bias, cfg: ModelConfig):
-    """DeepSeek router → dense over-experts gate [B, T, E] (float32).
+    """DeepSeek router → (weights [B, T, k], expert indices [B, T, k]).
 
     v2 (HF DeepseekV2MoEGate): softmax scores; optional group limiting by
     the MAX score per group; top-k; weights scaled (NOT renormalized).
@@ -195,24 +195,47 @@ def _deepseek_gate(x32, w_router, bias, cfg: ModelConfig):
     if cfg.moe_router == "deepseek_v3" and cfg.norm_topk_prob:
         w = w / (jnp.sum(w, axis=-1, keepdims=True) + 1e-20)
     w = w * cfg.routed_scaling_factor
+    return w, topi
+
+
+def _dense_gate(w, topi, E):
+    """(weights, indices) → dense [B, T, E] mask for the
+    dense-over-experts einsum path."""
     return jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32)
                    * w[..., None], axis=-2)
 
 
-def _deepseek_moe_mlp(x: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
-    """Routed experts (dense-over-experts, TPU-friendly static shapes)
-    plus the always-on shared experts."""
+def _deepseek_moe_mlp(x: jax.Array, lp, cfg: ModelConfig,
+                      mesh=None) -> jax.Array:
+    """Routed experts plus the always-on shared experts. Large
+    dispatches on an unsharded expert axis use the sorted blocked
+    dispatch (~top_k/E of the dense FLOPs — with E up to 256 on
+    DeepSeek-V3 the dense-over-experts einsum is ~32x waste); decode-
+    sized dispatches and expert-parallel meshes keep the dense einsum
+    (see llama._moe_mlp for the strategy rationale)."""
+    from .llama import _MOE_BLOCK, _moe_use_blocked, moe_experts_blocked
+
+    B, T, D = x.shape
+    E = lp["w_gate_e"].shape[0]
     x32 = x.astype(jnp.float32)
-    gate = _deepseek_gate(x32, lp["w_router"],
-                          lp.get("router_bias"), cfg)
-    ge = jnp.einsum("btd,edi->btei", x32,
-                    lp["w_gate_e"].astype(jnp.float32))
-    up = jnp.einsum("btd,edi->btei", x32,
-                    lp["w_up_e"].astype(jnp.float32))
-    act = jax.nn.silu(ge) * up
-    down = jnp.einsum("btei,eid->bted", act,
-                      lp["w_down_e"].astype(jnp.float32))
-    out = jnp.einsum("bted,bte->btd", down, gate)
+    w, topi = _deepseek_gate(x32, lp["w_router"],
+                             lp.get("router_bias"), cfg)
+    if _moe_use_blocked(mesh, B * T, E, cfg.num_experts_per_tok,
+                        _MOE_BLOCK):
+        out = moe_experts_blocked(
+            x32.reshape(B * T, D), w.reshape(B * T, -1),
+            topi.reshape(B * T, -1), lp["w_gate_e"], lp["w_up_e"],
+            lp["w_down_e"], block=_MOE_BLOCK).reshape(B, T, D)
+    else:
+        gate = _dense_gate(w, topi, E)
+        ge = jnp.einsum("btd,edi->btei", x32,
+                        lp["w_gate_e"].astype(jnp.float32))
+        up = jnp.einsum("btd,edi->btei", x32,
+                        lp["w_up_e"].astype(jnp.float32))
+        act = jax.nn.silu(ge) * up
+        down = jnp.einsum("btei,eid->bted", act,
+                          lp["w_down_e"].astype(jnp.float32))
+        out = jnp.einsum("bted,bte->btd", down, gate)
     if cfg.n_shared_experts > 0:
         out = out + _mlp(x32, lp["w_gate_s"].astype(jnp.float32),
                          lp["w_up_s"].astype(jnp.float32),
@@ -264,7 +287,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Same signature/contract as llama.forward; (kv_k, kv_v) ≡
     (latent pool, rope pool)."""
-    del allow_pallas, mesh  # latent attention is XLA-einsum throughout
+    del allow_pallas  # latent attention is XLA-einsum throughout;
+    # mesh is only consulted to pick the MoE dispatch strategy
     inv_freq = rope_freqs(cfg, dim=cfg.qk_rope_head_dim)
     H = cfg.num_heads
     r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
@@ -338,7 +362,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             new_c_parts.append(c_a)
             new_r_parts.append(r_a)
         seg_b.update(_moe_layer_params(cfg, params))
-        moe = layer_with(lambda x, lp: _deepseek_moe_mlp(x, lp, cfg))
+        moe = layer_with(
+            lambda x, lp: _deepseek_moe_mlp(x, lp, cfg, mesh=mesh))
         h, (c_b, r_b) = lax.scan(moe, h,
                                  (seg_b, kv_lat[kd:], kv_rope[kd:]))
         new_c_parts.append(c_b)
@@ -351,10 +376,11 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True, mesh=None):
     """Jitted (prefill_step, decode_step); same contract as llama.
-    Latent attention is XLA-einsum based throughout, so the pallas/mesh
-    kernel knobs are accepted for interface parity and ignored (GSPMD
-    shards the einsums directly)."""
-    del allow_pallas, mesh
+    Latent attention is XLA-einsum based throughout, so the pallas
+    kernel knob is accepted for interface parity and ignored (GSPMD
+    shards the einsums directly); mesh only picks the MoE dispatch
+    strategy (expert-sharded meshes keep the dense einsum)."""
+    del allow_pallas
 
     @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
     def prefill_step(params, tokens, positions, kv_k, kv_v, page_table,
@@ -364,14 +390,15 @@ def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True, mesh=None):
         # compressed latents, not per-head K/V blocks)
         del page_slots
         h, k2, v2 = forward(params, cfg, tokens, positions, kv_k, kv_v,
-                            page_table, flat_slots)
+                            page_table, flat_slots, mesh=mesh)
         return logits_at(params, cfg, h, last_idx), k2, v2
 
     @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
     def decode_step(params, tokens, positions, kv_k, kv_v, page_table,
                     flat_slots):
         h, k2, v2 = forward(params, cfg, tokens[:, None], positions[:, None],
-                            kv_k, kv_v, page_table, flat_slots[:, None])
+                            kv_k, kv_v, page_table, flat_slots[:, None],
+                            mesh=mesh)
         return (logits_at(params, cfg, h,
                           jnp.zeros(tokens.shape[0], jnp.int32)), k2, v2)
 

@@ -144,10 +144,23 @@ def kv_cache_pspec(cfg: ModelConfig) -> P:
 
 
 def shard_params(params, cfg: ModelConfig, mesh: Mesh):
+    from ..models.quant import QuantInt8
+
     specs = param_pspecs(cfg)
-    return {k: jax.device_put(
-        v, NamedSharding(mesh, specs.get(k, P(*([None] * v.ndim)))))
-        for k, v in params.items()}
+    out = {}
+    for k, v in params.items():
+        spec = specs.get(k, P(*([None] * v.ndim)))
+        if isinstance(v, QuantInt8):
+            # scale shape = weight shape with the contraction axis (-2)
+            # collapsed to 1 — that axis must stay unsharded in the
+            # scale's spec (can't split a size-1 dim over "model")
+            s_spec = P(*spec[:-2], None, spec[-1])
+            out[k] = QuantInt8(
+                jax.device_put(v.q, NamedSharding(mesh, spec)),
+                jax.device_put(v.s, NamedSharding(mesh, s_spec)))
+        else:
+            out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
 
 
 def shard_kv_cache(kv_k, kv_v, cfg: ModelConfig, mesh: Mesh):

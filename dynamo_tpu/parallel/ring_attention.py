@@ -45,17 +45,27 @@ NEG_INF = -1e30
 # ------------------------------------------------------------- ring kernel
 
 
-def _ring_attention_inner(q, k, v, q_pos, kv_pos, *, axis_name: str,
-                          scale: float):
+def _ring_attention_inner(q, k, v, q_pos, kv_pos, is_sliding, *,
+                          axis_name: str, scale: float,
+                          softcap=None, window=None):
     """Per-device body (runs under shard_map over ``axis_name``).
 
     q: [B, Tq, KV, G, hd] local query chunk (grouped GQA heads);
     k: [B, Tk, KV, hd]; v: [B, Tk, KV, dv] local key/value chunks —
     dv may differ from hd (MLA rides this kernel with keys
     [c_kv | k_rope] of width r+dr and values c_kv of width r);
-    q_pos/kv_pos: [B, T] absolute positions (-1 = padding).
+    q_pos/kv_pos: [B, T] absolute positions (-1 = padding);
+    is_sliding: traced scalar bool (Gemma-2 layer parity under scan).
+    ``softcap``/``window`` are the static Gemma-2 knobs: tanh softcap
+    applied BEFORE masking (models/llama._softcap_mask), and the
+    sliding window as a pure POSITION predicate (j > t - window) — it
+    needs no block locality, so any window size composes with any ring
+    chunking; blocks wholly outside a query's window just contribute
+    zero mass to its online softmax.
     Returns [B, Tq, KV, G, dv].
     """
+    from ..models.llama import _softcap_mask, _visible
+
     n = lax.psum(1, axis_name)
     B, Tq, KV, G, hd = q.shape
     dv = v.shape[-1]
@@ -70,10 +80,12 @@ def _ring_attention_inner(q, k, v, q_pos, kv_pos, *, axis_name: str,
         k_blk, v_blk, pos_blk, m, l, acc = carry
         scores = jnp.einsum("btkgh,bskh->bkgts", qf,
                             k_blk.astype(jnp.float32)) * scale
-        valid = (pos_blk[:, None, None, None, :] >= 0) & \
-                (pos_blk[:, None, None, None, :]
-                 <= q_pos[:, None, None, :, None])
-        scores = jnp.where(valid, scores, NEG_INF)
+        kvp = pos_blk[:, None, None, None, :]
+        qp = q_pos[:, None, None, :, None]
+        # same helpers as the paged path — ONE copy of the Gemma-2
+        # softcap-before-mask ordering and window-visibility invariants
+        valid = (kvp >= 0) & _visible(kvp, qp, window, is_sliding)
+        scores = _softcap_mask(scores, valid, softcap)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
         # exp only where valid: when a row has no valid keys yet, m_new is
         # still NEG_INF and exp(scores - m_new) would be exp(0)=1 — mask it
@@ -96,13 +108,17 @@ def _ring_attention_inner(q, k, v, q_pos, kv_pos, *, axis_name: str,
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    positions: jax.Array, mesh: Mesh, *,
-                   scale: float, seq_axis: str = "seq") -> jax.Array:
+                   scale: float, seq_axis: str = "seq",
+                   softcap=None, window=None,
+                   is_sliding=False) -> jax.Array:
     """Causal GQA attention with the sequence sharded over ``seq_axis``.
 
     q: [B, T, H, hd]; k/v: [B, T, KV, hd]; positions: [B, T] absolute
     (-1 for padding). All sequence-sharded over ``seq_axis``; heads may be
     additionally sharded over "model" (the kernel is per-head, so TP
-    composes freely). Returns [B, T, H, hd] with q's sharding.
+    composes freely). ``softcap``/``window``/``is_sliding`` are the
+    Gemma-2 semantics (see _ring_attention_inner). Returns [B, T, H, hd]
+    with q's sharding.
     """
     B, T, H, hd = q.shape
     KV = k.shape[2]
@@ -114,12 +130,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kvspec = P("data", seq_axis, "model", None)
     pspec = P("data", seq_axis)
 
-    inner = partial(_ring_attention_inner, axis_name=seq_axis, scale=scale)
+    inner = partial(_ring_attention_inner, axis_name=seq_axis, scale=scale,
+                    softcap=softcap, window=window)
     out = shard_map(
         inner, mesh=mesh,
-        in_specs=(qspec, kvspec, kvspec, pspec, pspec),
+        in_specs=(qspec, kvspec, kvspec, pspec, pspec, P()),
         out_specs=qspec, check_vma=False,
-    )(qg, k, v, positions, positions)
+    )(qg, k, v, positions, positions, jnp.asarray(is_sliding))
     return out.reshape(B, T, H, hd)
 
 
@@ -146,9 +163,10 @@ def ring_attention_mqa(q: jax.Array, k: jax.Array, v: jax.Array,
     inner = partial(_ring_attention_inner, axis_name=seq_axis, scale=scale)
     out = shard_map(
         inner, mesh=mesh,
-        in_specs=(qspec, kvspec, kvspec, pspec, pspec),
+        in_specs=(qspec, kvspec, kvspec, pspec, pspec, P()),
         out_specs=qspec, check_vma=False,
-    )(qg, k[:, :, None], v[:, :, None], positions, positions)
+    )(qg, k[:, :, None], v[:, :, None], positions, positions,
+      jnp.asarray(False))
     return out.reshape(B, T, H, -1)
 
 
@@ -167,8 +185,9 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
     transfer plane). ``positions`` are absolute; -1 marks padding.
     """
     from ..models.llama import (_act, _layer_keys, _mlp, _moe_mlp,
-                                _qk_headnorm, apply_rope, embed_tokens,
-                                project_logits, rms_norm, rope_freqs)
+                                _qk_headnorm, _residual_add, _sliding_flag,
+                                apply_rope, embed_tokens, project_logits,
+                                rms_norm, rope_freqs)
 
     inv_freq = rope_freqs(cfg)
     scale = cfg.attn_scale
@@ -185,7 +204,8 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
 
         layer_params = {kk: params[kk] for kk in _layer_keys(cfg)}
 
-        def layer(h, lp):
+        def layer(h, xs):
+            lp, l_idx = xs
             x = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.norm_unit_offset)
             xq, xk, xv = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
             if cfg.attn_bias:  # Qwen2-style qkv bias (matches llama.forward)
@@ -196,20 +216,26 @@ def make_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
             k = apply_rope(k, safe_pos, inv_freq)
             v = xv.reshape(B, T, KV, hd)
             attn = ring_attention(q, k, v, positions, mesh, scale=scale,
-                                  seq_axis=seq_axis)
-            h = h + attn.reshape(B, T, H * hd) @ lp["wo"]
+                                  seq_axis=seq_axis,
+                                  softcap=cfg.attn_logit_softcap,
+                                  window=cfg.sliding_window,
+                                  is_sliding=_sliding_flag(cfg, l_idx))
+            h = _residual_add(h, attn.reshape(B, T, H * hd) @ lp["wo"],
+                              lp, "ln_attn_post", cfg)
             x = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps, cfg.norm_unit_offset)
             if cfg.num_experts > 0:
-                h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"],
-                                 lp["w_up"], lp["w_down"],
-                                 cfg.num_experts_per_tok)
+                mlp_out = _moe_mlp(x, lp["w_router"], lp["w_gate"],
+                                   lp["w_up"], lp["w_down"],
+                                   cfg.num_experts_per_tok, mesh=mesh)
             else:
-                h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"],
-                             _act(cfg))
+                mlp_out = _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"],
+                               _act(cfg))
+            h = _residual_add(h, mlp_out, lp, "ln_mlp_post", cfg)
             h = lax.with_sharding_constraint(h, act_spec)
             return h, (k, v)
 
-        h, (k_all, v_all) = lax.scan(layer, h, layer_params)
+        h, (k_all, v_all) = lax.scan(
+            layer, h, (layer_params, jnp.arange(cfg.num_layers)))
         h = rms_norm(h, params["ln_final"], cfg.rms_norm_eps, cfg.norm_unit_offset)
         # logits at the true last token of each row (max position)
         last_idx = jnp.argmax(positions, axis=1)
@@ -297,7 +323,7 @@ def make_mla_long_prefill_fn(cfg: ModelConfig, mesh: Mesh, *,
             if cfg.num_experts > 0:
                 h = h + _moe_mlp(x, lp["w_router"], lp["w_gate"],
                                  lp["w_up"], lp["w_down"],
-                                 cfg.num_experts_per_tok)
+                                 cfg.num_experts_per_tok, mesh=mesh)
             else:
                 h = h + _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
             h = lax.with_sharding_constraint(h, act_spec)

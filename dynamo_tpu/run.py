@@ -96,6 +96,11 @@ def parse_args(argv=None):
         "DYN_PROFILE_DIR"), help="capture a JAX/XLA profiler trace of the "
         "serving session into this directory (view with xprof/tensorboard)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dtype", default="bf16", choices=["bf16", "int8"],
+                    help="int8 = weight-only quantized serving "
+                         "(models/quant.py): checkpoints quantize on the "
+                         "host at load; ~half the HBM and decode "
+                         "bytes/token of bf16")
     args = ap.parse_args(argv)
 
     if args.model_id and not args.model_path:
@@ -219,13 +224,15 @@ def build_engine(args) -> Tuple[object, object, bool]:
                     "over the mesh's seq axis)")
             ecfg = dataclasses.replace(
                 ecfg, long_prefill_threshold=args.long_prefill_threshold)
+        quant = "int8" if args.dtype == "int8" else None
         if args.model_path:
             try:
-                params = load_params(args.model_path, cfg)
+                params = load_params(args.model_path, cfg, quant=quant)
+                quant = None  # already applied on the host at load
             except FileNotFoundError:
                 log.warning("no weights at %s; random init", args.model_path)
         engine = JaxEngine(cfg, ecfg, params=params, seed=args.seed,
-                           mesh=mesh)
+                           mesh=mesh, quant=quant)
         if not args.no_warmup:
             engine.warmup(progress=True)
         return engine, mdc, False

@@ -1,0 +1,73 @@
+"""Worker for tests/test_multihost.py: joins a 2-process SPMD group via
+``initialize_multihost`` (the Ray-bootstrap replacement — reference
+lib/llm/src/engines/vllm/ray.rs), builds the GLOBAL 2x2 data×model mesh
+from both processes' CPU devices, runs one TP+DP-sharded forward, and
+checks its addressable output shards against a process-local oracle.
+
+Run as: python multihost_worker.py <coordinator> <num_procs> <pid>
+(env must set JAX_PLATFORMS=cpu and a 2-device virtual CPU host).
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # before backend init (conftest
+# trick: the ambient TPU plugin would otherwise grab the backend)
+
+import numpy as np  # noqa: E402
+
+
+def main(coordinator: str, num_processes: int, process_id: int) -> None:
+    from dynamo_tpu.parallel.mesh import initialize_multihost, param_pspecs
+
+    initialize_multihost(coordinator, num_processes, process_id)
+    assert jax.process_count() == num_processes, jax.process_count()
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    devs = jax.devices()
+    assert len(devs) == 2 * num_processes, devs  # 2 virtual CPUs per proc
+    mesh = Mesh(np.array(devs).reshape(num_processes, 2),
+                ("data", "model"))
+
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=8,
+                           hidden_size=32, vocab_size=128)
+    # identical on every process (deterministic PRNG) — the multi-host
+    # contract jax.distributed requires for jit'd programs
+    params_host = jax.tree.map(
+        np.asarray, llama.init_params(cfg, jax.random.PRNGKey(0),
+                                      dtype=jnp.float32))
+    specs = param_pspecs(cfg)
+
+    def gput(spec, a):
+        s = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(a.shape, s, lambda idx: a[idx])
+
+    gparams = {k: gput(specs.get(k, P(*([None] * v.ndim))), v)
+               for k, v in params_host.items()}
+    B, T = 2 * num_processes, 6
+    tokens = (np.arange(B * T, dtype=np.int32).reshape(B, T) * 7) % 120
+    gtokens = gput(P("data", None), tokens)
+
+    fwd = jax.jit(lambda p, t: llama.reference_forward(p, cfg, t))
+    logits = fwd(gparams, gtokens)
+    jax.block_until_ready(logits)
+
+    # oracle: same forward, process-local single device, full inputs
+    ref = np.asarray(fwd(jax.device_put(params_host),
+                         jax.device_put(tokens)))
+    for shard in logits.addressable_shards:
+        got = np.asarray(shard.data)
+        want = ref[shard.index]
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    print(f"MULTIHOST-OK pid={process_id} procs={jax.process_count()} "
+          f"global_devices={len(devs)} mesh={mesh.shape}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

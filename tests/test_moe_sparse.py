@@ -1,0 +1,180 @@
+"""Sparse MoE dispatch (models/llama.py moe_experts_blocked): parity
+with the dense-over-experts einsum, the ~top_k/E FLOP claim (measured
+via XLA cost analysis, not asserted by hand), quantized-weight
+interplay, and serving-path engagement. Reference analog: vLLM's
+fused_moe dispatch, which the reference's flagship Mixtral/DeepSeek
+configs serve through."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models import llama
+
+
+def _routing(key, N, E, k):
+    logits = jax.random.normal(key, (N, E))
+    w, idx = jax.lax.top_k(logits, k)
+    return jax.nn.softmax(w, axis=-1), idx
+
+
+def _dense_ref(x, w, idx, wg, wu, wd):
+    E = wg.shape[0]
+    gate = jnp.sum(jax.nn.one_hot(idx, E, dtype=jnp.float32)
+                   * w[..., None], axis=-2)           # [N, E]
+    ge = jnp.einsum("nd,edi->nei", x, wg)
+    up = jnp.einsum("nd,edi->nei", x, wu)
+    act = jax.nn.silu(ge) * up
+    down = jnp.einsum("nei,eid->ned", act, wd)
+    return jnp.einsum("ned,ne->nd", down, gate)
+
+
+def _weights(key, E, D, I):
+    k1, k2, k3 = jax.random.split(key, 3)
+    s = 1.0 / np.sqrt(D)
+    return (jax.random.normal(k1, (E, D, I)) * s,
+            jax.random.normal(k2, (E, D, I)) * s,
+            jax.random.normal(k3, (E, I, D)) / np.sqrt(I))
+
+
+@pytest.mark.parametrize("N,E,k,block", [
+    (512, 8, 2, 256),
+    (300, 16, 4, 64),   # N*k not a block multiple; many experts
+    (256, 4, 1, 256),   # k=1
+])
+def test_blocked_matches_dense(N, E, k, block):
+    D, I = 32, 48
+    wg, wu, wd = _weights(jax.random.PRNGKey(0), E, D, I)
+    x = jax.random.normal(jax.random.PRNGKey(1), (N, D), jnp.float32)
+    w, idx = _routing(jax.random.PRNGKey(2), N, E, k)
+    ref = _dense_ref(x, w, idx, wg, wu, wd)
+    got = llama.moe_experts_blocked(x, w, idx, wg, wu, wd, block=block)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_blocked_skewed_routing_no_drops():
+    """Every token routed to ONE expert — the group padding must absorb
+    the full N*k load on a single expert without dropping tokens (the
+    correctness property capacity-based dispatches give up)."""
+    N, E, k, D, I = 257, 8, 2, 16, 24
+    wg, wu, wd = _weights(jax.random.PRNGKey(3), E, D, I)
+    x = jax.random.normal(jax.random.PRNGKey(4), (N, D), jnp.float32)
+    idx = jnp.full((N, k), 3, jnp.int32)
+    w = jnp.full((N, k), 0.5, jnp.float32)
+    ref = _dense_ref(x, w, idx, wg, wu, wd)
+    got = llama.moe_experts_blocked(x, w, idx, wg, wu, wd, block=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_blocked_flops_scale_with_topk_not_experts():
+    """XLA cost analysis: the blocked dispatch must cost ~top_k/E of the
+    dense einsum. E=16, k=2 → exact ratio 1/8; padding and dispatch
+    overhead allowed up to 1/3."""
+    N, E, k, D, I = 1024, 16, 2, 64, 128
+    wg, wu, wd = _weights(jax.random.PRNGKey(5), E, D, I)
+    x = jax.random.normal(jax.random.PRNGKey(6), (N, D), jnp.float32)
+    w, idx = _routing(jax.random.PRNGKey(7), N, E, k)
+
+    def flops(fn):
+        c = jax.jit(fn).lower(x, w, idx, wg, wu, wd).compile()
+        return c.cost_analysis()["flops"]
+
+    dense = flops(lambda *a: _dense_ref(*a))
+    blocked = flops(lambda x, w, idx, wg, wu, wd:
+                    llama.moe_experts_blocked(x, w, idx, wg, wu, wd,
+                                              block=128))
+    ratio = blocked / dense
+    assert ratio < 1 / 3, f"blocked/dense flops = {ratio:.3f}"
+
+
+def test_blocked_with_quantized_experts():
+    """_dyn_expert slices the int8 stack THEN dequantizes — parity with
+    quantize→dense within matmul tolerance."""
+    from dynamo_tpu.models.quant import quantize_int8
+
+    N, E, k, D, I = 300, 8, 2, 32, 48
+    wg, wu, wd = _weights(jax.random.PRNGKey(8), E, D, I)
+    x = jax.random.normal(jax.random.PRNGKey(9), (N, D), jnp.float32)
+    w, idx = _routing(jax.random.PRNGKey(10), N, E, k)
+    qg, qu, qd = quantize_int8(wg), quantize_int8(wu), quantize_int8(wd)
+    ref = _dense_ref(x, w, idx, qg.dequant(jnp.float32),
+                     qu.dequant(jnp.float32), qd.dequant(jnp.float32))
+    got = llama.moe_experts_blocked(x, w, idx, qg, qu, qd, block=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_cost_model_trigger():
+    """The blocked path engages only where its worst-case row-MLP cost
+    (N·k + E·block) is at most HALF the dense einsum's (N·E), and never
+    under a >1-device mesh."""
+    use = llama._moe_use_blocked
+    # Mixtral-ish E=8, k=2, block=256: breakeven/2 at N=1024
+    assert not use(None, 256, 8, 2, 256)   # blocked would be ~1.25x DENSE
+    assert not use(None, 1023, 8, 2, 256)
+    assert use(None, 1024, 8, 2, 256)
+    # Qwen3-MoE-ish E=128, k=8: huge dense waste — engages much earlier
+    assert use(None, 600, 128, 8, 256)
+    assert not use(None, 128, 128, 8, 256)  # decode-sized: dense
+    # never on a sharded mesh
+    from dynamo_tpu.parallel.mesh import MeshSpec
+    assert not use(MeshSpec(data=2, model=2, expert=2).build(), 4096, 8,
+                   2, 256)
+
+
+def test_moe_mlp_paths_agree(monkeypatch):
+    """_moe_mlp with the blocked path engaged (small block via the env
+    knob's module constant) == the dense path — strategy is a pure
+    execution detail."""
+    cfg = ModelConfig.tiny(num_experts=8, num_experts_per_tok=2,
+                           model_type="mixtral")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    wr, wg, wu, wd = (params[k][0] for k in
+                      ("w_router", "w_gate", "w_up", "w_down"))
+    big = jax.random.normal(jax.random.PRNGKey(1),
+                            (1, 512, cfg.hidden_size), jnp.bfloat16)
+
+    monkeypatch.setattr(llama, "_MOE_BLOCK", 64)  # N·k+E·64=1536 ≤ 2048
+    assert llama._moe_use_blocked(None, 512, 8, 2, llama._MOE_BLOCK)
+    out_blocked = llama._moe_mlp(big, wr, wg, wu, wd, 2)
+    monkeypatch.setattr(llama, "_MOE_BLOCK", 1 << 30)  # forces dense
+    out_dense = llama._moe_mlp(big, wr, wg, wu, wd, 2)
+    np.testing.assert_allclose(
+        np.asarray(out_blocked, np.float32),
+        np.asarray(out_dense, np.float32),
+        rtol=5e-2, atol=5e-2)  # bf16 inputs; different summation orders
+
+
+def test_moe_serving_prefill_blocked_matches_dense(monkeypatch):
+    """End-to-end through llama.forward (paged prefill): the blocked
+    path engaged via a small block == the dense-forced forward."""
+    cfg = ModelConfig.tiny(num_experts=8, num_experts_per_tok=2,
+                           model_type="mixtral")
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    spec = llama.KVCacheSpec(num_pages=64, page_size=8)
+    kv_k, kv_v = llama.init_kv_cache(cfg, spec)
+    T = 256
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, T), 0, 500)
+    positions = jnp.broadcast_to(jnp.arange(T), (1, T))
+    table = jnp.arange(64, dtype=jnp.int32).reshape(1, 64)
+    flat = table[0, positions // 8] * 8 + positions % 8
+
+    def run():
+        h, _, _ = llama.forward(params, cfg, tokens, positions, kv_k,
+                                kv_v, table, flat)
+        return h
+
+    monkeypatch.setattr(llama, "_MOE_BLOCK", 32)  # 512+256 ≤ 2048/2
+    assert llama._moe_use_blocked(None, T, 8, 2, llama._MOE_BLOCK)
+    blocked_h = run()
+    monkeypatch.setattr(llama, "_MOE_BLOCK", 1 << 30)
+    dense_h = run()
+    np.testing.assert_allclose(
+        np.asarray(blocked_h, np.float32), np.asarray(dense_h, np.float32),
+        rtol=5e-2, atol=5e-2)

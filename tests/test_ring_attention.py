@@ -86,6 +86,103 @@ def test_long_prefill_matches_reference_forward():
                            cfg.head_dim_)
 
 
+def _gemma2_tiny():
+    return ModelConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=16,
+                            hidden_size=64, vocab_size=256,
+                            model_type="gemma2", sandwich_norms=True,
+                            embed_scale=True, norm_unit_offset=True,
+                            hidden_act="gelu_tanh",
+                            attn_logit_softcap=20.0,
+                            final_logit_softcap=30.0, sliding_window=6,
+                            query_pre_attn_scalar=16.0)
+
+
+def test_ring_kernel_sliding_window_and_softcap():
+    """The ring kernel with Gemma-2 knobs == dense attention with the
+    same mask/softcap — including a window SMALLER than a ring block
+    (window is a position predicate, not a block-local one) and one
+    larger than a block."""
+    mesh = MeshSpec(seq=4).build()
+    rng = np.random.RandomState(3)
+    B, T, H, KV, hd = 2, 32, 4, 2, 16
+    q = jnp.asarray(rng.randn(B, T, H, hd), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, KV, hd), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, KV, hd), jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+    for window in (5, 13):  # block = T/4 = 8: below and above
+        for softcap in (None, 20.0):
+            with jax.set_mesh(mesh):
+                out = ring_attention(q, k, v, positions, mesh, scale=0.25,
+                                     softcap=softcap, window=window,
+                                     is_sliding=True)
+            qg = q.reshape(B, T, KV, H // KV, hd)
+            scores = jnp.einsum("btkgh,bskh->bkgts",
+                                qg.astype(jnp.float32), k) * 0.25
+            if softcap:
+                scores = softcap * jnp.tanh(scores / softcap)
+            j, t = positions[:, None, :], positions[:, :, None]
+            vis = (j <= t) & (j > t - window)
+            scores = jnp.where(vis[:, None, None], scores, -1e30)
+            ref = jnp.einsum("bkgts,bskh->btkgh",
+                             jax.nn.softmax(scores, axis=-1),
+                             v).reshape(B, T, H, hd)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-5)
+            # global layers must ignore the window even when one is set
+            with jax.set_mesh(mesh):
+                out_g = ring_attention(q, k, v, positions, mesh,
+                                       scale=0.25, softcap=softcap,
+                                       window=window, is_sliding=False)
+            scores_g = jnp.einsum("btkgh,bskh->bkgts",
+                                  qg.astype(jnp.float32), k) * 0.25
+            if softcap:
+                scores_g = softcap * jnp.tanh(scores_g / softcap)
+            scores_g = jnp.where((j <= t)[:, None, None], scores_g, -1e30)
+            ref_g = jnp.einsum("bkgts,bskh->btkgh",
+                               jax.nn.softmax(scores_g, axis=-1),
+                               v).reshape(B, T, H, hd)
+            np.testing.assert_allclose(np.asarray(out_g),
+                                       np.asarray(ref_g),
+                                       rtol=2e-4, atol=2e-5)
+
+
+def test_long_prefill_gemma2_matches_reference_forward():
+    """Gemma-2 semantics (sliding window on even layers, score + final
+    softcaps, sandwich norms, embed scale) through the sequence-parallel
+    ring prefill == the dense reference forward (VERDICT r4 task 7 —
+    this was a hard ValueError for two rounds)."""
+    cfg = _gemma2_tiny()
+    mesh = MeshSpec(seq=4, model=2).build()
+    params = init_params(cfg, jax.random.PRNGKey(4))
+    sharded = shard_params(params, cfg, mesh)
+    B, T = 2, 32
+    tokens = jnp.asarray(
+        np.random.RandomState(5).randint(1, 250, (B, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    fn = make_long_prefill_fn(cfg, mesh)
+    with jax.set_mesh(mesh):
+        logits, k_all, v_all = fn(sharded, tokens, positions)
+    ref = reference_forward(params, cfg, tokens)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref[:, -1]),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_engine_accepts_gemma2_long_prefill():
+    """JaxEngine no longer refuses Gemma-2 + long_prefill_threshold."""
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+
+    cfg = _gemma2_tiny()
+    mesh = MeshSpec(seq=4, model=2).build()
+    eng = JaxEngine(cfg, EngineConfig(page_size=8, num_pages=32,
+                                      max_batch=2, prefill_chunk=16,
+                                      prefill_buckets=(16,),
+                                      batch_buckets=(1, 2),
+                                      page_buckets=(8,),
+                                      long_prefill_threshold=16),
+                    mesh=mesh)
+    assert eng.long_prefill_fn is not None
+
+
 def test_scatter_prefill_kv_roundtrip():
     """K/V from long prefill lands in the paged pool where the paged
     decode path expects it."""

@@ -8,12 +8,14 @@
 # Usage:  bash tools/chip_session.sh [outdir]        (defaults bench_results)
 # Env:    PYTHONPATH must include /root/.axon_site; JAX_PLATFORMS=axon.
 #
-# Priority order (VERDICT r3 "Next round"):
-#   1. headline    — the driver-verified number everything flows through
-#   2. prefill A/B — flash prefill kernel ±DYN_PREFILL_PALLAS (task 2)
-#   3. sweep       — batch geometry roofline (task 3)
-#   4. multiturn   — host-tier TTFT with the overlapped restores (task 4a)
-#   5. disagg      — on-chip A/B with transfer breakdown (task 4b)
+# Priority order (VERDICT r4 "Next round"):
+#   1. headline     — the driver-verified number everything flows through
+#   2. int8 A/B     — same 1b workload, weight-only int8 (r4 task 2)
+#   3. 8b headline  — north-star model size, int8 (r4 task 3)
+#   4. prefill A/B  — flash prefill kernel ±DYN_PREFILL_PALLAS
+#   5. sweep        — batch geometry roofline
+#   6. multiturn    — host-tier TTFT with the overlapped restores
+#   7. disagg       — on-chip A/B with transfer breakdown
 
 set -u
 cd "$(dirname "$0")/.."
@@ -37,18 +39,25 @@ run_step() {  # name timeout_s args...
 # 1. headline (driver workload, defaults)
 run_step headline 1200
 
-# 2. flash prefill kernel A/B (same workload, kernel prefill on)
+# 2. int8 weight-only A/B on the same workload (decode is HBM-bound:
+#    expect tok/s up from halved weight bytes/step)
+run_step int8_1b 1200 --dtype int8
+
+# 3. 8B north-star (BASELINE.md model size; int8 is what fits 16 GB)
+run_step headline_8b 2400 --model 8b --dtype int8 --concurrency 16
+
+# 4. flash prefill kernel A/B (same workload, kernel prefill on)
 DYN_PREFILL_PALLAS=1 run_step prefill_pallas 1200
 
-# 3. batch-geometry sweep (each distinct max_batch:K pays one warmup)
+# 5. batch-geometry sweep (each distinct max_batch:K pays one warmup)
 run_step sweep 4200 --sweep \
     "32:64:4,32:64:16,64:64:8,64:64:16,128:64:16,64:128:8,128:128:8,128:128:16"
 
-# 4. multiturn host-tier TTFT: no-tier baseline, then the tier
+# 6. multiturn host-tier TTFT: no-tier baseline, then the tier
 run_step multiturn_base 1500 --scenario multiturn --host-pages 0
 run_step multiturn_tier 2400 --scenario multiturn --host-pages 4096
 
-# 5. disagg A/B with the transfer breakdown
+# 7. disagg A/B with the transfer breakdown
 run_step disagg 2400 --scenario disagg
 
 echo "=== chip session complete; results in $OUT/ ==="
