@@ -26,6 +26,12 @@ _ROUTES = {
     "Deployment": ("apis/apps/v1", "deployments"),
     "Service": ("api/v1", "services"),
     "ConfigMap": ("api/v1", "configmaps"),
+    "Ingress": ("apis/networking.k8s.io/v1", "ingresses"),
+    # optional Istio plane (reference operator's VirtualService path,
+    # dynamonimdeployment_controller.go:1133) — only touched when a CR
+    # asks for it, so clusters without Istio never see the route
+    "VirtualService": ("apis/networking.istio.io/v1beta1",
+                       "virtualservices"),
 }
 
 

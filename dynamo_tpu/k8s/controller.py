@@ -29,6 +29,10 @@ from .render import render
 log = logging.getLogger("dynamo_tpu.k8s")
 
 MANAGED_BY = "dynamo-tpu-operator"
+# kinds the controller owns; VirtualService only exists on Istio clusters
+MANAGED_KINDS = ("Deployment", "Service", "ConfigMap", "Ingress",
+                 "VirtualService")
+OPTIONAL_KINDS = frozenset({"VirtualService"})
 SPEC_HASH_ANN = "dynamo-tpu.dev/spec-hash"
 
 
@@ -105,11 +109,9 @@ class Reconciler:
         # list each managed kind ONCE per pass and partition by instance
         # label — per-CR listing would cost 3N+1 apiserver calls per tick
         observed_by_cr: Dict[str, Dict[Tuple[str, str], Dict[str, Any]]] = {}
-        for kind in ("Deployment", "Service", "ConfigMap"):
+        for kind in MANAGED_KINDS:
             sel = f"app.kubernetes.io/managed-by={MANAGED_BY}"
-            for obj in self.client.list(kind, namespace,
-                                        label_selector=sel):
-                obj.setdefault("kind", kind)
+            for obj in self._list_tolerant(kind, namespace, sel):
                 inst = (obj.get("metadata", {}).get("labels", {})
                         .get("app.kubernetes.io/instance"))
                 if inst is not None:
@@ -126,11 +128,34 @@ class Reconciler:
         selector = (f"app.kubernetes.io/managed-by={MANAGED_BY},"
                     f"app.kubernetes.io/instance={name}")
         observed: Dict[Tuple[str, str], Dict[str, Any]] = {}
-        for kind in ("Deployment", "Service", "ConfigMap"):
-            for obj in self.client.list(kind, ns, label_selector=selector):
-                obj.setdefault("kind", kind)
+        for kind in MANAGED_KINDS:
+            for obj in self._list_tolerant(kind, ns, selector):
                 observed[_key(obj)] = obj
         return observed
+
+    def _list_tolerant(self, kind: str, ns: str, selector: str):
+        """List a managed kind, tolerating clusters without the optional
+        networking CRDs (Istio VirtualService): a NOT-FOUND on the route
+        means "none exist", not a reconcile failure — a CR that never
+        asks for Istio must reconcile cleanly on a vanilla cluster.
+        ONLY not-found qualifies: a 403/timeout/500 on an optional kind
+        must still surface (demoting it would make a transient apiserver
+        error indistinguishable from "Istio not installed" and hot-loop
+        create→409 against existing objects)."""
+        try:
+            out = []
+            for obj in self.client.list(kind, ns, label_selector=selector):
+                obj.setdefault("kind", kind)
+                out.append(obj)
+            return out
+        except Exception as e:  # noqa: BLE001
+            msg = str(e).lower()
+            if kind in OPTIONAL_KINDS and (
+                    "404" in msg or "not found" in msg
+                    or "could not find" in msg):
+                log.debug("optional kind %s unavailable: %s", kind, e)
+                return []
+            raise
 
     def reconcile(self, cr: Dict[str, Any],
                   observed: Optional[Dict[Tuple[str, str],

@@ -64,7 +64,26 @@ def render(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
         "metadata": {"name": cfgmap_name, "namespace": ns, "labels": labels},
         "data": {"config.yaml": config_yaml}})
 
-    for svc_name, svc in (s.get("services") or {}).items():
+    services = s.get("services") or {}
+    frontends = [n for n, v in services.items() if v.get("frontend")]
+    spec_ing = s.get("ingress")
+    if (spec_ing and spec_ing.get("enabled", True)
+            and len(frontends) > 1 and not spec_ing.get("service")):
+        # two Ingresses claiming the same host+path would route
+        # arbitrarily — refuse loudly instead (per-service `ingress`
+        # blocks or an explicit `ingress.service` disambiguate)
+        raise ValueError(
+            f"spec.ingress is ambiguous with {len(frontends)} frontend "
+            f"services ({', '.join(frontends)}): set ingress.service or "
+            "move ingress under one service")
+    # debug-split targets need a backing Service even when they are not
+    # frontends (the canary Ingress / Istio debug route points at them)
+    debug_targets = set()
+    for ing in [spec_ing] + [v.get("ingress") for v in services.values()]:
+        if ing and ing.get("enabled", True) and ing.get("debugService"):
+            debug_targets.add(ing["debugService"])
+
+    for svc_name, svc in services.items():
         slug = svc_name.lower()
         tpu = svc.get("tpuAccelerator")
         pod: Dict[str, Any] = {
@@ -110,7 +129,7 @@ def render(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
                     "metadata": {"labels": {"app": f"{name}-{slug}",
                                             **labels}},
                     "spec": pod}}})
-        if svc.get("frontend"):
+        if svc.get("frontend") or svc_name in debug_targets:
             out.append({
                 "apiVersion": "v1", "kind": "Service",
                 "metadata": {"name": f"{name}-{slug}", "namespace": ns,
@@ -118,6 +137,122 @@ def render(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
                 "spec": {"selector": {"app": f"{name}-{slug}"},
                          "ports": [{"port": svc.get("port", 8080)}],
                          "type": svc.get("serviceType", "ClusterIP")}})
+        if svc.get("frontend"):
+            ing = svc.get("ingress")
+            if ing is None and spec_ing is not None:
+                target = spec_ing.get("service")
+                if target is None or target == svc_name:
+                    ing = spec_ing
+            if ing:
+                out.extend(_render_networking(name, ns, slug, svc, ing,
+                                              labels))
+    return out
+
+
+def _render_networking(name: str, ns: str, slug: str,
+                       svc: Dict[str, Any], ing: Dict[str, Any],
+                       labels: Dict[str, str]) -> List[Dict[str, Any]]:
+    """Cluster networking for a frontend service — the reference
+    operator's ingress plane (deploy/dynamo/operator pkg/dynamo/system/
+    ingress.go: networking/v1 Ingress from a network config;
+    internal/controller dynamonimdeployment_controller.go:1133: Istio
+    VirtualService; internal/envoy/envoy.go: header-routed
+    debug/production split), expressed K8s-natively:
+
+    - ``spec.ingress`` → networking/v1 Ingress (class, host, path,
+      annotations, TLS);
+    - ``ingress.istio: true`` → an Istio VirtualService instead;
+    - ``ingress.debugService`` → a second CANARY Ingress routing
+      requests carrying the debug header to that service
+      (ingress-controller canary-by-header — the K8s-native form of the
+      reference's Envoy header split; no sidecar proxy to manage).
+    """
+    if not ing or not ing.get("enabled", True):
+        return []
+    port = svc.get("port", 8080)
+    backend_svc = f"{name}-{slug}"
+    host = ing.get("host") or (
+        f"{name}.{ing['hostSuffix']}" if ing.get("hostSuffix") else None)
+    path = ing.get("path", "/")
+    path_type = ing.get("pathType", "Prefix")
+    out: List[Dict[str, Any]] = []
+
+    if ing.get("istio"):
+        vs: Dict[str, Any] = {
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "VirtualService",
+            "metadata": {"name": backend_svc, "namespace": ns,
+                         "labels": labels},
+            "spec": {
+                "hosts": [host or backend_svc],
+                "gateways": [ing.get("istioGateway", "istio-system/"
+                                     "ingress-gateway")],
+                "http": [{
+                    "match": [{"uri": {"prefix": path}}],
+                    "route": [{"destination": {
+                        "host": f"{backend_svc}.{ns}.svc.cluster.local",
+                        "port": {"number": port}}}],
+                }],
+            }}
+        if ing.get("debugService"):
+            # header-matched route first (Istio evaluates in order)
+            vs["spec"]["http"].insert(0, {
+                "match": [{
+                    "uri": {"prefix": path},
+                    "headers": {ing.get("debugHeader", "x-dynamo-debug"):
+                                {"exact": ing.get("debugHeaderValue",
+                                                  "1")}},
+                }],
+                "route": [{"destination": {
+                    "host": (f"{name}-{ing['debugService'].lower()}"
+                             f".{ns}.svc.cluster.local"),
+                    "port": {"number": port}}}],
+            })
+        return [vs]
+
+    def rule(svc_name: str) -> Dict[str, Any]:
+        r: Dict[str, Any] = {"http": {"paths": [{
+            "path": path, "pathType": path_type,
+            "backend": {"service": {"name": svc_name,
+                                    "port": {"number": port}}}}]}}
+        if host:
+            r["host"] = host
+        return r
+
+    ingress: Dict[str, Any] = {
+        "apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+        "metadata": {"name": backend_svc, "namespace": ns,
+                     "labels": labels,
+                     "annotations": dict(ing.get("annotations") or {})},
+        "spec": {"rules": [rule(backend_svc)]},
+    }
+    if ing.get("className"):
+        ingress["spec"]["ingressClassName"] = ing["className"]
+    if ing.get("tlsSecret"):
+        ingress["spec"]["tls"] = [{"hosts": [host] if host else [],
+                                   "secretName": ing["tlsSecret"]}]
+    out.append(ingress)
+
+    if ing.get("debugService"):
+        canary = {
+            "apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+            "metadata": {
+                "name": f"{backend_svc}-debug", "namespace": ns,
+                "labels": labels,
+                "annotations": {
+                    **dict(ing.get("annotations") or {}),
+                    "nginx.ingress.kubernetes.io/canary": "true",
+                    "nginx.ingress.kubernetes.io/canary-by-header":
+                        ing.get("debugHeader", "x-dynamo-debug"),
+                    "nginx.ingress.kubernetes.io/canary-by-header-value":
+                        ing.get("debugHeaderValue", "1"),
+                }},
+            "spec": {"rules": [rule(
+                f"{name}-{ing['debugService'].lower()}")]},
+        }
+        if ing.get("className"):
+            canary["spec"]["ingressClassName"] = ing["className"]
+        out.append(canary)
     return out
 
 

@@ -333,3 +333,77 @@ def test_mixed_and_unnamed_port_lists_tolerate_server_additions():
 
     # scalar lists stay strict: an appended arg is drift to heal
     assert _owned_fields_drifted({"args": ["-a"]}, {"args": ["-a", "-b"]})
+
+
+def test_ingress_converges_and_drift_heals():
+    """A CR with spec.ingress converges an Ingress child (ownerRefs,
+    managed-by labels), heals class drift, and deletes it when the spec
+    drops ingress — the reference operator's networking plane
+    (pkg/dynamo/system/ingress.go) under the same convergence rules as
+    Deployments."""
+    kube = FakeKube()
+    cr = example_cr()
+    cr["spec"]["ingress"] = {"className": "nginx", "host": "llama.io"}
+    kube.create("DynamoDeployment", "serving", cr)
+    rec = Reconciler(kube)
+    rec.reconcile_all("serving")
+
+    ing = kube.get("Ingress", "serving", "llama-disagg-routedfrontend")
+    assert ing is not None
+    assert ing["metadata"]["labels"][
+        "app.kubernetes.io/managed-by"] == MANAGED_BY
+    assert ing["metadata"]["ownerReferences"][0]["name"] == "llama-disagg"
+    assert ing["spec"]["ingressClassName"] == "nginx"
+
+    # kubectl-edit drift on an owned field heals
+    broken = kube.get("Ingress", "serving", "llama-disagg-routedfrontend")
+    broken["spec"]["ingressClassName"] = "other"
+    kube.store[("Ingress", "serving",
+                "llama-disagg-routedfrontend")] = broken
+    rec.reconcile_all("serving")
+    assert kube.get("Ingress", "serving", "llama-disagg-routedfrontend")[
+        "spec"]["ingressClassName"] == "nginx"
+
+    # dropping ingress from the spec orphan-deletes the child
+    cr2 = kube.get("DynamoDeployment", "serving", "llama-disagg")
+    del cr2["spec"]["ingress"]
+    kube.store[("DynamoDeployment", "serving", "llama-disagg")] = cr2
+    rec.reconcile_all("serving")
+    assert kube.get("Ingress", "serving",
+                    "llama-disagg-routedfrontend") is None
+
+
+def test_istio_route_absent_cluster_tolerated():
+    """On a cluster without the Istio CRDs the VirtualService list 404s;
+    reconcile must treat that as 'none exist', not fail — and still
+    converge everything else."""
+
+    class NoIstioKube(FakeKube):
+        def list(self, kind, namespace, label_selector=None):
+            if kind == "VirtualService":
+                raise RuntimeError("404 the server could not find the "
+                                   "requested resource")
+            return super().list(kind, namespace, label_selector)
+
+    kube = NoIstioKube()
+    kube.create("DynamoDeployment", "serving", example_cr())
+    Reconciler(kube).reconcile_all("serving")
+    assert kube.get("Deployment", "serving", "llama-disagg-dcp")
+
+
+def test_istio_route_non_404_error_raises():
+    """Only NOT-FOUND demotes to 'no VirtualServices'; a 403/timeout on
+    the optional kind must surface (otherwise a transient apiserver
+    error is indistinguishable from 'Istio not installed')."""
+
+    class ForbiddenKube(FakeKube):
+        def list(self, kind, namespace, label_selector=None):
+            if kind == "VirtualService":
+                raise RuntimeError("403 forbidden")
+            return super().list(kind, namespace, label_selector)
+
+    kube = ForbiddenKube()
+    kube.create("DynamoDeployment", "serving", example_cr())
+    import pytest
+    with pytest.raises(RuntimeError, match="403"):
+        Reconciler(kube)._observe("serving", "llama-disagg")
