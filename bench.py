@@ -44,8 +44,10 @@ def metric_name(args) -> str:
                 f"(ISL~{args.isl}/OSL {args.osl}, {_model_tag(args)} "
                 "llama, 1 chip)")
     if args.scenario == "multiturn":
+        tier = str(args.host_pages) + (
+            "-int8" if getattr(args, "host_tier_int8", False) else "")
         return (f"TTFT p50 (later turns), multiturn {args.users}u x "
-                f"{args.turns}t, host_pages={args.host_pages}")
+                f"{args.turns}t, host_pages={tier}")
     if args.scenario == "disagg":
         return (f"disagg/agg req/s ratio (1-chip time-shared, threshold "
                 f"{args.disagg_threshold})")
@@ -173,6 +175,10 @@ def parse_args():
                          "iteration, interleave decode windows")
     ap.add_argument("--host-pages", type=int, default=0,
                     help="host-DRAM offload tier size (multiturn scenario)")
+    ap.add_argument("--host-tier-int8", action="store_true",
+                    help="int8-compress the host tier: half the D2H/H2D "
+                         "bytes per page move (lossy; "
+                         "engine/kv_compress.py)")
     ap.add_argument("--users", type=int, default=16)
     ap.add_argument("--turns", type=int, default=4)
     ap.add_argument("--max-batch", type=int, default=None,
@@ -245,6 +251,8 @@ def build_engine(args):
         # (~10 pages/user HBM vs histories growing past 17 pages)
         ecfg.num_pages = min(ecfg.num_pages, 10 * args.users)
         ecfg.host_pages = args.host_pages
+    if args.host_tier_int8:
+        ecfg.host_tier_int8 = True
     print(f"devices: {jax.devices()}", file=sys.stderr)
     engine = JaxEngine(cfg, ecfg, seed=args.seed,
                        quant="int8" if args.dtype == "int8" else None)

@@ -70,6 +70,12 @@ class EngineConfig:
     # `prefilling` while their restores drain across iterations; 0 =
     # unlimited (the old single-shot behavior)
     tier_restore_chunk: int = 32
+    # int8-compress the host tier (engine/kv_compress.py): pages are
+    # quantized ON DEVICE before D2H and dequantized ON DEVICE after
+    # H2D, so the slow host link moves ~half the bytes and the host
+    # pool holds ~2x the pages per GB. LOSSY (restored pages round-trip
+    # through int8) — opt-in
+    host_tier_int8: bool = False
     max_prefill_batch: int = 8  # prompts packed per prefill dispatch
     # fused decode window: run K decode+sample steps inside ONE jitted
     # program (sampling stays on device; tokens cross to the host once per
@@ -280,13 +286,24 @@ class JaxEngine:
                               host_pages=self.ecfg.host_pages)
         # host-DRAM offload pools (same per-page layout as the HBM pool)
         self.host_k = self.host_v = None
+        self.host_k_s = self.host_v_s = None
         if self.ecfg.host_pages > 0:
             hshape = (model_cfg.num_layers, self.ecfg.host_pages,
                       model_cfg.num_kv_heads, self.ecfg.page_size,
                       model_cfg.head_dim_)
-            hdtype = np.asarray(jnp.zeros((), self.kv_k.dtype)).dtype
-            self.host_k = np.zeros(hshape, hdtype)
-            self.host_v = np.zeros(hshape, hdtype)
+            if self.ecfg.host_tier_int8:
+                # compressed tier: int8 rows + f32 per-row scales — the
+                # D2H/H2D link moves ~half the bytes and the same host
+                # RAM holds ~2x the pages (engine/kv_compress.py)
+                self.host_k = np.zeros(hshape, np.int8)
+                self.host_v = np.zeros(hshape, np.int8)
+                sshape = hshape[:-1] + (1,)
+                self.host_k_s = np.zeros(sshape, np.float32)
+                self.host_v_s = np.zeros(sshape, np.float32)
+            else:
+                hdtype = np.asarray(jnp.zeros((), self.kv_k.dtype)).dtype
+                self.host_k = np.zeros(hshape, hdtype)
+                self.host_v = np.zeros(hshape, hdtype)
         self.offload_pages_total = 0
         self.restore_pages_total = 0
         # guards PageManager between the event-loop thread (_admit) and
@@ -671,10 +688,19 @@ class JaxEngine:
 
     def _land_inflight_offloads(self, entries) -> None:
         """Copy parked offload gathers into the host pool (the D2H
-        readback that overlapped the intervening device steps)."""
+        readback that overlapped the intervening device steps). Under
+        host_tier_int8 each entry carries (q, s) pairs — quantized on
+        device before the D2H, so these np.asarray reads move int8."""
         for k_dev, v_dev, oslots, n in entries:
-            self.host_k[:, oslots] = np.asarray(k_dev)[:, :n]
-            self.host_v[:, oslots] = np.asarray(v_dev)[:, :n]
+            if self.ecfg.host_tier_int8:
+                (kq, ks), (vq, vs) = k_dev, v_dev
+                self.host_k[:, oslots] = np.asarray(kq)[:, :n]
+                self.host_k_s[:, oslots] = np.asarray(ks)[:, :n]
+                self.host_v[:, oslots] = np.asarray(vq)[:, :n]
+                self.host_v_s[:, oslots] = np.asarray(vs)[:, :n]
+            else:
+                self.host_k[:, oslots] = np.asarray(k_dev)[:, :n]
+                self.host_v[:, oslots] = np.asarray(v_dev)[:, :n]
 
     def _drain_kv_tier(self, full: bool = False) -> None:
         """Run queued HBM↔host page copies (executor thread, before any
@@ -712,6 +738,11 @@ class JaxEngine:
             # dispatch only — no np.asarray round-trip here
             k_dev = _gather_pages(self.kv_k, idx)
             v_dev = _gather_pages(self.kv_v, idx)
+            if self.ecfg.host_tier_int8:
+                from .kv_compress import quantize_pages
+
+                k_dev = quantize_pages(k_dev)  # (q, s) device pair
+                v_dev = quantize_pages(v_dev)
             self._offload_inflight.append((k_dev, v_dev, slots, len(off)))
             self.offload_pages_total += len(off)
         # harvest offload gathers whose D2H overlapped earlier steps. With
@@ -734,10 +765,22 @@ class JaxEngine:
             # host gather with slot 0 (content discarded)
             idx = _pad_pow2(pages, self.ecfg.num_pages)
             hsl = _pad_pow2(slots, 0)
-            self.kv_k = _inject_pages(self.kv_k, jnp.asarray(idx, jnp.int32),
-                                      jnp.asarray(self.host_k[:, hsl]))
-            self.kv_v = _inject_pages(self.kv_v, jnp.asarray(idx, jnp.int32),
-                                      jnp.asarray(self.host_v[:, hsl]))
+            iidx = jnp.asarray(idx, jnp.int32)
+            if self.ecfg.host_tier_int8:
+                # H2D moves int8 + scales; dequant runs on device
+                from .kv_compress import dequantize_pages
+
+                k_rows = dequantize_pages(
+                    jnp.asarray(self.host_k[:, hsl]),
+                    jnp.asarray(self.host_k_s[:, hsl]))
+                v_rows = dequantize_pages(
+                    jnp.asarray(self.host_v[:, hsl]),
+                    jnp.asarray(self.host_v_s[:, hsl]))
+            else:
+                k_rows = jnp.asarray(self.host_k[:, hsl])
+                v_rows = jnp.asarray(self.host_v[:, hsl])
+            self.kv_k = _inject_pages(self.kv_k, iidx, k_rows)
+            self.kv_v = _inject_pages(self.kv_v, iidx, v_rows)
             self.restore_pages_total += len(res)
 
     # ------------------------------------------------------------- prefill

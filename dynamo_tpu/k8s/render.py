@@ -76,11 +76,28 @@ def render(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
             f"spec.ingress is ambiguous with {len(frontends)} frontend "
             f"services ({', '.join(frontends)}): set ingress.service or "
             "move ingress under one service")
+    # dangling references render "successfully" with no route and
+    # nothing in status — validate them loudly instead
+    if (spec_ing and spec_ing.get("enabled", True)
+            and spec_ing.get("service")
+            and spec_ing["service"] not in frontends):
+        raise ValueError(
+            f"ingress.service {spec_ing['service']!r} is not a frontend "
+            f"service (frontends: {', '.join(frontends) or 'none'})")
+    for n, v in services.items():
+        if v.get("ingress") and not v.get("frontend"):
+            raise ValueError(
+                f"service {n!r} carries an ingress block but is not "
+                "frontend: true — the block would be silently ignored")
     # debug-split targets need a backing Service even when they are not
     # frontends (the canary Ingress / Istio debug route points at them)
     debug_targets = set()
     for ing in [spec_ing] + [v.get("ingress") for v in services.values()]:
         if ing and ing.get("enabled", True) and ing.get("debugService"):
+            if ing["debugService"] not in services:
+                raise ValueError(
+                    f"ingress.debugService {ing['debugService']!r} names "
+                    "no defined service")
             debug_targets.add(ing["debugService"])
 
     for svc_name, svc in services.items():
@@ -145,13 +162,14 @@ def render(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
                     ing = spec_ing
             if ing:
                 out.extend(_render_networking(name, ns, slug, svc, ing,
-                                              labels))
+                                              labels, services))
     return out
 
 
 def _render_networking(name: str, ns: str, slug: str,
                        svc: Dict[str, Any], ing: Dict[str, Any],
-                       labels: Dict[str, str]) -> List[Dict[str, Any]]:
+                       labels: Dict[str, str],
+                       services: Dict[str, Any]) -> List[Dict[str, Any]]:
     """Cluster networking for a frontend service — the reference
     operator's ingress plane (deploy/dynamo/operator pkg/dynamo/system/
     ingress.go: networking/v1 Ingress from a network config;
@@ -170,6 +188,10 @@ def _render_networking(name: str, ns: str, slug: str,
     if not ing or not ing.get("enabled", True):
         return []
     port = svc.get("port", 8080)
+    # the debug route targets the DEBUG service's own port (its backing
+    # Service exposes that, not the frontend's)
+    dbg = ing.get("debugService")
+    dbg_port = (services.get(dbg, {}).get("port", 8080) if dbg else None)
     backend_svc = f"{name}-{slug}"
     host = ing.get("host") or (
         f"{name}.{ing['hostSuffix']}" if ing.get("hostSuffix") else None)
@@ -206,15 +228,15 @@ def _render_networking(name: str, ns: str, slug: str,
                 "route": [{"destination": {
                     "host": (f"{name}-{ing['debugService'].lower()}"
                              f".{ns}.svc.cluster.local"),
-                    "port": {"number": port}}}],
+                    "port": {"number": dbg_port}}}],
             })
         return [vs]
 
-    def rule(svc_name: str) -> Dict[str, Any]:
+    def rule(svc_name: str, svc_port: int) -> Dict[str, Any]:
         r: Dict[str, Any] = {"http": {"paths": [{
             "path": path, "pathType": path_type,
             "backend": {"service": {"name": svc_name,
-                                    "port": {"number": port}}}}]}}
+                                    "port": {"number": svc_port}}}}]}}
         if host:
             r["host"] = host
         return r
@@ -224,7 +246,7 @@ def _render_networking(name: str, ns: str, slug: str,
         "metadata": {"name": backend_svc, "namespace": ns,
                      "labels": labels,
                      "annotations": dict(ing.get("annotations") or {})},
-        "spec": {"rules": [rule(backend_svc)]},
+        "spec": {"rules": [rule(backend_svc, port)]},
     }
     if ing.get("className"):
         ingress["spec"]["ingressClassName"] = ing["className"]
@@ -248,7 +270,7 @@ def _render_networking(name: str, ns: str, slug: str,
                         ing.get("debugHeaderValue", "1"),
                 }},
             "spec": {"rules": [rule(
-                f"{name}-{ing['debugService'].lower()}")]},
+                f"{name}-{ing['debugService'].lower()}", dbg_port)]},
         }
         if ing.get("className"):
             canary["spec"]["ingressClassName"] = ing["className"]

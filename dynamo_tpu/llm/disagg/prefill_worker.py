@@ -29,10 +29,18 @@ log = logging.getLogger("dynamo_tpu.llm.disagg")
 
 class PrefillWorker:
     def __init__(self, drt, engine, *, namespace: str = "dynamo",
-                 max_inflight: int = 4):
+                 max_inflight: int = 4,
+                 compress_kv: Optional[bool] = None):
+        import os
+
         self.drt = drt
         self.engine = engine
         self.namespace = namespace
+        # int8-compress shipped pages (~half the DCN bytes; lossy —
+        # engine/kv_compress.py). Opt-in: arg, else DYN_KV_TRANSFER_INT8
+        self.compress_kv = (compress_kv if compress_kv is not None
+                            else os.environ.get("DYN_KV_TRANSFER_INT8",
+                                                "") == "1")
         self.queue = PrefillQueue(drt.dcp, namespace)
         self.max_inflight = max_inflight
         self._clients: Dict[int, KvTransferClient] = {}
@@ -98,7 +106,8 @@ class PrefillWorker:
             k, v = await self.engine.extract_pages(local_send)
 
             client = await self._client(req.engine_id)
-            await client.send_kv(req.request_id, remote_dst, k, v, first)
+            await client.send_kv(req.request_id, remote_dst, k, v, first,
+                                 compress=self.compress_kv)
             self.completed += 1
         except Exception:  # noqa: BLE001 — a bad job must not kill the loop
             self.failed += 1

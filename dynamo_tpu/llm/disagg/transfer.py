@@ -146,8 +146,27 @@ class KvTransferServer:
             shape = tuple(h["shape"])  # [L, n, KV, ps, hd]
             dtype = _np_dtype(h["dtype"])
             k_len = h["k_len"]
-            k = np.frombuffer(msg.body[:k_len], dtype).reshape(shape)
-            v = np.frombuffer(msg.body[k_len:], dtype).reshape(shape)
+            if h.get("quant") == "int8":
+                # compressed frame (sender opted in — see
+                # engine/kv_compress.py): body = k_q‖v_q‖k_s‖v_s; the
+                # header dtype is the ORIGINAL pool dtype to restore to
+                from ...engine.kv_compress import dequantize_pages_np
+
+                sshape = shape[:-1] + (1,)
+                s_len = int(np.prod(sshape)) * 4
+                kq = np.frombuffer(msg.body[:k_len],
+                                   np.int8).reshape(shape)
+                vq = np.frombuffer(msg.body[k_len:2 * k_len],
+                                   np.int8).reshape(shape)
+                ks = np.frombuffer(msg.body[2 * k_len:2 * k_len + s_len],
+                                   np.float32).reshape(sshape)
+                vs = np.frombuffer(msg.body[2 * k_len + s_len:],
+                                   np.float32).reshape(sshape)
+                k = dequantize_pages_np(kq, ks, dtype)
+                v = dequantize_pages_np(vq, vs, dtype)
+            else:
+                k = np.frombuffer(msg.body[:k_len], dtype).reshape(shape)
+                v = np.frombuffer(msg.body[k_len:], dtype).reshape(shape)
             await self.engine.inject_pages(page_ids, k, v)
             self.bytes_ingested += len(msg.body)
             self.pages_ingested += len(page_ids)
@@ -184,9 +203,14 @@ class KvTransferClient:
 
     async def send_kv(self, request_id: str, page_ids, k: np.ndarray,
                       v: np.ndarray, first_token: int,
-                      timeout: float = 60.0) -> None:
+                      timeout: float = 60.0,
+                      compress: bool = False) -> None:
         """Ship pages [L, n, KV, ps, hd] + first token; returns once the
-        decode side has injected them (raises on remote failure)."""
+        decode side has injected them (raises on remote failure).
+        ``compress=True`` quantizes each (token, head) row to int8 +
+        f32 scale before framing — ~half the DCN bytes, lossy (see
+        engine/kv_compress.py); the header's dtype stays the ORIGINAL
+        so the receiver restores into its pool dtype."""
         k = np.ascontiguousarray(k)
         v = np.ascontiguousarray(v)
         header = {
@@ -197,11 +221,22 @@ class KvTransferClient:
             "k_len": k.nbytes,
             "first_token": int(first_token),
         }
+        if compress:
+            from ...engine.kv_compress import quantize_pages_np
+
+            kq, ks = quantize_pages_np(k)
+            vq, vs = quantize_pages_np(v)
+            header["quant"] = "int8"
+            header["k_len"] = kq.nbytes
+            body = (kq.tobytes() + vq.tobytes()
+                    + ks.tobytes() + vs.tobytes())
+        else:
+            body = k.tobytes() + v.tobytes()
         async with self._lock:  # frame-atomic per request
             try:
                 await self._ensure()
                 self._writer.write(codec.encode(TwoPartMessage(
-                    header=header, body=k.tobytes() + v.tobytes())))
+                    header=header, body=body)))
                 await self._writer.drain()
                 ack = await asyncio.wait_for(codec.decode(self._reader),
                                              timeout)

@@ -174,3 +174,49 @@ def test_spec_ingress_ambiguous_frontends_rejected():
     assert len(ings) == 1
     assert ings[0]["spec"]["rules"][0]["http"]["paths"][0]["backend"][
         "service"]["name"] == "demo-frontend2"
+
+
+def test_debug_route_uses_debug_services_port():
+    """The canary/Istio debug route must target the DEBUG service's own
+    port (its backing Service exposes that), not the frontend's."""
+    spec = _frontend_spec({"host": "demo.io", "debugService": "Debug"})
+    spec["spec"]["services"]["Debug"]["port"] = 9090
+    objs = render_mod.render(spec)
+    svc = [o for o in objs if o["kind"] == "Service"
+           and o["metadata"]["name"] == "demo-debug"][0]
+    assert svc["spec"]["ports"][0]["port"] == 9090
+    canary = [o for o in objs if o["kind"] == "Ingress"
+              and o["metadata"]["name"].endswith("-debug")][0]
+    assert canary["spec"]["rules"][0]["http"]["paths"][0]["backend"][
+        "service"]["port"]["number"] == 9090
+    # Istio variant too
+    spec["spec"]["ingress"]["istio"] = True
+    vs = [o for o in render_mod.render(spec)
+          if o["kind"] == "VirtualService"][0]
+    assert vs["spec"]["http"][0]["route"][0]["destination"]["port"][
+        "number"] == 9090
+    assert vs["spec"]["http"][1]["route"][0]["destination"]["port"][
+        "number"] == 8080
+
+
+def test_dangling_ingress_references_rejected():
+    import pytest
+
+    # ingress.service naming a non-frontend
+    spec = _frontend_spec({"host": "x.io", "service": "Debug"})
+    with pytest.raises(ValueError, match="not a frontend"):
+        render_mod.render(spec)
+    # ingress.service typo
+    spec = _frontend_spec({"host": "x.io", "service": "frontend"})
+    with pytest.raises(ValueError, match="not a frontend"):
+        render_mod.render(spec)
+    # ingress block on a non-frontend service
+    spec = _frontend_spec({"host": "x.io"})
+    del spec["spec"]["ingress"]
+    spec["spec"]["services"]["Debug"]["ingress"] = {"host": "d.io"}
+    with pytest.raises(ValueError, match="not.*frontend"):
+        render_mod.render(spec)
+    # debugService naming an undefined service
+    spec = _frontend_spec({"host": "x.io", "debugService": "Debgu"})
+    with pytest.raises(ValueError, match="no defined service"):
+        render_mod.render(spec)

@@ -56,6 +56,10 @@ run_step sweep 4200 --sweep \
 # 6. multiturn host-tier TTFT: no-tier baseline, then the tier
 run_step multiturn_base 1500 --scenario multiturn --host-pages 0
 run_step multiturn_tier 2400 --scenario multiturn --host-pages 4096
+# int8-compressed tier: halves the relay bytes per page move — the lever
+# aimed at the r1 "restores cost more than recompute" finding
+run_step multiturn_tier_int8 2400 --scenario multiturn --host-pages 4096 \
+    --host-tier-int8
 
 # 7. disagg A/B with the transfer breakdown
 run_step disagg 2400 --scenario disagg
