@@ -254,8 +254,18 @@ def build_engine(args):
     if args.host_tier_int8:
         ecfg.host_tier_int8 = True
     print(f"devices: {jax.devices()}", file=sys.stderr)
-    engine = JaxEngine(cfg, ecfg, seed=args.seed,
-                       quant="int8" if args.dtype == "int8" else None)
+    params = None
+    if args.model == "8b":
+        # 8B Gaussian host-init costs minutes of single-core time the
+        # chip session can't spare; throughput never reads the values —
+        # synthesize the int8 tree instantly (models/quant.py)
+        from dynamo_tpu.models import llama
+        from dynamo_tpu.models.quant import synthetic_int8_params
+
+        params = synthetic_int8_params(llama, cfg)
+    engine = JaxEngine(cfg, ecfg, seed=args.seed, params=params,
+                       quant="int8" if args.dtype == "int8" and
+                       params is None else None)
     return engine, cfg
 
 

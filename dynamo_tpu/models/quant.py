@@ -132,6 +132,38 @@ def quantize_params(params: Dict, keys=QUANT_KEYS) -> Dict:
     return out
 
 
+def synthetic_int8_params(model, cfg,
+                          device: Optional[jax.Device] = None) -> Dict:
+    """Shape-faithful int8 params with MEANINGLESS values, built in
+    milliseconds — for throughput benchmarking only.
+
+    ``host_init_quantized`` draws a full Gaussian tree on the host; at
+    8B on a single-core bench host that costs minutes of the chip
+    session's budget for values the throughput measurement never looks
+    at. Here: ``jax.eval_shape`` gives the exact tree without computing
+    it, quantized keys get UNINITIALIZED int8 (always finite) with
+    fan-in scales, norms get ones and everything else zeros (finite
+    activations throughout — XLA does no value-dependent shortcuts, so
+    the timing is identical to real weights)."""
+    shapes = jax.eval_shape(lambda key: model.init_params(cfg, key),
+                            jax.random.PRNGKey(0))
+    out = {}
+    for k, sd in shapes.items():
+        if k in QUANT_KEYS:
+            q = np.empty(sd.shape, np.int8)
+            s = np.full(sd.shape[:-2] + (1,) + sd.shape[-1:],
+                        1.0 / np.sqrt(sd.shape[-2]) / 127.0, np.float32)
+            out[k] = QuantInt8(q, s)
+        elif k.startswith(("ln_", "q_norm", "k_norm", "kv_norm")):
+            out[k] = np.ones(sd.shape, np.float32)
+        else:
+            out[k] = np.zeros(sd.shape,
+                              np.float32 if sd.dtype == jnp.float32
+                              else jnp.bfloat16)
+    dev = device or jax.devices()[0]
+    return jax.device_put(out, dev)
+
+
 def host_init_quantized(model, cfg, seed: int = 0,
                         device: Optional[jax.Device] = None) -> Dict:
     """Random-init on the host CPU backend, quantize there, then ship

@@ -223,3 +223,40 @@ def test_host_init_quantized_device_placement():
     dev = jax.devices()[0]
     assert list(p["w_up"].q.devices()) == [dev]
     assert list(p["embed"].devices()) == [dev]
+
+
+def test_synthetic_int8_params_serve(run_async):
+    """The instant benchmark-only init (bench --model 8b path): correct
+    tree structure, int8 quantized keys, finite outputs end-to-end
+    through the engine."""
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.quant import synthetic_int8_params
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = ModelConfig.tiny()
+    params = synthetic_int8_params(llama, cfg)
+    ref = set(llama.init_params(cfg, jax.random.PRNGKey(0)))
+    assert set(params) == ref
+    assert isinstance(params["wq"], QuantInt8)
+    assert params["wq"].q.dtype == jnp.int8
+
+    eng = JaxEngine(cfg, EngineConfig(num_pages=32, page_size=8,
+                                      max_batch=4), params=params)
+
+    async def go():
+        req = PreprocessedRequest(
+            token_ids=[1, 2, 3],
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=3, ignore_eos=True))
+        out = []
+        async for d in eng.generate(req, Context()):
+            out.extend(d.token_ids or [])
+        await eng.stop()
+        return out
+
+    toks = run_async(go())
+    assert len(toks) == 3 and all(0 <= t < cfg.vocab_size for t in toks)
