@@ -5,6 +5,7 @@ Commands mirror the reference's binaries (SURVEY §2.5):
   serve       SDK graph deployment (deploy/dynamo/sdk CLI)
   llmctl      model registration CLI (launch/llmctl)
   dcp-server  standalone control-plane server (etcd+NATS analog)
+  fetch-model seed a checkpoint to a directory (DynamoModelRequest Job)
 """
 
 from __future__ import annotations
@@ -33,6 +34,10 @@ def main() -> int:
         from .runtime.dcp_server import main as dcp_main
 
         return dcp_main(argv)
+    if cmd == "fetch-model":
+        from .models.hub import fetch_model_cli
+
+        return fetch_model_cli(argv)
     print(f"unknown command {cmd!r}\n{__doc__}")
     return 2
 

@@ -23,9 +23,13 @@ from typing import Any, Dict, List, Optional, Protocol
 # group/version/plural routing for the kinds the controller manages
 _ROUTES = {
     "DynamoDeployment": ("apis/dynamo-tpu.dev/v1alpha1", "dynamodeployments"),
+    "DynamoModelRequest": ("apis/dynamo-tpu.dev/v1alpha1",
+                           "dynamomodelrequests"),
     "Deployment": ("apis/apps/v1", "deployments"),
     "Service": ("api/v1", "services"),
     "ConfigMap": ("api/v1", "configmaps"),
+    "Job": ("apis/batch/v1", "jobs"),
+    "PersistentVolumeClaim": ("api/v1", "persistentvolumeclaims"),
     "Ingress": ("apis/networking.k8s.io/v1", "ingresses"),
     # optional Istio plane (reference operator's VirtualService path,
     # dynamonimdeployment_controller.go:1133) — only touched when a CR
@@ -133,7 +137,13 @@ class InClusterClient:
         return self._req("PUT", self._path(kind, namespace, name), obj)
 
     def delete(self, kind, namespace, name):
-        self._req("DELETE", self._path(kind, namespace, name))
+        # explicit Background propagation: batch/v1 Jobs default to
+        # ORPHAN over the raw REST API (unlike kubectl) — a bare DELETE
+        # would leave the old seed pod running and writing to the PVC
+        # beside its replacement
+        self._req("DELETE", self._path(kind, namespace, name),
+                  body={"kind": "DeleteOptions", "apiVersion": "v1",
+                        "propagationPolicy": "Background"})
 
     def update_status(self, kind, namespace, name, status):
         cur = self.get(kind, namespace, name)

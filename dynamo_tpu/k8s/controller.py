@@ -24,15 +24,21 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .client import KubeClient
-from .render import render
+from .render import render, render_model_request
 
 log = logging.getLogger("dynamo_tpu.k8s")
 
 MANAGED_BY = "dynamo-tpu-operator"
+OWNER_KIND_LABEL = "dynamo-tpu.dev/owner-kind"
 # kinds the controller owns; VirtualService only exists on Istio clusters
 MANAGED_KINDS = ("Deployment", "Service", "ConfigMap", "Ingress",
-                 "VirtualService")
+                 "VirtualService", "Job", "PersistentVolumeClaim")
 OPTIONAL_KINDS = frozenset({"VirtualService"})
+# PVC spec is immutable (and holds model data): create once, never
+# replace on drift; Jobs' pod templates are immutable too — a changed
+# render is applied by DELETE + recreate, not PUT
+CREATE_ONLY = frozenset({"PersistentVolumeClaim"})
+RECREATE_ON_DRIFT = frozenset({"Job"})
 SPEC_HASH_ANN = "dynamo-tpu.dev/spec-hash"
 
 
@@ -108,25 +114,37 @@ class Reconciler:
     def reconcile_all(self, namespace: str) -> None:
         # list each managed kind ONCE per pass and partition by instance
         # label — per-CR listing would cost 3N+1 apiserver calls per tick
-        observed_by_cr: Dict[str, Dict[Tuple[str, str], Dict[str, Any]]] = {}
+        # partition by (owning CR kind, instance): a DynamoDeployment and
+        # a DynamoModelRequest sharing one name (the natural pairing) must
+        # never see — and orphan-delete — each other's children
+        observed_by_cr: Dict[Tuple[str, str],
+                             Dict[Tuple[str, str], Dict[str, Any]]] = {}
         for kind in MANAGED_KINDS:
             sel = f"app.kubernetes.io/managed-by={MANAGED_BY}"
             for obj in self._list_tolerant(kind, namespace, sel):
-                inst = (obj.get("metadata", {}).get("labels", {})
-                        .get("app.kubernetes.io/instance"))
+                labels = obj.get("metadata", {}).get("labels", {})
+                inst = labels.get("app.kubernetes.io/instance")
+                # children stamped before the owner-kind label existed
+                # default to DynamoDeployment (the only CR kind then)
+                okind = labels.get(OWNER_KIND_LABEL, "DynamoDeployment")
                 if inst is not None:
-                    observed_by_cr.setdefault(inst, {})[_key(obj)] = obj
-        for cr in self.client.list("DynamoDeployment", namespace):
-            name = cr.get("metadata", {}).get("name")
-            try:
-                self.reconcile(cr, observed=observed_by_cr.get(name))
-            except Exception:  # noqa: BLE001 — one bad CR must not wedge
-                log.exception("reconcile failed for %s", name)
+                    observed_by_cr.setdefault(
+                        (okind, inst), {})[_key(obj)] = obj
+        for cr_kind in ("DynamoDeployment", "DynamoModelRequest"):
+            for cr in self.client.list(cr_kind, namespace):
+                cr.setdefault("kind", cr_kind)
+                name = cr.get("metadata", {}).get("name")
+                try:
+                    self.reconcile(
+                        cr, observed=observed_by_cr.get((cr_kind, name)))
+                except Exception:  # noqa: BLE001 — one bad CR must not
+                    log.exception("reconcile failed for %s", name)  # wedge
 
-    def _observe(self, ns: str, name: str
+    def _observe(self, ns: str, name: str, cr_kind: str
                  ) -> Dict[Tuple[str, str], Dict[str, Any]]:
         selector = (f"app.kubernetes.io/managed-by={MANAGED_BY},"
-                    f"app.kubernetes.io/instance={name}")
+                    f"app.kubernetes.io/instance={name},"
+                    f"{OWNER_KIND_LABEL}={cr_kind}")
         observed: Dict[Tuple[str, str], Dict[str, Any]] = {}
         for kind in MANAGED_KINDS:
             for obj in self._list_tolerant(kind, ns, selector):
@@ -163,27 +181,31 @@ class Reconciler:
         """Converge one DynamoDeployment toward its rendered manifests."""
         meta = cr["metadata"]
         name, ns = meta["name"], meta.get("namespace", "default")
+        cr_kind = cr.get("kind", "DynamoDeployment")
+        renderer = (render_model_request
+                    if cr_kind == "DynamoModelRequest" else render)
         owner_ref = {
             "apiVersion": cr.get("apiVersion", "dynamo-tpu.dev/v1alpha1"),
-            "kind": cr.get("kind", "DynamoDeployment"),
+            "kind": cr_kind,
             "name": name,
             "uid": meta.get("uid", ""),
             "controller": True,
             "blockOwnerDeletion": True,
         }
         desired: Dict[Tuple[str, str], Dict[str, Any]] = {}
-        for obj in render(cr):
+        for obj in renderer(cr):
             obj = copy.deepcopy(obj)
             m = obj.setdefault("metadata", {})
             m.setdefault("labels", {})[
                 "app.kubernetes.io/managed-by"] = MANAGED_BY
             m["labels"]["app.kubernetes.io/instance"] = name
+            m["labels"][OWNER_KIND_LABEL] = cr_kind
             m["ownerReferences"] = [owner_ref]
             m.setdefault("annotations", {})[SPEC_HASH_ANN] = _spec_hash(obj)
             desired[_key(obj)] = obj
 
         if observed is None:
-            observed = self._observe(ns, name)
+            observed = self._observe(ns, name, cr_kind)
         else:
             observed = dict(observed)
 
@@ -202,6 +224,17 @@ class Reconciler:
             field_drift = any(
                 _owned_fields_drifted(want.get(sect), have.get(sect))
                 for sect in ("spec", "data"))
+            if (hash_drift or field_drift) and kind in CREATE_ONLY:
+                # immutable spec (PVC): the object exists, leave it be —
+                # resize/class changes need operator intervention anyway
+                log.debug("skip drift on create-only %s/%s", kind, oname)
+                continue
+            if (hash_drift or field_drift) and kind in RECREATE_ON_DRIFT:
+                # immutable pod template (Job): apply by delete+create
+                log.info("recreate %s/%s", kind, oname)
+                self.client.delete(kind, ns, oname)
+                observed[key] = self.client.create(kind, ns, want) or want
+                continue
             if hash_drift or field_drift:
                 # replace with the rendered truth, keeping resourceVersion
                 # so the API server's optimistic concurrency applies
@@ -226,7 +259,37 @@ class Reconciler:
                 log.info("delete orphan %s/%s", *key)
                 self.client.delete(key[0], ns, key[1])
 
-        self._update_status(cr, ns, name, desired, observed)
+        if cr_kind == "DynamoModelRequest":
+            self._update_model_request_status(cr, ns, name, observed)
+        else:
+            self._update_status(cr, ns, name, desired, observed)
+
+    def _update_model_request_status(self, cr, ns, name,
+                                     observed) -> None:
+        """Seeding/Ready/Failed from the seeding Job's CONDITIONS — the
+        reference's ModelsSeeding / ModelsExists conditions
+        (dynamoinimrequest_types.go:28-33), collapsed to a phase.
+        Conditions, not the failed/succeeded counters: under
+        restartPolicy OnFailure retries are in-pod container restarts
+        that never increment status.failed, so a crash-looping seed
+        would read as "Seeding" forever from counters alone."""
+        job = observed.get(("Job", f"{name}-seed")) or {}
+        st = job.get("status") or {}
+        conds = {c.get("type"): c.get("status")
+                 for c in st.get("conditions") or []}
+        if conds.get("Complete") == "True" or st.get("succeeded", 0) >= 1:
+            phase = "Ready"
+        elif conds.get("Failed") == "True":
+            phase = "Failed"
+        else:
+            phase = "Seeding"
+        # same claim resolution as the renderer — an existingClaim CR
+        # renders no PVC but its claim is still the one seeded into
+        spec = cr.get("spec") or {}
+        claim = spec.get("existingClaim") or f"{name}-models"
+        self.client.update_status(
+            "DynamoModelRequest", ns, name,
+            {"phase": phase, "claim": claim})
 
     def _update_status(self, cr, ns, name, desired, observed) -> None:
         """phase + readyServices from the Deployment readiness already in

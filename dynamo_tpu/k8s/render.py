@@ -278,6 +278,77 @@ def _render_networking(name: str, ns: str, slug: str,
     return out
 
 
+def render_model_request(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """DynamoModelRequest → PVC + model-seeding Job.
+
+    The reference's third CRD, DynamoNimRequest, stages the serving
+    ARTIFACT before a deployment can run: it seeds models and bakes a
+    per-model container image via builder Jobs
+    (operator api/v1alpha1/dynamoinimrequest_types.go conditions
+    ModelsSeeding/ImageBuilding; internal/controller/
+    dynamonimrequest_controller.go:476-532 generateImageBuilderJob).
+    On TPU the serving image is generic — the artifact that must be
+    staged is the CHECKPOINT — so the TPU-native plane is: a
+    PersistentVolumeClaim for the model store plus a batch Job running
+    ``python -m dynamo_tpu fetch-model`` into it. DynamoDeployment
+    services then mount the claim at /models.
+    """
+    meta = spec.get("metadata", {})
+    name = meta.get("name", "model")
+    ns = meta.get("namespace", "default")
+    s = spec["spec"]
+    model_id = s["modelId"]
+    image = s.get("image", "dynamo-tpu:latest")
+    claim = s.get("existingClaim") or f"{name}-models"
+    dest = s.get("destPath", f"/models/{name}")
+    labels = {"app.kubernetes.io/part-of": name}
+    out: List[Dict[str, Any]] = []
+
+    if not s.get("existingClaim"):
+        out.append({
+            "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": {"name": claim, "namespace": ns, "labels": labels},
+            "spec": {
+                "accessModes": [s.get("accessMode", "ReadWriteOnce")],
+                "resources": {"requests": {
+                    "storage": s.get("storage", "50Gi")}},
+                **({"storageClassName": s["storageClassName"]}
+                   if s.get("storageClassName") else {}),
+            }})
+
+    cmd = ["python", "-m", "dynamo_tpu", "fetch-model",
+           "--model-id", model_id, "--dest", dest]
+    if s.get("revision"):
+        cmd += ["--revision", s["revision"]]
+    container: Dict[str, Any] = {
+        "name": "seed", "image": image, "command": cmd,
+        "volumeMounts": [{"name": "models", "mountPath": "/models"}],
+    }
+    if s.get("hfTokenSecret"):
+        # only set env when non-empty: the apiserver drops an empty env
+        # list on read-back (omitempty), which the drift diff would read
+        # as a change and hot-loop Job recreation
+        container["env"] = [{"name": "HF_TOKEN", "valueFrom": {
+            "secretKeyRef": {"name": s["hfTokenSecret"],
+                             "key": s.get("hfTokenKey", "token")}}}]
+    out.append({
+        "apiVersion": "batch/v1", "kind": "Job",
+        "metadata": {"name": f"{name}-seed", "namespace": ns,
+                     "labels": labels},
+        "spec": {
+            "backoffLimit": s.get("backoffLimit", 4),
+            "template": {
+                "metadata": {"labels": {"app": f"{name}-seed", **labels}},
+                "spec": {
+                    "restartPolicy": "OnFailure",
+                    "containers": [container],
+                    "volumes": [{"name": "models",
+                                 "persistentVolumeClaim":
+                                     {"claimName": claim}}],
+                }}}})
+    return out
+
+
 def main() -> int:
     if len(sys.argv) != 2:
         print("usage: render.py <dynamodeployment.yaml>", file=sys.stderr)

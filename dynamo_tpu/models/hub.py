@@ -52,3 +52,54 @@ def resolve_model(model_id: str, revision: str | None = None) -> str:
             f"not in the HF cache, and download failed ({exc}). Pass "
             f"--model-path, or pre-populate the HuggingFace cache on "
             f"zero-egress hosts.") from exc
+
+
+def fetch_model_cli(argv) -> int:
+    """``python -m dynamo_tpu fetch-model --model-id M --dest DIR``.
+
+    The model-seeding Job body the K8s DynamoModelRequest plane runs
+    (k8s/render.py render_model_request — the TPU-native analog of the
+    reference's DynamoNimRequest image/model seeding,
+    operator internal/controller/dynamonimrequest_controller.go):
+    resolve the checkpoint (cache → network), then materialize it at a
+    stable destination (the mounted PVC). Idempotent: a complete
+    destination (config.json present and no partial marker) returns
+    immediately, so Job retries and re-runs are free."""
+    import argparse
+    import json
+    import shutil
+
+    ap = argparse.ArgumentParser(prog="dynamo_tpu fetch-model")
+    ap.add_argument("--model-id", required=True,
+                    help="HF hub id, local dir, or anything resolve_model "
+                         "accepts")
+    ap.add_argument("--revision", default=None)
+    ap.add_argument("--dest", required=True,
+                    help="destination directory (PVC mount)")
+    args = ap.parse_args(argv)
+
+    marker = os.path.join(args.dest, ".seeding")
+    stamp = os.path.join(args.dest, ".seeded.json")
+    want = {"model_id": args.model_id, "revision": args.revision}
+    # done = stamped with the SAME model+revision and no partial marker:
+    # a changed spec.modelId recreates the Job, and that Job must
+    # actually replace the checkpoint, not short-circuit on the old one
+    try:
+        with open(stamp) as f:
+            done = json.load(f) == want and not os.path.exists(marker)
+    except (FileNotFoundError, json.JSONDecodeError):
+        done = False
+    if done:
+        log.info("model already seeded at %s", args.dest)
+        print(args.dest)
+        return 0
+    src = resolve_model(args.model_id, revision=args.revision)
+    os.makedirs(args.dest, exist_ok=True)
+    open(marker, "w").close()
+    shutil.copytree(src, args.dest, dirs_exist_ok=True)
+    with open(stamp, "w") as f:
+        json.dump(want, f)
+    os.unlink(marker)
+    log.info("seeded %s -> %s", args.model_id, args.dest)
+    print(args.dest)
+    return 0

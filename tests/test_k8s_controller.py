@@ -406,4 +406,153 @@ def test_istio_route_non_404_error_raises():
     kube.create("DynamoDeployment", "serving", example_cr())
     import pytest
     with pytest.raises(RuntimeError, match="403"):
-        Reconciler(kube)._observe("serving", "llama-disagg")
+        Reconciler(kube)._observe("serving", "llama-disagg",
+                                  "DynamoDeployment")
+
+
+def model_request_cr(**spec_over):
+    spec = {"modelId": "org/model-8b", "storage": "40Gi"}
+    spec.update(spec_over)
+    return {
+        "apiVersion": "dynamo-tpu.dev/v1alpha1",
+        "kind": "DynamoModelRequest",
+        "metadata": {"name": "llama8b", "namespace": "serving",
+                     "uid": "uid-mr"},
+        "spec": spec,
+    }
+
+
+def test_model_request_converges_pvc_and_job():
+    """DynamoModelRequest → PVC + seeding Job with ownerRefs; status
+    tracks the Job (Seeding → Ready) — the reference's DynamoNimRequest
+    ModelsSeeding/ModelsExists conditions, TPU-shaped (checkpoint onto a
+    claim instead of a model-baked image)."""
+    kube = FakeKube()
+    kube.create("DynamoModelRequest", "serving", model_request_cr())
+    rec = Reconciler(kube)
+    rec.reconcile_all("serving")
+
+    pvc = kube.get("PersistentVolumeClaim", "serving", "llama8b-models")
+    assert pvc is not None
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "40Gi"
+    assert pvc["metadata"]["ownerReferences"][0]["kind"] == \
+        "DynamoModelRequest"
+    job = kube.get("Job", "serving", "llama8b-seed")
+    assert job is not None
+    cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[:4] == ["python", "-m", "dynamo_tpu", "fetch-model"]
+    assert "org/model-8b" in cmd
+    cr = kube.get("DynamoModelRequest", "serving", "llama8b")
+    assert cr["status"]["phase"] == "Seeding"
+    assert cr["status"]["claim"] == "llama8b-models"
+
+    # job completes → Ready
+    job["status"] = {"succeeded": 1}
+    kube.store[("Job", "serving", "llama8b-seed")] = job
+    rec.reconcile_all("serving")
+    assert kube.get("DynamoModelRequest", "serving",
+                    "llama8b")["status"]["phase"] == "Ready"
+
+
+def test_model_request_pvc_create_only_job_recreates():
+    """PVC spec is immutable: drift is left alone. Job template is
+    immutable: a changed render (new modelId) applies by delete +
+    recreate."""
+    kube = FakeKube()
+    kube.create("DynamoModelRequest", "serving", model_request_cr())
+    rec = Reconciler(kube)
+    rec.reconcile_all("serving")
+
+    # hand-shrink the PVC (drift) — reconcile must NOT touch it
+    pvc = kube.get("PersistentVolumeClaim", "serving", "llama8b-models")
+    pvc["spec"]["resources"]["requests"]["storage"] = "1Gi"
+    kube.store[("PersistentVolumeClaim", "serving",
+                "llama8b-models")] = pvc
+    rec.reconcile_all("serving")
+    assert kube.get("PersistentVolumeClaim", "serving", "llama8b-models")[
+        "spec"]["resources"]["requests"]["storage"] == "1Gi"
+
+    # change the model → the Job is deleted and recreated, not replaced
+    cr = kube.get("DynamoModelRequest", "serving", "llama8b")
+    cr["spec"]["modelId"] = "org/other-model"
+    kube.store[("DynamoModelRequest", "serving", "llama8b")] = cr
+    rec.reconcile_all("serving")
+    assert ("Job", "serving", "llama8b-seed") in kube.deleted
+    job = kube.get("Job", "serving", "llama8b-seed")
+    assert "org/other-model" in \
+        job["spec"]["template"]["spec"]["containers"][0]["command"]
+
+
+def test_model_request_existing_claim_and_token():
+    from dynamo_tpu.k8s.render import render_model_request
+
+    objs = render_model_request(model_request_cr(
+        existingClaim="shared-models", hfTokenSecret="hf-tok"))
+    kinds = [o["kind"] for o in objs]
+    assert "PersistentVolumeClaim" not in kinds  # reuse, don't create
+    job = [o for o in objs if o["kind"] == "Job"][0]
+    vol = job["spec"]["template"]["spec"]["volumes"][0]
+    assert vol["persistentVolumeClaim"]["claimName"] == "shared-models"
+    env = job["spec"]["template"]["spec"]["containers"][0]["env"]
+    assert env[0]["valueFrom"]["secretKeyRef"]["name"] == "hf-tok"
+
+
+def test_same_name_deployment_and_model_request_coexist():
+    """A DynamoDeployment and a DynamoModelRequest named identically (the
+    natural pairing) must never orphan-delete each other's children —
+    observed state partitions by owning CR KIND, not just instance."""
+    kube = FakeKube()
+    kube.create("DynamoDeployment", "serving",
+                {**example_cr(),
+                 "metadata": {"name": "llama8b", "namespace": "serving",
+                              "uid": "u1"}})
+    kube.create("DynamoModelRequest", "serving", model_request_cr())
+    rec = Reconciler(kube)
+    rec.reconcile_all("serving")
+    rec.reconcile_all("serving")  # second pass: would orphan-delete
+
+    assert kube.get("PersistentVolumeClaim", "serving", "llama8b-models")
+    assert kube.get("Job", "serving", "llama8b-seed")
+    assert kube.get("Deployment", "serving", "llama8b-dcp")
+    assert ("PersistentVolumeClaim", "serving",
+            "llama8b-models") not in kube.deleted
+    assert ("Deployment", "serving", "llama8b-dcp") not in kube.deleted
+
+
+def test_model_request_failed_via_job_condition():
+    """Under restartPolicy OnFailure the failed counter never increments
+    — phase must come from the Job's Failed CONDITION."""
+    kube = FakeKube()
+    kube.create("DynamoModelRequest", "serving", model_request_cr())
+    rec = Reconciler(kube)
+    rec.reconcile_all("serving")
+    job = kube.get("Job", "serving", "llama8b-seed")
+    job["status"] = {"failed": 0, "conditions": [
+        {"type": "Failed", "status": "True",
+         "reason": "BackoffLimitExceeded"}]}
+    kube.store[("Job", "serving", "llama8b-seed")] = job
+    rec.reconcile_all("serving")
+    assert kube.get("DynamoModelRequest", "serving",
+                    "llama8b")["status"]["phase"] == "Failed"
+
+
+def test_model_request_existing_claim_status():
+    kube = FakeKube()
+    kube.create("DynamoModelRequest", "serving",
+                model_request_cr(existingClaim="shared-models"))
+    Reconciler(kube).reconcile_all("serving")
+    cr = kube.get("DynamoModelRequest", "serving", "llama8b")
+    assert cr["status"]["claim"] == "shared-models"
+    assert kube.get("PersistentVolumeClaim", "serving",
+                    "llama8b-models") is None
+
+
+def test_seed_job_without_token_has_no_env_key():
+    """env: [] would be dropped by a real apiserver on read-back and
+    re-read as drift → permanent Job recreate hot loop; the renderer
+    must omit the key entirely."""
+    from dynamo_tpu.k8s.render import render_model_request
+
+    job = [o for o in render_model_request(model_request_cr())
+           if o["kind"] == "Job"][0]
+    assert "env" not in job["spec"]["template"]["spec"]["containers"][0]

@@ -150,3 +150,46 @@ def test_loader_mixtral_roundtrip(tmp_path):
         "num_local_experts": 2, "num_experts_per_tok": 1})
     loaded = load_params(str(tmp_path), dtype=np.float32)
     _assert_tree_close(loaded, p)
+
+
+def test_fetch_model_cli_idempotent(tmp_path, capsys):
+    """The DynamoModelRequest seeding Job body: local-dir source copies
+    to dest; a complete dest short-circuits (Job retries are free)."""
+    import json as _json
+    import os
+
+    from dynamo_tpu.models.hub import fetch_model_cli
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "config.json").write_text(_json.dumps({"model_type": "llama"}))
+    (src / "model.safetensors").write_bytes(b"\0" * 16)
+    dest = tmp_path / "pvc" / "models" / "m"
+
+    rc = fetch_model_cli(["--model-id", str(src), "--dest", str(dest)])
+    assert rc == 0
+    assert (dest / "config.json").exists()
+    assert (dest / "model.safetensors").exists()
+    assert not (dest / ".seeding").exists()
+
+    # second run: must not re-copy (mutate dest, confirm untouched)
+    (dest / "model.safetensors").write_bytes(b"\1" * 4)
+    rc = fetch_model_cli(["--model-id", str(src), "--dest", str(dest)])
+    assert rc == 0
+    assert (dest / "model.safetensors").read_bytes() == b"\1" * 4
+
+    # a stale .seeding marker (crashed job) forces a re-copy
+    (dest / ".seeding").touch()
+    rc = fetch_model_cli(["--model-id", str(src), "--dest", str(dest)])
+    assert rc == 0
+    assert (dest / "model.safetensors").read_bytes() == b"\0" * 16
+
+    # a CHANGED model id must replace the checkpoint, not short-circuit
+    # on the stamp (the recreated seed Job's whole purpose)
+    src2 = src.parent / "src2"
+    src2.mkdir()
+    (src2 / "config.json").write_text(_json.dumps({"model_type": "qwen3"}))
+    (src2 / "model.safetensors").write_bytes(b"\2" * 8)
+    rc = fetch_model_cli(["--model-id", str(src2), "--dest", str(dest)])
+    assert rc == 0
+    assert (dest / "model.safetensors").read_bytes() == b"\2" * 8
