@@ -1,11 +1,28 @@
 """REST admin/control API over the distributed runtime.
 
 Reference deploy/dynamo/api-server (Go, ~11k LoC: REST services for
-clusters/deployments/components backed by a DB + K8s): here the control
-plane's KV store IS the database, so the API server is a thin aiohttp app
-exposing what operators need — registered models, live endpoint instances,
-service records, model cards, and stored deployment specs (consumed by
-the deploy/kubernetes renderer or a future in-cluster controller).
+clusters/deployments/components backed by a DB + K8s, with per-user/org
+auth): here the control plane's KV store IS the database, so the API
+server is a thin aiohttp app exposing what operators need — registered
+models, live endpoint instances, service records, model cards, and
+stored deployment specs (consumed by the deploy/kubernetes renderer or
+the in-cluster controller).
+
+Multi-tenancy: bearer-token auth with role + namespace scoping (the
+api-server's organizations/users plane, collapsed to what a serving
+control plane actually gates). Tokens come from ``--tokens-file`` /
+``DYN_ADMIN_TOKENS`` as a JSON list of ``{"token", "label", "role":
+"admin"|"writer"|"reader", "namespace"?}``:
+
+- ``admin``    — everything;
+- ``writer``   — read everything; mutate only resources whose namespace
+  matches its claim (deployments carry ``metadata.namespace``; models
+  are global, so namespace-restricted writers cannot mutate them);
+- ``reader``   — GET only.
+
+No tokens configured → the API is open (single-operator deployments,
+and the in-cluster default where the pod network is the boundary).
+Every mutation is audit-logged with the token LABEL, never the token.
 
     python -m dynamo_tpu.admin.api_server --port 8800 --dcp 127.0.0.1:6650
 """
@@ -14,7 +31,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Optional
+from typing import Dict, List, Optional
 
 from aiohttp import web
 
@@ -29,10 +46,49 @@ from .store import DEPLOYMENT_PREFIX
 log = logging.getLogger("dynamo_tpu.admin")
 
 
+class Principal:
+    """Resolved identity of a request: role + optional namespace claim."""
+
+    __slots__ = ("label", "role", "namespace")
+
+    def __init__(self, label: str, role: str,
+                 namespace: Optional[str] = None):
+        self.label, self.role, self.namespace = label, role, namespace
+
+    def can_mutate(self, namespace: Optional[str]) -> bool:
+        """namespace=None marks a GLOBAL resource (models): those need
+        an unrestricted writer or admin."""
+        if self.role == "admin":
+            return True
+        if self.role != "writer":
+            return False
+        if self.namespace is None:
+            return True
+        return namespace == self.namespace
+
+
+_OPEN = Principal("anonymous", "admin")  # no tokens configured
+
+
 class AdminApiServer:
-    def __init__(self, drt: DistributedRuntime):
+    def __init__(self, drt: DistributedRuntime,
+                 tokens: Optional[List[Dict]] = None):
         self.drt = drt
-        self.app = web.Application()
+        # None = auth not configured (open); [] = auth CONFIGURED with
+        # zero valid tokens (a templated file whose values were unset) —
+        # that must fail closed, not silently grant anonymous admin
+        self._auth_enabled = tokens is not None
+        self._tokens: Dict[str, Principal] = {}
+        for t in tokens or []:
+            if not t.get("token"):
+                raise ValueError(f"token entry {t.get('label')!r}: "
+                                 "missing 'token'")
+            if t.get("role") not in ("admin", "writer", "reader"):
+                raise ValueError(f"token {t.get('label')!r}: role must be "
+                                 "admin|writer|reader")
+            self._tokens[t["token"]] = Principal(
+                t.get("label", "unnamed"), t["role"], t.get("namespace"))
+        self.app = web.Application(middlewares=[self._auth_middleware])
         r = self.app.router
         r.add_get("/healthz", self._health)
         r.add_get("/api/v1/models", self._models_list)
@@ -58,6 +114,41 @@ class AdminApiServer:
         if self._runner:
             await self._runner.cleanup()
 
+    # ---------------------------------------------------------------- auth
+
+    @web.middleware
+    async def _auth_middleware(self, req, handler):
+        if not self._auth_enabled or req.path == "/healthz":
+            req["principal"] = _OPEN
+            return await handler(req)
+        auth = req.headers.get("Authorization", "")
+        # RFC 7235: the auth-scheme is case-insensitive
+        token = (auth[7:] if auth[:7].lower() == "bearer " else "")
+        p = self._tokens.get(token)
+        if p is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        if req.method not in ("GET", "HEAD") and p.role == "reader":
+            return web.json_response(
+                {"error": "forbidden: read-only token"}, status=403)
+        req["principal"] = p
+        return await handler(req)
+
+    @staticmethod
+    def _audit(req, action: str, target: str) -> None:
+        log.info("audit: %s %s by %s(%s)", action, target,
+                 req["principal"].label, req["principal"].role)
+
+    @staticmethod
+    def _forbid(req, namespace: Optional[str]):
+        """None result = allowed; a response = the 403 to return."""
+        p = req["principal"]
+        if p.can_mutate(namespace):
+            return None
+        scope = namespace if namespace is not None else "(global)"
+        return web.json_response(
+            {"error": f"forbidden: token {p.label!r} cannot mutate "
+                      f"namespace {scope}"}, status=403)
+
     # ------------------------------------------------------------ handlers
 
     async def _health(self, _req):
@@ -70,15 +161,24 @@ class AdminApiServer:
             {"models": [unpack(i.value) for i in items]})
 
     async def _models_add(self, req):
+        denied = self._forbid(req, None)  # models are global
+        if denied:
+            return denied
         body = await req.json()
         entry = ModelEntry(name=body["name"], endpoint=body["endpoint"],
                            model_type=body.get("model_type", "chat"))
         await register_model(self.drt.dcp, entry)
+        self._audit(req, "models.add", entry.name)
         return web.json_response({"added": entry.to_dict()})
 
     async def _models_delete(self, req):
+        denied = self._forbid(req, None)
+        if denied:
+            return denied
         ok = await remove_model(self.drt.dcp, req.match_info["name"],
                                 req.match_info["mtype"])
+        if ok:  # audit records what HAPPENED, not what was attempted
+            self._audit(req, "models.delete", req.match_info["name"])
         return web.json_response({"removed": ok},
                                  status=200 if ok else 404)
 
@@ -119,7 +219,24 @@ class AdminApiServer:
         if not name:
             return web.json_response({"error": "metadata.name required"},
                                      status=400)
+        ns = (spec.get("metadata") or {}).get("namespace", "default")
+        denied = self._forbid(req, ns)
+        if denied:
+            return denied
+        p = req["principal"]
+        if p.role == "writer" and p.namespace is not None:
+            # a scoped writer must also not OVERWRITE a spec that lives
+            # in another namespace under the same name (the extra KV
+            # read is skipped for admin/open, where it cannot fail)
+            cur = await self.drt.dcp.kv_get(f"{DEPLOYMENT_PREFIX}{name}")
+            if cur is not None:
+                cur_ns = ((unpack(cur).get("metadata") or {})
+                          .get("namespace", "default"))
+                denied = self._forbid(req, cur_ns)
+                if denied:
+                    return denied
         await self.drt.dcp.kv_put(f"{DEPLOYMENT_PREFIX}{name}", pack(spec))
+        self._audit(req, "deployments.put", f"{ns}/{name}")
         return web.json_response({"stored": name})
 
     async def _deployments_get(self, req):
@@ -130,8 +247,17 @@ class AdminApiServer:
         return web.json_response(unpack(raw))
 
     async def _deployments_delete(self, req):
-        ok = await self.drt.dcp.kv_delete(
-            f"{DEPLOYMENT_PREFIX}{req.match_info['name']}")
+        name = req.match_info["name"]
+        cur = await self.drt.dcp.kv_get(f"{DEPLOYMENT_PREFIX}{name}")
+        if cur is None:
+            return web.json_response({"removed": False}, status=404)
+        ns = ((unpack(cur).get("metadata") or {})
+              .get("namespace", "default"))
+        denied = self._forbid(req, ns)
+        if denied:
+            return denied
+        ok = await self.drt.dcp.kv_delete(f"{DEPLOYMENT_PREFIX}{name}")
+        self._audit(req, "deployments.delete", f"{ns}/{name}")
         return web.json_response({"removed": ok},
                                  status=200 if ok else 404)
 
@@ -144,12 +270,25 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8800)
     ap.add_argument("--dcp", default=None)
+    ap.add_argument("--tokens-file", default=None,
+                    help="JSON list of {token,label,role,namespace?}; "
+                         "also DYN_ADMIN_TOKENS (inline JSON). Absent = "
+                         "open API")
     args = ap.parse_args(argv)
+
+    import json as _json
+
+    tokens = None
+    if args.tokens_file:
+        with open(args.tokens_file) as f:
+            tokens = _json.load(f)
+    elif os.environ.get("DYN_ADMIN_TOKENS"):
+        tokens = _json.loads(os.environ["DYN_ADMIN_TOKENS"])
 
     async def amain():
         drt = await DistributedRuntime.attach(
             args.dcp or os.environ.get("DYN_DCP_ADDRESS"))
-        srv = AdminApiServer(drt)
+        srv = AdminApiServer(drt, tokens=tokens)
         await srv.start(args.host, args.port)
         try:
             await asyncio.Event().wait()

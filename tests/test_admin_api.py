@@ -80,3 +80,143 @@ def test_admin_api_crud(run_async):
     assert out["dep"]["spec"]["graph"].startswith("examples.")
     assert out["dep_gone"] == 404
     assert out["advisories"]["advisories"][0]["component"] == "decode"
+
+
+def test_admin_api_auth_scoping(run_async):
+    """Bearer-token multi-tenancy (reference api-server's users/orgs
+    plane): 401 without a token, reader is GET-only, a namespace-scoped
+    writer mutates only its namespace (and cannot overwrite another
+    namespace's spec under the same name), admin does everything."""
+    port = _free_port()
+
+    async def scenario():
+        import aiohttp
+
+        drt = await DistributedRuntime.detached()
+        srv = AdminApiServer(drt, tokens=[
+            {"token": "adm", "label": "root", "role": "admin"},
+            {"token": "rd", "label": "viewer", "role": "reader"},
+            {"token": "wr-a", "label": "team-a", "role": "writer",
+             "namespace": "team-a"},
+        ])
+        await srv.start("127.0.0.1", port)
+        base = f"http://127.0.0.1:{port}"
+
+        def hdr(tok=None):
+            return {"Authorization": f"Bearer {tok}"} if tok else {}
+
+        dep = {"metadata": {"name": "d1", "namespace": "team-a"},
+               "spec": {"graph": "g"}}
+        dep_b = {"metadata": {"name": "d2", "namespace": "team-b"},
+                 "spec": {"graph": "g"}}
+        out = {}
+        async with aiohttp.ClientSession() as s:
+            # healthz stays open; everything else 401s without a token
+            async with s.get(f"{base}/healthz") as r:
+                out["health"] = r.status
+            async with s.get(f"{base}/api/v1/models") as r:
+                out["no_token"] = r.status
+            async with s.get(f"{base}/api/v1/models",
+                             headers=hdr("bogus")) as r:
+                out["bad_token"] = r.status
+            # reader: GET ok, POST 403
+            async with s.get(f"{base}/api/v1/deployments",
+                             headers=hdr("rd")) as r:
+                out["reader_get"] = r.status
+            async with s.post(f"{base}/api/v1/deployments", json=dep,
+                              headers=hdr("rd")) as r:
+                out["reader_post"] = r.status
+            # scoped writer: own namespace ok, other namespace 403,
+            # global models 403
+            async with s.post(f"{base}/api/v1/deployments", json=dep,
+                              headers=hdr("wr-a")) as r:
+                out["writer_own"] = r.status
+            async with s.post(f"{base}/api/v1/deployments", json=dep_b,
+                              headers=hdr("wr-a")) as r:
+                out["writer_other"] = r.status
+            async with s.post(f"{base}/api/v1/models",
+                              json={"name": "m", "endpoint": "e"},
+                              headers=hdr("wr-a")) as r:
+                out["writer_models"] = r.status
+            # admin stores a team-b spec named d1? No — d1 belongs to
+            # team-a; admin CAN overwrite, but team-a's writer must not
+            # be able to hijack a team-b spec via rename
+            async with s.post(f"{base}/api/v1/deployments", json=dep_b,
+                              headers=hdr("adm")) as r:
+                out["admin_post"] = r.status
+            hijack = {"metadata": {"name": "d2", "namespace": "team-a"},
+                      "spec": {"graph": "evil"}}
+            async with s.post(f"{base}/api/v1/deployments", json=hijack,
+                              headers=hdr("wr-a")) as r:
+                out["writer_hijack"] = r.status
+            async with s.delete(f"{base}/api/v1/deployments/d2",
+                                headers=hdr("wr-a")) as r:
+                out["writer_del_other"] = r.status
+            async with s.delete(f"{base}/api/v1/deployments/d1",
+                                headers=hdr("wr-a")) as r:
+                out["writer_del_own"] = r.status
+        await srv.stop()
+        await drt.shutdown()
+        return out
+
+    out = run_async(scenario())
+    assert out["health"] == 200
+    assert out["no_token"] == 401 and out["bad_token"] == 401
+    assert out["reader_get"] == 200 and out["reader_post"] == 403
+    assert out["writer_own"] == 200
+    assert out["writer_other"] == 403
+    assert out["writer_models"] == 403
+    assert out["admin_post"] == 200
+    assert out["writer_hijack"] == 403  # d2 lives in team-b
+    assert out["writer_del_other"] == 403
+    assert out["writer_del_own"] == 200
+
+
+def test_admin_api_rejects_bad_role():
+    import pytest
+
+    with pytest.raises(ValueError, match="role"):
+        AdminApiServer(None, tokens=[{"token": "x", "role": "root"}])
+
+
+def test_admin_api_empty_token_list_fails_closed(run_async):
+    """tokens=[] means auth CONFIGURED with no valid credentials (a
+    templated file whose values were unset) — must 401 everything, not
+    silently fail open; and a lowercase 'bearer' scheme is accepted
+    (RFC 7235 case-insensitive)."""
+    port = _free_port()
+
+    async def scenario():
+        import aiohttp
+
+        drt = await DistributedRuntime.detached()
+        closed = AdminApiServer(drt, tokens=[])
+        await closed.start("127.0.0.1", port)
+        base = f"http://127.0.0.1:{port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/api/v1/models") as r:
+                st_closed = r.status
+        await closed.stop()
+
+        port2 = _free_port()
+        srv = AdminApiServer(drt, tokens=[
+            {"token": "t", "label": "x", "role": "reader"}])
+        await srv.start("127.0.0.1", port2)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port2}/api/v1/models",
+                             headers={"Authorization": "bearer t"}) as r:
+                st_lower = r.status
+        await srv.stop()
+        await drt.shutdown()
+        return st_closed, st_lower
+
+    st_closed, st_lower = run_async(scenario())
+    assert st_closed == 401
+    assert st_lower == 200
+
+
+def test_admin_api_rejects_missing_token_field():
+    import pytest
+
+    with pytest.raises(ValueError, match="missing 'token'"):
+        AdminApiServer(None, tokens=[{"label": "ci", "role": "writer"}])
