@@ -288,22 +288,27 @@ class JaxEngine:
         self.host_k = self.host_v = None
         self.host_k_s = self.host_v_s = None
         if self.ecfg.host_pages > 0:
-            hshape = (model_cfg.num_layers, self.ecfg.host_pages,
-                      model_cfg.num_kv_heads, self.ecfg.page_size,
-                      model_cfg.head_dim_)
+            # derive page geometry from the ACTUAL device pools: the two
+            # pools differ per family (MLA: latent [.., 1, ps, r] vs rope
+            # [.., 1, ps, dr]) — rebuilding from GQA config fields here
+            # would allocate wrong-shaped host pools for MLA and crash
+            # the first offload landing
+            hk = (model_cfg.num_layers, self.ecfg.host_pages,
+                  *self.kv_k.shape[2:])
+            hv = (model_cfg.num_layers, self.ecfg.host_pages,
+                  *self.kv_v.shape[2:])
             if self.ecfg.host_tier_int8:
                 # compressed tier: int8 rows + f32 per-row scales — the
                 # D2H/H2D link moves ~half the bytes and the same host
                 # RAM holds ~2x the pages (engine/kv_compress.py)
-                self.host_k = np.zeros(hshape, np.int8)
-                self.host_v = np.zeros(hshape, np.int8)
-                sshape = hshape[:-1] + (1,)
-                self.host_k_s = np.zeros(sshape, np.float32)
-                self.host_v_s = np.zeros(sshape, np.float32)
+                self.host_k = np.zeros(hk, np.int8)
+                self.host_v = np.zeros(hv, np.int8)
+                self.host_k_s = np.zeros(hk[:-1] + (1,), np.float32)
+                self.host_v_s = np.zeros(hv[:-1] + (1,), np.float32)
             else:
                 hdtype = np.asarray(jnp.zeros((), self.kv_k.dtype)).dtype
-                self.host_k = np.zeros(hshape, hdtype)
-                self.host_v = np.zeros(hshape, hdtype)
+                self.host_k = np.zeros(hk, hdtype)
+                self.host_v = np.zeros(hv, hdtype)
         self.offload_pages_total = 0
         self.restore_pages_total = 0
         # guards PageManager between the event-loop thread (_admit) and

@@ -279,3 +279,59 @@ def test_alloc_accounting_with_reusable_prefix_hits():
     c = pm.allocate_sequence(prompt)
     assert c is not None
     assert c.cached_tokens == 6
+
+
+def test_mla_engine_host_tier_end_to_end(run_async):
+    """MLA (latent+rope pools with DIFFERENT last dims) through the host
+    tier: host pool shapes must derive from the device pools — deriving
+    them from GQA config fields allocated wrong shapes and crashed the
+    first offload landing (round-5 latent bug). Restore must be
+    token-identical (tier is lossless here)."""
+    import jax
+
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = ModelConfig.tiny(model_type="deepseek_v2", kv_lora_rank=16,
+                           qk_nope_head_dim=16, qk_rope_head_dim=8,
+                           v_head_dim=16, q_lora_rank=24)
+    ecfg = EngineConfig(page_size=4, num_pages=24, max_batch=4,
+                        prefill_chunk=32, prefill_buckets=(32,),
+                        batch_buckets=(4,), page_buckets=(16,),
+                        host_pages=64, watermark_pages=2)
+    engine = JaxEngine(cfg, ecfg, seed=0)
+    assert engine.host_k.shape[2:] == engine.kv_k.shape[2:]
+    assert engine.host_v.shape[2:] == engine.kv_v.shape[2:]
+    assert engine.host_k.shape[-1] != engine.host_v.shape[-1]  # MLA!
+
+    async def gen(prompt, n=6):
+        req = PreprocessedRequest(
+            token_ids=prompt, sampling=SamplingOptions(),
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+            eos_token_ids=[])
+        toks = []
+        async for out in engine.generate(req, Context()):
+            toks.extend(out.token_ids)
+            if out.finish_reason:
+                break
+        return toks
+
+    async def scenario():
+        rng = np.random.RandomState(3)
+        prompt_a = rng.randint(1, 500, 24).tolist()
+        first = await gen(prompt_a)
+        for i in range(4):
+            await gen(rng.randint(1, 500, 24).tolist())
+        again = await gen(prompt_a)
+        await engine.stop()
+        return first, again
+
+    first, again = run_async(scenario())
+    assert len(first) == 6
+    assert first == again
+    assert engine.offload_pages_total > 0
+    assert engine.restore_pages_total > 0
