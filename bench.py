@@ -49,8 +49,10 @@ def metric_name(args) -> str:
         return (f"TTFT p50 (later turns), multiturn {args.users}u x "
                 f"{args.turns}t, host_pages={tier}")
     if args.scenario == "disagg":
+        x8 = (", kv-int8" if os.environ.get("DYN_KV_TRANSFER_INT8") == "1"
+              else "")
         return (f"disagg/agg req/s ratio (1-chip time-shared, threshold "
-                f"{args.disagg_threshold})")
+                f"{args.disagg_threshold}{x8})")
     return ("output tokens/s, synthetic ShareGPT "
             f"(ISL~{args.isl}/OSL {args.osl}, {args.requests} reqs, "
             f"conc {args.concurrency}, {_model_tag(args)} llama, 1 chip)")

@@ -247,3 +247,12 @@ def test_measure_request_timeout_is_error_row(monkeypatch):
 
     rep = asyncio.run(bench.measure(HangingEngine(), [([7], 3)], 1))
     assert rep["errors"] == 1 and rep["requests"] == 0
+
+
+def test_disagg_label_reflects_transfer_int8(monkeypatch):
+    args = make_args(scenario="disagg")
+    base = bench.metric_name(args)
+    monkeypatch.setenv("DYN_KV_TRANSFER_INT8", "1")
+    assert "kv-int8" in bench.metric_name(args)
+    monkeypatch.delenv("DYN_KV_TRANSFER_INT8")
+    assert bench.metric_name(args) == base

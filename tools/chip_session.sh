@@ -64,5 +64,9 @@ run_step multiturn_tier_int8 2400 --scenario multiturn --host-pages 4096 \
 # 7. disagg A/B with the transfer breakdown
 run_step disagg 2400 --scenario disagg
 
+# 8. disagg with int8-compressed KV transfer: halves transfer_mb /
+#    ingest time in the breakdown fields (lossy, opt-in)
+DYN_KV_TRANSFER_INT8=1 run_step disagg_int8 2400 --scenario disagg
+
 echo "=== chip session complete; results in $OUT/ ==="
 grep -h . "$OUT"/*.json 2>/dev/null | head -20
