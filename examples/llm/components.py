@@ -9,6 +9,7 @@ echo-engine trick but with the real JAX engine."""
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
@@ -28,6 +29,11 @@ def _build_engine(cfg: dict):
     from dynamo_tpu.models.config import ModelConfig
 
     model = cfg.get("model", "tiny")
+    # dtype: int8 → weight-only quantized serving (models/quant.py);
+    # checkpoints quantize on the host at load, random-init engines via
+    # the engine's quant path — same contract as `run.py --dtype int8`
+    quant = "int8" if cfg.get("dtype") == "int8" else None
+    params = None
     if model == "tiny":
         mc = ModelConfig.tiny()
         ecfg = EngineConfig(page_size=cfg.get("kv_block_size", 8),
@@ -39,6 +45,8 @@ def _build_engine(cfg: dict):
         mdc = ModelDeploymentCard(name=cfg.get("served_model_name", "tiny"),
                                   kv_block_size=ecfg.page_size)
     else:
+        from dynamo_tpu.models.loader import load_params
+
         mc = ModelConfig.from_local_path(model)
         ecfg = EngineConfig(page_size=cfg.get("kv_block_size", 64),
                             num_pages=cfg.get("num_pages", 2048),
@@ -47,7 +55,15 @@ def _build_engine(cfg: dict):
         mdc = ModelDeploymentCard.from_local_path(
             model, name=cfg.get("served_model_name"))
         mdc.kv_block_size = ecfg.page_size
-    engine = JaxEngine(mc, ecfg, seed=cfg.get("seed", 0))
+        try:
+            params = load_params(model, mc, quant=quant)
+            quant = None  # applied on the host at load
+        except FileNotFoundError:
+            pass  # config-only dir (tests): random init below
+    if cfg.get("host_tier_int8"):
+        ecfg = dataclasses.replace(ecfg, host_tier_int8=True)
+    engine = JaxEngine(mc, ecfg, seed=cfg.get("seed", 0), params=params,
+                       quant=quant)
     if cfg.get("warmup", False):
         engine.warmup()
     return engine, mdc

@@ -107,3 +107,52 @@ def test_graph_shapes():
         order = [s.name for s in mod.Frontend.graph()]
         assert order.index("TpuWorker") < max(
             i for i, n in enumerate(order) if "Processor" in n)
+
+
+def test_int8_worker_graph_end_to_end(run_async):
+    """The quantized flagship path (configs/disagg_router_int8.yaml's
+    dtype: int8 worker key) serves through the routed graph: the
+    worker's engine holds QuantInt8 weights and completions stream."""
+    import importlib
+
+    import examples.llm.components as comp
+
+    importlib.reload(comp)
+    mod = importlib.import_module("examples.llm.graphs.agg_router")
+    importlib.reload(mod)
+
+    port = _free_port()
+    cfg = ServiceConfig({
+        "RoutedFrontend": {"served_model_name": "tiny", "port": port,
+                           "host": "127.0.0.1"},
+        "RoutedProcessor": {"served_model_name": "tiny", "kv_block_size": 8},
+        "Router": {"kv_block_size": 8, "scrape_interval": 0.2},
+        "TpuWorker": {"model": "tiny", "served_model_name": "tiny",
+                      "dtype": "int8", "kv_block_size": 8,
+                      "num_pages": 128},
+    })
+
+    async def scenario():
+        import aiohttp
+
+        dep = await deploy_inline(mod.Frontend, config=cfg)
+        try:
+            worker = next(w for w in dep.workers
+                          if w.svc.name == "TpuWorker")
+            from dynamo_tpu.models.quant import QuantInt8
+            assert isinstance(worker.instance.engine.params["wq"],
+                              QuantInt8)
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/completions",
+                        json={"model": "tiny", "prompt": "abc",
+                              "max_tokens": 4}) as r:
+                    return r.status, await r.json()
+        finally:
+            await dep.stop()
+            await dep.drt.shutdown()
+
+    status, body = run_async(scenario())
+    assert status == 200
+    assert body["object"] == "text_completion"
+    assert body["choices"][0]["text"]
