@@ -46,7 +46,8 @@ from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
 from ..runtime.engine import Context
 from .kv_manager import PageManager, chain_hashes
-from .sampling import SamplingBatch, sample_tokens
+from .sampling import (SamplingBatch, sample_tokens,
+                       update_penalty_state)
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -70,6 +71,11 @@ class EngineConfig:
     # `prefilling` while their restores drain across iterations; 0 =
     # unlimited (the old single-shot behavior)
     tier_restore_chunk: int = 32
+    # pre-compile the penalized decode-window variants too (doubles the
+    # decode programs in warmup). Off by default: most deployments never
+    # send sampling penalties, and a first penalty request merely pays
+    # one compile per bucket
+    warmup_penalties: bool = False
     # int8-compress the host tier (engine/kv_compress.py): pages are
     # quantized ON DEVICE before D2H and dequantized ON DEVICE after
     # H2D, so the slow host link moves ~half the bytes and the host
@@ -394,15 +400,29 @@ class JaxEngine:
                       if decode else set()):
                 tableB = jnp.zeros((B, P), jnp.int32)
                 if ecfg.decode_steps > 1:
-                    toks, _carry, self.kv_k, self.kv_v = self.decode_multi_fn(
-                        self.params, jnp.zeros(B, jnp.int32),
-                        jnp.zeros(B, jnp.int32) - 1,
-                        jnp.zeros(B, bool), jnp.zeros(B, jnp.int32),
-                        jnp.ones(B, jnp.int32), self.kv_k, self.kv_v,
-                        tableB, jnp.zeros(B), jnp.zeros(B, jnp.int32),
-                        jnp.ones(B), jnp.zeros(B, jnp.uint32),
-                        jnp.full((B, ecfg.max_eos_ids), -1, jnp.int32),
-                        k_steps=ecfg.decode_steps)
+                    # warm the penalty-free variant always; the penalized
+                    # window programs too when warmup_penalties (default:
+                    # a first penalty request pays one compile per bucket
+                    # mid-serving — documented tradeoff, most deployments
+                    # never send penalties and should not double warmup)
+                    pen_variants = [None]
+                    if ecfg.warmup_penalties:
+                        V = self.cfg.vocab_size
+                        pen_variants.append((
+                            jnp.zeros((B, V), jnp.int32),
+                            jnp.zeros((B, V), jnp.int8),
+                            jnp.ones(B), jnp.zeros(B), jnp.zeros(B)))
+                    for pv in pen_variants:
+                        (toks, _carry, self.kv_k,
+                         self.kv_v) = self.decode_multi_fn(
+                            self.params, jnp.zeros(B, jnp.int32),
+                            jnp.zeros(B, jnp.int32) - 1,
+                            jnp.zeros(B, bool), jnp.zeros(B, jnp.int32),
+                            jnp.ones(B, jnp.int32), self.kv_k, self.kv_v,
+                            tableB, jnp.zeros(B), jnp.zeros(B, jnp.int32),
+                            jnp.ones(B), jnp.zeros(B, jnp.uint32),
+                            jnp.full((B, ecfg.max_eos_ids), -1, jnp.int32),
+                            pv, k_steps=ecfg.decode_steps)
                 else:
                     logits, self.kv_k, self.kv_v = self.decode_fn(
                         self.params, jnp.zeros(B, jnp.int32),
@@ -1107,6 +1127,20 @@ class JaxEngine:
             return None
 
         prev = self._pending  # None if _grow_or_preempt flushed
+        # sampling penalties need ACCURATE host token lists (counts are
+        # rebuilt from seq.tokens each dispatch): land the in-flight
+        # window first, trading the pipelining overlap away only for
+        # batches that actually use penalties
+        if prev is not None and any(_wants_penalties(s.req.sampling)
+                                    for s in batch):
+            self._process_window(prev)
+            prev = None
+            # the readback may have finished rows (EOS/length) and freed
+            # their pages — dispatching them would scatter into page 0
+            batch = [s for s in batch
+                     if s.finished is None and not s.context.stopped]
+            if not batch:
+                return None
         B = self.ecfg.bucket_batch(len(batch))
         P = self.ecfg.bucket_pages(max(len(s.pages) for s in batch))
         E = self.ecfg.max_eos_ids
@@ -1145,11 +1179,12 @@ class JaxEngine:
             done = jnp.zeros(B, bool)
             steps, rem = jnp.asarray(nsteps), jnp.asarray(nrem)
         sb = SamplingBatch.build([s.req.sampling for s in batch], B)
+        pen = self._penalty_args(batch, sb, B)
         toks, carry, self.kv_k, self.kv_v = self.decode_multi_fn(
             self.params, tok, pos, done, steps, rem, self.kv_k, self.kv_v,
             jnp.asarray(table), jnp.asarray(sb.temperature),
             jnp.asarray(sb.top_k), jnp.asarray(sb.top_p),
-            jnp.asarray(sb.seeds), jnp.asarray(eos), k_steps=K)
+            jnp.asarray(sb.seeds), jnp.asarray(eos), pen, k_steps=K)
         self.steps += 1
         pend = _PendingWindow(batch=list(batch), toks=toks, carry=carry,
                               index={id(s): i for i, s in enumerate(batch)})
@@ -1206,6 +1241,33 @@ class JaxEngine:
 
     # ------------------------------------------------------------- helpers
 
+    def _penalty_state(self, seqs: List[Sequence], pad_to: int):
+        """(counts [B,V] int32 of GENERATED tokens, presence [B,V] int8
+        over the full context) rebuilt from the host token lists — the
+        stateless-per-dispatch form (slots migrate between sequences, so
+        device-resident histograms would need per-dispatch resharding
+        anyway). Only ever built for batches that use penalties."""
+        V = self.cfg.vocab_size
+        counts = np.zeros((pad_to, V), np.int32)
+        presence = np.zeros((pad_to, V), np.int8)
+        for i, s in enumerate(seqs):
+            gen = np.asarray(s.tokens[s.num_prompt:], np.int64)
+            if gen.size:
+                counts[i] = np.bincount(gen, minlength=V)[:V]
+            ctx = np.asarray(s.tokens, np.int64)
+            presence[i, ctx[ctx < V]] = 1
+        return (jnp.asarray(counts), jnp.asarray(presence))
+
+    def _penalty_args(self, seqs: List[Sequence], sb: SamplingBatch,
+                      pad_to: int):
+        """The (counts, presence, rep, freq, pres) tuple the samplers
+        take, or None for penalty-free batches (the only warmed path)."""
+        if not sb.has_penalties:
+            return None
+        return self._penalty_state(seqs, pad_to) + (
+            jnp.asarray(sb.rep), jnp.asarray(sb.freq),
+            jnp.asarray(sb.pres))
+
     def _sample_device(self, seqs: List[Sequence], logits) -> jax.Array:
         """On-device token draw, no readback. logits: [B_padded, V]
         (bucketed); pads sampling params to match so every distinct batch
@@ -1214,10 +1276,11 @@ class JaxEngine:
         sb = SamplingBatch.build([s.req.sampling for s in seqs], pad_to)
         steps = np.zeros(pad_to, np.int32)
         steps[:len(seqs)] = [s.generated for s in seqs]
+        pen = self._penalty_args(seqs, sb, pad_to)
         return sample_tokens(logits, jnp.asarray(sb.temperature),
                              jnp.asarray(sb.top_k), jnp.asarray(sb.top_p),
                              jnp.asarray(sb.seeds), jnp.asarray(steps),
-                             max_top_k=self.ecfg.max_top_k)
+                             max_top_k=self.ecfg.max_top_k, penalties=pen)
 
     def _sample(self, seqs: List[Sequence], logits) -> np.ndarray:
         toks = self._sample_device(seqs, logits)
@@ -1498,7 +1561,7 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
              donate_argnames=("kv_k", "kv_v"))
     def decode_multi(params, tokens, positions, done, steps, remaining,
                      kv_k, kv_v, page_table, temperature, top_k, top_p,
-                     seeds, eos_table, *, k_steps: int):
+                     seeds, eos_table, penalties=None, *, k_steps: int):
         B = tokens.shape[0]
         ps = kv_k.shape[3]
         P = page_table.shape[1]
@@ -1519,7 +1582,9 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
                 page_table, slot[:, None], mesh=mesh)
             logits = logits_at(params, cfg, h, jnp.zeros(B, jnp.int32))
             nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
-                                steps, max_top_k=max_top_k)
+                                steps, max_top_k=max_top_k,
+                                penalties=penalties)
+            penalties = update_penalty_state(penalties, nxt, done)
             tok, pos, done, steps, remaining = carry_step_update(
                 nxt, tok, pos, done, steps, remaining, eos_table)
             toks.append(tok)
@@ -1527,6 +1592,12 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
                 kv_k, kv_v)
 
     return decode_multi
+
+
+def _wants_penalties(s) -> bool:
+    return bool((getattr(s, "repetition_penalty", None) or 1.0) != 1.0
+                or getattr(s, "frequency_penalty", None)
+                or getattr(s, "presence_penalty", None))
 
 
 @jax.jit

@@ -27,36 +27,101 @@ class SamplingBatch:
     top_k: np.ndarray        # [B] int32; 0 → disabled
     top_p: np.ndarray        # [B] float32; 1.0 → disabled
     seeds: np.ndarray        # [B] uint32 per-row RNG streams
+    # OpenAI/HF penalties; neutral values disable each
+    rep: np.ndarray          # [B] float32; 1.0 → disabled (HF semantics)
+    freq: np.ndarray         # [B] float32; 0.0 → disabled
+    pres: np.ndarray         # [B] float32; 0.0 → disabled
 
     @classmethod
     def build(cls, rows, pad_to: int) -> "SamplingBatch":
         """rows: list of SamplingOptions-like objects with .temperature,
-        .top_k, .top_p, .seed."""
+        .top_k, .top_p, .seed (+ the penalty fields)."""
         B = pad_to
         temperature = np.zeros(B, np.float32)
         top_k = np.zeros(B, np.int32)
         top_p = np.ones(B, np.float32)
         seeds = np.zeros(B, np.uint32)
+        rep = np.ones(B, np.float32)
+        freq = np.zeros(B, np.float32)
+        pres = np.zeros(B, np.float32)
         for i, s in enumerate(rows):
             temperature[i] = s.temperature if s.temperature is not None else 0.0
             top_k[i] = s.top_k or 0
             top_p[i] = s.top_p if s.top_p is not None else 1.0
             seeds[i] = (s.seed if s.seed is not None
                         else np.random.randint(0, 2**31)) & 0xFFFFFFFF
-        return cls(temperature, top_k, top_p, seeds)
+            rep[i] = (s.repetition_penalty
+                      if getattr(s, "repetition_penalty", None) else 1.0)
+            freq[i] = getattr(s, "frequency_penalty", None) or 0.0
+            pres[i] = getattr(s, "presence_penalty", None) or 0.0
+        return cls(temperature, top_k, top_p, seeds, rep, freq, pres)
+
+    @property
+    def has_penalties(self) -> bool:
+        return bool((self.rep != 1.0).any() or (self.freq != 0.0).any()
+                    or (self.pres != 0.0).any())
+
+
+def apply_penalties(logits: jax.Array, counts: jax.Array,
+                    presence: jax.Array, rep: jax.Array,
+                    freq: jax.Array, pres: jax.Array) -> jax.Array:
+    """Sampling penalties on raw logits (before temperature), vLLM
+    order and semantics:
+
+    - repetition (HF `RepetitionPenaltyLogitsProcessor`): tokens present
+      ANYWHERE in the context (prompt + generated) get positive logits
+      divided / negative logits multiplied by the penalty;
+    - frequency/presence (OpenAI): subtract ``freq·count`` and
+      ``pres·(count>0)`` where ``count`` is over GENERATED tokens only.
+
+    counts: [B, V] generated-token counts; presence: [B, V] context
+    presence (bool-ish); penalties are per-row [B].
+    """
+    present = presence > 0
+    rp = rep[:, None]
+    logits = jnp.where(
+        present & (rp != 1.0),
+        jnp.where(logits > 0, logits / rp, logits * rp), logits)
+    cf = counts.astype(jnp.float32)
+    return logits - freq[:, None] * cf - pres[:, None] * (cf > 0)
+
+
+def update_penalty_state(penalties, sampled: jax.Array, done: jax.Array):
+    """Fold a window step's sampled tokens into the penalty state — ONE
+    implementation shared by both fused decode windows (llama
+    decode_window and the engine's generic fallback), so the live-mask
+    timing vs carry_step_update can never drift between them. ``done``
+    is the PRE-step mask: tokens sampled while a row was live are the
+    ones the host will append. Returns the updated tuple (or None
+    through the penalty-free path)."""
+    if penalties is None:
+        return None
+    counts, presence, rep, freq, pres = penalties
+    rows = jnp.arange(counts.shape[0])
+    live = jnp.logical_not(done).astype(counts.dtype)
+    counts = counts.at[rows, sampled].add(live)
+    presence = presence.at[rows, sampled].max(live.astype(presence.dtype))
+    return (counts, presence, rep, freq, pres)
 
 
 @partial(jax.jit, static_argnames=("max_top_k",))
 def sample_tokens(logits: jax.Array, temperature: jax.Array,
                   top_k: jax.Array, top_p: jax.Array, seeds: jax.Array,
-                  step: jax.Array, max_top_k: int = 64) -> jax.Array:
+                  step: jax.Array, max_top_k: int = 64,
+                  penalties=None) -> jax.Array:
     """Sample one token per row. logits: [B, V] float32; ``step`` is a
     scalar or per-row [B] decode-step counter (advances the RNG stream).
 
     Greedy rows (temperature==0) take argmax. Sampled rows apply
-    temperature → top-k (static bound ``max_top_k``, per-row effective k) →
-    top-p (nucleus) → categorical draw from a per-row fold_in'd key.
+    [penalties →] temperature → top-k (static bound ``max_top_k``,
+    per-row effective k) → top-p (nucleus) → categorical draw from a
+    per-row fold_in'd key. ``penalties``, when given, is the tuple
+    ``(counts [B,V], presence [B,V], rep [B], freq [B], pres [B])``
+    consumed by :func:`apply_penalties`; None (the default and the only
+    pre-compiled variant) keeps the penalty-free program.
     """
+    if penalties is not None:
+        logits = apply_penalties(logits, *penalties)
     step = jnp.broadcast_to(step, temperature.shape)
     B, V = logits.shape
 

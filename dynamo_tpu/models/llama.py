@@ -749,7 +749,7 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
     move is keeping the sampling feedback loop on device.
 
     Signature matches engine._make_decode_multi's generic fallback."""
-    from ..engine.sampling import sample_tokens
+    from ..engine.sampling import sample_tokens, update_penalty_state
 
     inv_freq = rope_freqs(cfg)
     scale = cfg.attn_scale
@@ -779,7 +779,7 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
              donate_argnames=("kv_k", "kv_v"))
     def decode_window(params, tokens, positions, done, steps, remaining,
                       kv_k, kv_v, page_table, temperature, top_k, top_p,
-                      seeds, eos_table, *, k_steps: int):
+                      seeds, eos_table, penalties=None, *, k_steps: int):
         B = tokens.shape[0]
         L = cfg.num_layers
         ps = kv_k.shape[3]
@@ -859,7 +859,9 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
             # below), so correctness needs no per-row control flow
             logits, wk, wv = one_step(tok, pos, wk, wv, i)
             nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
-                                steps, max_top_k=max_top_k)
+                                steps, max_top_k=max_top_k,
+                                penalties=penalties)
+            penalties = update_penalty_state(penalties, nxt, done)
             tok, pos, done, steps, remaining = carry_step_update(
                 nxt, tok, pos, done, steps, remaining, eos_table)
             toks.append(tok)

@@ -1,0 +1,146 @@
+"""Sampling penalties (repetition / frequency / presence): semantics of
+apply_penalties vs the HF logits processor and OpenAI definitions, and
+the serving path end-to-end (penalties must bite inside the fused decode
+window, across windows, and on the prefill first token). The reference
+serves these via vLLM SamplingParams; SamplingOptions carried the fields
+since round 1 but silently ignored them until round 5."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.sampling import SamplingBatch, apply_penalties
+from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                             SamplingOptions,
+                                             StopConditions)
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.engine import Context
+
+
+def test_repetition_penalty_matches_transformers():
+    """HF RepetitionPenaltyLogitsProcessor is the oracle: tokens present
+    in the context get positive logits divided / negative multiplied."""
+    import torch
+    from transformers import RepetitionPenaltyLogitsProcessor
+
+    rng = np.random.RandomState(0)
+    V = 40
+    logits = rng.randn(1, V).astype(np.float32) * 3
+    ctx = np.array([[3, 7, 7, 12]])
+    proc = RepetitionPenaltyLogitsProcessor(penalty=1.7)
+    want = proc(torch.tensor(ctx), torch.tensor(logits)).numpy()
+
+    presence = np.zeros((1, V), np.int8)
+    presence[0, ctx[0]] = 1
+    got = apply_penalties(jnp.asarray(logits), jnp.zeros((1, V), jnp.int32),
+                          jnp.asarray(presence),
+                          jnp.asarray([1.7], jnp.float32),
+                          jnp.zeros(1), jnp.zeros(1))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+def test_frequency_presence_penalties_openai_semantics():
+    """OpenAI: logits[t] -= freq*count[t] + pres*(count[t]>0), counts
+    over GENERATED tokens only."""
+    V = 10
+    logits = jnp.zeros((1, V), jnp.float32)
+    counts = jnp.asarray(np.array([[0, 1, 3, 0, 0, 0, 0, 0, 0, 0]],
+                                  np.int32))
+    out = apply_penalties(logits, counts, jnp.zeros((1, V), jnp.int8),
+                          jnp.ones(1), jnp.asarray([0.5]),
+                          jnp.asarray([0.25]))
+    out = np.asarray(out)[0]
+    assert out[0] == 0.0
+    np.testing.assert_allclose(out[1], -0.5 * 1 - 0.25)
+    np.testing.assert_allclose(out[2], -0.5 * 3 - 0.25)
+
+
+def test_sampling_batch_detects_penalties():
+    none = SamplingBatch.build([SamplingOptions()], 1)
+    assert not none.has_penalties
+    assert SamplingBatch.build(
+        [SamplingOptions(repetition_penalty=1.3)], 1).has_penalties
+    assert SamplingBatch.build(
+        [SamplingOptions(frequency_penalty=0.5)], 2).has_penalties
+    assert SamplingBatch.build(
+        [SamplingOptions(presence_penalty=0.1)], 1).has_penalties
+
+
+def _run_engine(req_opts, prompt, n, run_async, **ecfg_over):
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+
+    cfg = ModelConfig.tiny()
+    base = dict(page_size=8, num_pages=64, max_batch=4, prefill_chunk=32,
+                prefill_buckets=(32,), batch_buckets=(4,),
+                page_buckets=(16,), decode_steps=4)
+    base.update(ecfg_over)
+    eng = JaxEngine(cfg, EngineConfig(**base), seed=0)
+    if base.get("warmup_penalties"):
+        eng.warmup()  # must pre-compile the penalized window variants
+
+    async def go():
+        req = PreprocessedRequest(
+            token_ids=list(prompt), sampling=req_opts,
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+            eos_token_ids=[])
+        toks = []
+        async for out in eng.generate(req, Context()):
+            toks.extend(out.token_ids)
+            if out.finish_reason:
+                break
+        await eng.stop()
+        return toks
+
+    return run_async(go())
+
+
+def test_engine_repetition_penalty_breaks_greedy_loops(run_async):
+    """A strong repetition penalty must change the GREEDY continuation
+    (penalties apply before argmax) and strictly reduce repetition vs
+    the unpenalized run — across multiple K=4 windows, so the device
+    in-window counts AND the host rebuild both participate."""
+    prompt = [(i * 11) % 200 + 1 for i in range(12)]
+    plain = _run_engine(SamplingOptions(), prompt, 24, run_async)
+    pen = _run_engine(SamplingOptions(repetition_penalty=8.0), prompt, 24,
+                      run_async)
+    assert len(plain) == len(pen) == 24
+
+    def max_count(toks):
+        _, c = np.unique(np.asarray(toks), return_counts=True)
+        return int(c.max())
+
+    # tiny random models loop hard under greedy; the penalty must break
+    # that loop measurably
+    assert max_count(pen) < max_count(plain), (plain, pen)
+    assert pen != plain
+
+
+def test_engine_presence_penalty_no_pipelining_correctness(run_async):
+    """Presence-penalized batches force the in-flight window to land
+    before dispatch (host counts must be accurate); the run completes
+    with the requested token count and differs from the plain run."""
+    prompt = [5, 9, 2, 6, 5, 3]
+    plain = _run_engine(SamplingOptions(), prompt, 16, run_async)
+    pen = _run_engine(SamplingOptions(presence_penalty=2.0), prompt, 16,
+                      run_async)
+    assert len(pen) == 16
+    assert pen != plain
+
+
+def test_no_penalties_path_untouched(run_async):
+    """Requests without penalties keep the exact pre-penalty program —
+    token-identical to a run before this feature (pins the None path)."""
+    prompt = [3, 1, 4, 1, 5]
+    a = _run_engine(SamplingOptions(), prompt, 12, run_async)
+    b = _run_engine(SamplingOptions(), prompt, 12, run_async)
+    assert a == b and len(a) == 12
+
+
+def test_warmup_penalties_flag(run_async):
+    """warmup_penalties=True pre-compiles the penalized window variants;
+    a penalty request then serves through the warmed engine."""
+    toks = _run_engine(SamplingOptions(repetition_penalty=2.0),
+                       [1, 2, 3, 4], 8, run_async,
+                       warmup_penalties=True)
+    assert len(toks) == 8
