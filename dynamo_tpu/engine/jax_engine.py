@@ -1131,7 +1131,7 @@ class JaxEngine:
         # rebuilt from seq.tokens each dispatch): land the in-flight
         # window first, trading the pipelining overlap away only for
         # batches that actually use penalties
-        if prev is not None and any(_wants_penalties(s.req.sampling)
+        if prev is not None and any(_wants_count_state(s.req.sampling)
                                     for s in batch):
             self._process_window(prev)
             prev = None
@@ -1260,13 +1260,49 @@ class JaxEngine:
 
     def _penalty_args(self, seqs: List[Sequence], sb: SamplingBatch,
                       pad_to: int):
-        """The (counts, presence, rep, freq, pres) tuple the samplers
-        take, or None for penalty-free batches (the only warmed path)."""
-        if not sb.has_penalties:
+        """The (counts, presence, rep, freq, pres[, bias]) tuple the
+        samplers take, or None for penalty/bias-free batches (the only
+        warmed path). The bias element is appended only when some row
+        sets logit_bias — its own treedef, so bias-free penalty batches
+        reuse the 5-tuple program."""
+        biased = [getattr(s.req.sampling, "logit_bias", None)
+                  for s in seqs]
+        if not sb.has_penalties and not any(biased):
             return None
-        return self._penalty_state(seqs, pad_to) + (
-            jnp.asarray(sb.rep), jnp.asarray(sb.freq),
-            jnp.asarray(sb.pres))
+        if sb.has_penalties:
+            state = self._penalty_state(seqs, pad_to)
+        else:
+            # bias-only: counts/presence are mathematically unused
+            # (rep=1, freq=pres=0 broadcast them away) — [B, 1]
+            # placeholders instead of 2x [B, V] arrays per dispatch
+            state = (jnp.zeros((pad_to, 1), jnp.int32),
+                     jnp.zeros((pad_to, 1), jnp.int8))
+        out = state + (jnp.asarray(sb.rep), jnp.asarray(sb.freq),
+                       jnp.asarray(sb.pres))
+        if any(biased):
+            V = self.cfg.vocab_size
+            rows = [self._bias_row(s) if b else None
+                    for s, b in zip(seqs, biased)]
+            bias = np.zeros((pad_to, V), np.float32)
+            for i, r in enumerate(rows):
+                if r is not None:
+                    bias[i] = r
+            out = out + (jnp.asarray(bias),)
+        return out
+
+    def _bias_row(self, seq: Sequence) -> np.ndarray:
+        """Per-sequence dense logit_bias row, built once and cached on
+        the Sequence (the dict is immutable per request; only the batch
+        assembly runs per dispatch)."""
+        row = getattr(seq, "_bias_row", None)
+        if row is None:
+            V = self.cfg.vocab_size
+            row = np.zeros(V, np.float32)
+            for t, v in (seq.req.sampling.logit_bias or {}).items():
+                if 0 <= int(t) < V:
+                    row[int(t)] = v
+            seq._bias_row = row
+        return row
 
     def _sample_device(self, seqs: List[Sequence], logits) -> jax.Array:
         """On-device token draw, no readback. logits: [B_padded, V]
@@ -1594,7 +1630,11 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
     return decode_multi
 
 
-def _wants_penalties(s) -> bool:
+def _wants_count_state(s) -> bool:
+    """True when the row needs ACCURATE token counts (the three
+    count-driven penalties) — these force the pipelining barrier.
+    logit_bias is static per request and needs neither counts nor the
+    barrier."""
     return bool((getattr(s, "repetition_penalty", None) or 1.0) != 1.0
                 or getattr(s, "frequency_penalty", None)
                 or getattr(s, "presence_penalty", None))

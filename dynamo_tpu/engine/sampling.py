@@ -64,7 +64,8 @@ class SamplingBatch:
 
 def apply_penalties(logits: jax.Array, counts: jax.Array,
                     presence: jax.Array, rep: jax.Array,
-                    freq: jax.Array, pres: jax.Array) -> jax.Array:
+                    freq: jax.Array, pres: jax.Array,
+                    bias=None) -> jax.Array:
     """Sampling penalties on raw logits (before temperature), vLLM
     order and semantics:
 
@@ -83,7 +84,11 @@ def apply_penalties(logits: jax.Array, counts: jax.Array,
         present & (rp != 1.0),
         jnp.where(logits > 0, logits / rp, logits * rp), logits)
     cf = counts.astype(jnp.float32)
-    return logits - freq[:, None] * cf - pres[:, None] * (cf > 0)
+    logits = logits - freq[:, None] * cf - pres[:, None] * (cf > 0)
+    if bias is not None:
+        # OpenAI logit_bias [B, V]: plain additive, before sampling
+        logits = logits + bias
+    return logits
 
 
 def update_penalty_state(penalties, sampled: jax.Array, done: jax.Array):
@@ -96,12 +101,17 @@ def update_penalty_state(penalties, sampled: jax.Array, done: jax.Array):
     through the penalty-free path)."""
     if penalties is None:
         return None
-    counts, presence, rep, freq, pres = penalties
+    counts, presence, rest = penalties[0], penalties[1], penalties[2:]
+    if counts.shape[1] == 1:
+        # bias-only placeholder state ([B, 1]): counts are unused by
+        # apply_penalties (neutral rep/freq/pres) — nothing to fold in,
+        # and a real scatter would index out of bounds
+        return penalties
     rows = jnp.arange(counts.shape[0])
     live = jnp.logical_not(done).astype(counts.dtype)
     counts = counts.at[rows, sampled].add(live)
     presence = presence.at[rows, sampled].max(live.astype(presence.dtype))
-    return (counts, presence, rep, freq, pres)
+    return (counts, presence) + rest
 
 
 @partial(jax.jit, static_argnames=("max_top_k",))

@@ -128,7 +128,11 @@ class HttpService:
         ctx = Context()
         try:
             t0 = time.monotonic()
-            aiter = engine(req, ctx).__aiter__()
+            n = getattr(req, "n", 1) or 1
+            if n > 1:
+                aiter = _fanout_choices(engine, req, ctx, n).__aiter__()
+            else:
+                aiter = engine(req, ctx).__aiter__()
             # pull the first item BEFORE committing response headers so
             # early failures (validation, routing) map to clean HTTP errors
             try:
@@ -237,13 +241,160 @@ class HttpService:
             if data is None:
                 continue
             for choice in data.get("choices", []):
-                agg.add_text(choice.get("text", ""), choice.get("finish_reason"))
+                agg.add_text(choice.get("text", ""),
+                             choice.get("finish_reason"),
+                             index=choice.get("index", 0))
             if data.get("usage"):
                 from ..protocols.openai import Usage
 
                 agg.usage = Usage(**data["usage"])
         guard.mark_ok()
         return web.json_response(agg.response().model_dump(exclude_none=True))
+
+
+async def _fanout_choices(engine, req, ctx: Context, n: int):
+    """n>1 (OpenAI parallel sampling): run n single-choice generations
+    concurrently — each a full pipeline pass whose prompt prefill the
+    engine's prefix cache dedups after the first — and multiplex their
+    chunks with per-stream choice indices. The reference inherits n from
+    vLLM's SamplingParams; here it composes from the existing machinery.
+
+    Seeds: an explicit request seed derives per-choice seeds (seed+i, so
+    the choices differ but the SET is reproducible); no seed keeps each
+    stream's own entropy. Cancellation: the outer context's stop/kill
+    propagates to every child stream. Annotation events (comments,
+    formatted_prompt) pass through from choice 0 only — n identical
+    copies would duplicate them."""
+    import time as _time
+    import uuid as _uuid
+
+    queue: asyncio.Queue = asyncio.Queue()
+    DONE = object()
+    kids = [Context(f"{ctx.id}-c{i}") for i in range(n)]
+    # ONE stream identity: OpenAI streaming semantics give all chunks of
+    # a response a single id/created, choices distinguished by index
+    stream_id = f"chatcmpl-{_uuid.uuid4().hex}"
+    created = int(_time.time())
+
+    def child_req(i):
+        upd = {"n": 1}
+        if getattr(req, "seed", None) is not None:
+            upd["seed"] = req.seed + i
+        return req.model_copy(update=upd)
+
+    async def pump(i):
+        try:
+            async for chunk in engine(child_req(i), kids[i]):
+                await queue.put((i, chunk))
+        except Exception as e:  # noqa: BLE001 — surface as stream error
+            await queue.put((i, e))
+        finally:
+            await queue.put((i, DONE))
+
+    async def propagate_cancel():
+        await ctx.wait_stopped()  # kill() sets _stop too
+        for k in kids:
+            (k.kill if ctx.killed else k.stop_generating)()
+
+    tasks = [asyncio.ensure_future(pump(i)) for i in range(n)]
+    canceller = asyncio.ensure_future(propagate_cancel())
+    live = n
+    merged_usage = None
+    usage_template = None
+    try:
+        while live:
+            i, item = await queue.get()
+            if item is DONE:
+                live -= 1
+                continue
+            if isinstance(item, Exception):
+                raise item
+            if isinstance(item, Annotated) and item.data is None:
+                if item.is_error or i == 0:
+                    yield item
+                continue
+            u = _chunk_usage(item)
+            if u is not None:
+                # one merged usage chunk at the end (OpenAI semantics:
+                # completion tokens sum over choices, shared prompt
+                # once). Per-child usage never passes through — even on
+                # chunks that also carry choices — or aggregators would
+                # double-count it against the merged chunk
+                from ..protocols.openai import Usage, _merge_usage
+
+                merged_usage = _merge_usage(merged_usage, Usage(**u))
+                usage_template = item
+                if not _chunk_choices(item):
+                    continue  # usage-only chunk: held back entirely
+                item = _strip_usage(item)
+            yield _reindex(item, i, stream_id, created)
+        if merged_usage is not None and usage_template is not None:
+            yield _reindex(_set_usage(usage_template, merged_usage),
+                           0, stream_id, created)
+    finally:
+        canceller.cancel()
+        for k in kids:
+            k.stop_generating()
+        for t in tasks:
+            t.cancel()
+
+
+def _chunk_target(chunk):
+    return chunk.data if isinstance(chunk, Annotated) else chunk
+
+
+def _chunk_usage(chunk):
+    t = _chunk_target(chunk)
+    if isinstance(t, dict):
+        return t.get("usage")
+    u = getattr(t, "usage", None)
+    return u.model_dump() if u is not None else None
+
+
+def _chunk_choices(chunk):
+    t = _chunk_target(chunk)
+    if isinstance(t, dict):
+        return t.get("choices") or []
+    return getattr(t, "choices", None) or []
+
+
+def _set_usage(chunk, usage):
+    t = _chunk_target(chunk)
+    if isinstance(t, dict):
+        t = dict(t, usage=usage.model_dump(), choices=[])
+        if isinstance(chunk, Annotated):
+            return Annotated(data=t)
+        return t
+    t = t.model_copy(update={"usage": usage, "choices": []})
+    return Annotated(data=t.model_dump(exclude_none=True))         if isinstance(chunk, Annotated) else t
+
+
+def _reindex(chunk, i: int, stream_id=None, created=None):
+    """Stamp a child stream's chunk with its choice index and (for n>1
+    streams) the single parent-stream id/created."""
+    target = chunk.data if isinstance(chunk, Annotated) else chunk
+    if isinstance(target, dict):
+        for c in target.get("choices", []):
+            c["index"] = i
+        if stream_id is not None and "id" in target:
+            target["id"] = stream_id
+            target["created"] = created
+    elif hasattr(target, "choices"):
+        for c in target.choices:
+            c.index = i
+        if stream_id is not None and hasattr(target, "id"):
+            target.id = stream_id
+            target.created = created
+    return chunk
+
+
+def _strip_usage(chunk):
+    target = chunk.data if isinstance(chunk, Annotated) else chunk
+    if isinstance(target, dict):
+        target.pop("usage", None)
+    elif hasattr(target, "usage"):
+        target.usage = None
+    return chunk
 
 
 def _chunk_dict(chunk) -> Optional[dict]:

@@ -91,6 +91,9 @@ class OpenAIPreprocessor:
             frequency_penalty=request.frequency_penalty,
             presence_penalty=request.presence_penalty,
             repetition_penalty=getattr(request, "repetition_penalty", None),
+            logit_bias=({int(k): float(v)
+                         for k, v in request.logit_bias.items()}
+                        if getattr(request, "logit_bias", None) else None),
             seed=request.seed, n=request.n or 1)
         if ext.greedy_sampling:
             sampling.temperature = 0.0

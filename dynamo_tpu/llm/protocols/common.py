@@ -42,6 +42,9 @@ class SamplingOptions:
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
+    # OpenAI logit_bias: token id -> additive bias (-100..100), applied
+    # to the logits before sampling
+    logit_bias: Optional[dict] = None
     seed: Optional[int] = None
     n: int = 1
 

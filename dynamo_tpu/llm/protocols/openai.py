@@ -63,6 +63,7 @@ class ChatCompletionRequest(BaseModel):
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
+    logit_bias: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
     logprobs: Optional[bool] = None
     top_logprobs: Optional[int] = None
@@ -99,6 +100,7 @@ class CompletionRequest(BaseModel):
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
+    logit_bias: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
     logprobs: Optional[int] = None
     echo: bool = False
@@ -239,26 +241,35 @@ class ChatAggregator:
         self.id = f"chatcmpl-{request_id or uuid.uuid4().hex}"
         self.model = model
         self.created = int(time.time())
-        self.text_parts: List[str] = []
-        self.finish_reason: Optional[str] = None
+        # keyed by choice index — n>1 streams interleave their chunks
+        self.text_parts: Dict[int, List[str]] = {}
+        self.finish_reason: Dict[int, str] = {}
         self.usage: Optional[Usage] = None
 
     def add_chunk(self, chunk: ChatCompletionChunk) -> None:
         for choice in chunk.choices:
             if choice.delta.content:
-                self.text_parts.append(choice.delta.content)
+                self.text_parts.setdefault(choice.index, []).append(
+                    choice.delta.content)
             if choice.finish_reason:
-                self.finish_reason = choice.finish_reason
+                self.finish_reason[choice.index] = choice.finish_reason
         if chunk.usage is not None:
+            # last-wins: engines may report CUMULATIVE usage per chunk;
+            # summing belongs to the n>1 fan-out, which guarantees
+            # exactly one (already-merged) usage chunk per stream
             self.usage = chunk.usage
 
     def response(self) -> ChatCompletionResponse:
+        idxs = sorted(set(self.text_parts) | set(self.finish_reason)) or [0]
         return ChatCompletionResponse(
             id=self.id, created=self.created, model=self.model,
             choices=[ChatChoice(
-                message=ChatMessage(role="assistant",
-                                    content="".join(self.text_parts)),
-                finish_reason=self.finish_reason or "stop")],
+                index=i,
+                message=ChatMessage(
+                    role="assistant",
+                    content="".join(self.text_parts.get(i, []))),
+                finish_reason=self.finish_reason.get(i) or "stop")
+                for i in idxs],
             usage=self.usage)
 
 
@@ -267,20 +278,36 @@ class CompletionAggregator:
         self.id = f"cmpl-{request_id or uuid.uuid4().hex}"
         self.model = model
         self.created = int(time.time())
-        self.text_parts: List[str] = []
-        self.finish_reason: Optional[str] = None
+        self.text_parts: Dict[int, List[str]] = {}
+        self.finish_reason: Dict[int, str] = {}
         self.usage: Optional[Usage] = None
 
-    def add_text(self, text: str, finish_reason: Optional[str] = None) -> None:
+    def add_text(self, text: str, finish_reason: Optional[str] = None,
+                 index: int = 0) -> None:
         if text:
-            self.text_parts.append(text)
+            self.text_parts.setdefault(index, []).append(text)
         if finish_reason:
-            self.finish_reason = finish_reason
+            self.finish_reason[index] = finish_reason
 
     def response(self) -> CompletionResponse:
+        idxs = sorted(set(self.text_parts) | set(self.finish_reason)) or [0]
         return CompletionResponse(
             id=self.id, created=self.created, model=self.model,
             choices=[CompletionChoice(
-                text="".join(self.text_parts),
-                finish_reason=_finish_reason_openai(self.finish_reason) or "stop")],
+                index=i, text="".join(self.text_parts.get(i, [])),
+                finish_reason=_finish_reason_openai(
+                    self.finish_reason.get(i)) or "stop")
+                for i in idxs],
             usage=self.usage)
+
+
+def _merge_usage(cur: Optional["Usage"], new: "Usage") -> "Usage":
+    """n>1: completion tokens SUM across choices; the shared prompt is
+    counted once (OpenAI semantics)."""
+    if cur is None:
+        return new
+    return Usage(
+        prompt_tokens=max(cur.prompt_tokens, new.prompt_tokens),
+        completion_tokens=cur.completion_tokens + new.completion_tokens,
+        total_tokens=max(cur.prompt_tokens, new.prompt_tokens)
+        + cur.completion_tokens + new.completion_tokens)

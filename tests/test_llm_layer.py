@@ -330,3 +330,70 @@ def test_http_error_paths_and_annotations(run_async):
         await service.stop()
 
     run_async(main())
+
+
+def test_http_n_choices(run_async):
+    """OpenAI n>1 (accepted-but-ignored until r5): unary responses carry
+    n distinct-index choices with summed usage; streaming chunks carry
+    per-choice indices and ONE [DONE]. Runs over the echo chain (the
+    reference inherits n from vLLM SamplingParams; here it fans out
+    n single-choice pipeline passes — tests/test_penalties.py covers the
+    real engine's seed derivation)."""
+
+    async def main():
+        import aiohttp
+
+        mdc = make_mdc()
+        service = HttpService()
+        service.manager.add_chat_model(
+            "m", LocalChatChain(mdc, EchoEngineCore(delay_ms=0)))
+        await service.start(host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+
+        async with aiohttp.ClientSession() as http:
+            body = {"model": "m", "max_tokens": 6, "n": 3,
+                    "messages": [{"role": "user", "content": "abc"}]}
+            async with http.post(f"{base}/v1/chat/completions",
+                                 json=body) as r:
+                assert r.status == 200, await r.text()
+                full = await r.json()
+            choices = full["choices"]
+            assert [c["index"] for c in choices] == [0, 1, 2]
+            assert all(c["message"]["content"] for c in choices)
+            assert all(c["finish_reason"] == "length" for c in choices)
+
+            sbody = dict(body, stream=True,
+                         stream_options={"include_usage": True})
+            seen_idx = set()
+            done_count = 0
+            usages = []
+            ids = set()
+            async with http.post(f"{base}/v1/chat/completions",
+                                 json=sbody) as r:
+                assert r.status == 200
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    payload = line[len("data: "):]
+                    if payload == "[DONE]":
+                        done_count += 1
+                        continue
+                    c = json.loads(payload)
+                    if c.get("id"):
+                        ids.add(c["id"])
+                    for ch in c.get("choices", []):
+                        seen_idx.add(ch["index"])
+                    if c.get("usage"):
+                        usages.append(c["usage"])
+            assert done_count == 1
+            assert seen_idx == {0, 1, 2}
+            # OpenAI stream semantics: ONE id across all chunks, and
+            # exactly ONE (merged) usage chunk — per-child usage never
+            # leaks through
+            assert len(ids) == 1, ids
+            assert len(usages) == 1
+            assert usages[0]["completion_tokens"] == 18  # 3 x 6
+        await service.stop()
+
+    run_async(main())

@@ -144,3 +144,31 @@ def test_warmup_penalties_flag(run_async):
                        [1, 2, 3, 4], 8, run_async,
                        warmup_penalties=True)
     assert len(toks) == 8
+
+
+def test_logit_bias_forces_and_bans_tokens(run_async):
+    """OpenAI logit_bias: +100 effectively forces a token under greedy,
+    -100 bans it — end-to-end through the engine (dense bias array rides
+    the penalty tuple as a 6th element)."""
+    forced = _run_engine(SamplingOptions(logit_bias={7: 100.0}),
+                         [1, 2, 3], 6, run_async)
+    assert forced == [7] * 6
+
+    plain = _run_engine(SamplingOptions(), [1, 2, 3], 6, run_async)
+    banned = _run_engine(
+        SamplingOptions(logit_bias={int(plain[0]): -100.0}),
+        [1, 2, 3], 6, run_async)
+    assert banned[0] != plain[0]
+
+
+def test_logit_bias_http_mapping():
+    """The OpenAI request's {str token id: bias} map reaches
+    SamplingOptions as {int: float} (the preprocessor conversion)."""
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+
+    req = ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "x"}],
+        logit_bias={"42": -100, "7": 2.5})
+    assert req.logit_bias == {"42": -100, "7": 2.5}
+    mapped = {int(k): float(v) for k, v in req.logit_bias.items()}
+    assert mapped == {42: -100.0, 7: 2.5}
