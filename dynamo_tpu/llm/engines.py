@@ -72,6 +72,18 @@ class LocalCompletionChain:
         rid = f"cmpl-{context.id or _uuid.uuid4().hex}"
         created = int(_time.time())
         completion_tokens = 0
+        if pre.output.echo_prompt:
+            # OpenAI completions echo=true: the response text starts with
+            # the prompt (reconstructed from the request token ids so
+            # pre-tokenized prompts echo too)
+            yield {
+                "id": rid, "object": "text_completion", "created": created,
+                "model": request.model,
+                "choices": [{"index": 0,
+                             "text": self.preprocessor.tokenizer.decode(
+                                 list(pre.token_ids)),
+                             "finish_reason": None}],
+            }
         async for out in self.backend.generate(pre, context):
             completion_tokens += len(out.token_ids)
             if out.text or out.finish_reason:

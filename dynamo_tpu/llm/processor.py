@@ -103,6 +103,16 @@ class Processor:
         rid = f"cmpl-{context.id or uuid.uuid4().hex}"
         created = int(time.time())
         n_out = 0
+        if pre.output.echo_prompt:
+            # OpenAI completions echo=true (same contract as the local
+            # chain, llm/engines.py)
+            yield {"id": rid, "object": "text_completion",
+                   "created": created, "model": request.model,
+                   "choices": [{
+                       "index": 0,
+                       "text": self.preprocessor.tokenizer.decode(
+                           list(pre.token_ids)),
+                       "finish_reason": None}]}
         async for out in backend.generate(pre, context):
             n_out += len(out.token_ids)
             if out.text or out.finish_reason:

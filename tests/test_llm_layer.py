@@ -397,3 +397,36 @@ def test_http_n_choices(run_async):
         await service.stop()
 
     run_async(main())
+
+
+def test_completions_echo(run_async):
+    """OpenAI completions echo=true: the response text starts with the
+    prompt (accepted-but-ignored until r5)."""
+
+    async def main():
+        import aiohttp
+
+        mdc = make_mdc()
+        service = HttpService()
+        from dynamo_tpu.llm.engines import LocalCompletionChain
+        service.manager.add_completions_model(
+            "m", LocalCompletionChain(mdc, EchoEngineCore(delay_ms=0)))
+        await service.start(host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as http:
+            body = {"model": "m", "prompt": "hello", "max_tokens": 4,
+                    "echo": True}
+            async with http.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                full = await r.json()
+            text = full["choices"][0]["text"]
+            assert text.startswith("hello"), text
+            assert len(text) > len("hello")
+            # echo off: no prompt prefix
+            async with http.post(f"{base}/v1/completions",
+                                 json=dict(body, echo=False)) as r:
+                plain = await r.json()
+            assert not plain["choices"][0]["text"].startswith("hello")
+        await service.stop()
+
+    run_async(main())
