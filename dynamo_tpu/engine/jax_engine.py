@@ -46,7 +46,7 @@ from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
 from ..runtime.engine import Context
 from .kv_manager import PageManager, chain_hashes
-from .sampling import (SamplingBatch, sample_tokens,
+from .sampling import (SamplingBatch, logprob_aux, sample_tokens,
                        update_penalty_state)
 
 log = logging.getLogger("dynamo_tpu.engine")
@@ -71,6 +71,12 @@ class EngineConfig:
     # `prefilling` while their restores drain across iterations; 0 =
     # unlimited (the old single-shot behavior)
     tier_restore_chunk: int = 32
+    # top-N alternatives returned per token when a request asks for
+    # logprobs; matches OpenAI's top_logprobs cap of 20 so no valid
+    # request is silently truncated. ONE static value so all logprob
+    # requests share a compiled window variant (the per-row requested
+    # count is sliced host-side)
+    max_top_logprobs: int = 20
     # pre-compile the penalized decode-window variants too (doubles the
     # decode programs in warmup). Off by default: most deployments never
     # send sampling penalties, and a first penalty request merely pays
@@ -204,6 +210,7 @@ class _PendingWindow:
     toks: jax.Array                 # [B, K] sampled tokens
     carry: tuple                    # (tok, pos, done, steps, remaining)
     index: Dict[int, int] = field(default_factory=dict)  # id(seq) → row
+    aux: Optional[tuple] = None     # (lp [B,K], tv [B,K,N], ti [B,K,N])
     processed: bool = False
 
 
@@ -215,6 +222,7 @@ class _PendingPrefill:
 
     finishing: List[Tuple[int, Sequence]]
     sampled: Optional[jax.Array]
+    aux: Optional[tuple] = None  # (lp [B], top_vals [B,N], top_ids [B,N])
     processed: bool = False
 
 
@@ -940,10 +948,11 @@ class JaxEngine:
         # compile per finishing-count); skipped entirely when every
         # finishing row is a preemption-resume (next token already sampled)
         if any(s.generated == 0 for _, s in finishing):
-            sampled = self._sample_device(batch, logits)
+            sampled, aux = self._sample_device(batch, logits)
         else:
-            sampled = None
-        return _PendingPrefill(finishing=finishing, sampled=sampled)
+            sampled, aux = None, None
+        return _PendingPrefill(finishing=finishing, sampled=sampled,
+                               aux=aux)
 
     def _long_prefill(self, seq: Sequence) -> None:
         """Whole-prompt sequence-parallel prefill via ring attention: run
@@ -983,8 +992,11 @@ class JaxEngine:
         self.steps += 1
         self._commit_full_pages(seq)
         if seq.generated == 0:
-            tok = self._sample([seq], logits)
-            self._append_token(seq, int(tok[0]))
+            toks_d, aux_d = self._sample_device([seq], logits)
+            aux = (tuple(np.asarray(a) for a in aux_d)
+                   if aux_d is not None else None)
+            self._append_token(seq, int(np.asarray(toks_d)[0]),
+                               lp=self._lp_entry(seq, aux, 0))
             if seq.finished is None:
                 self.running.append(seq)
         else:
@@ -1009,10 +1021,13 @@ class JaxEngine:
             return
         pf.processed = True
         toks = np.asarray(pf.sampled) if pf.sampled is not None else None
+        aux = (tuple(np.asarray(a) for a in pf.aux)
+               if pf.aux is not None else None)
         for i, seq in pf.finishing:
             self._commit_full_pages(seq)
             if seq.generated == 0:
-                self._append_token(seq, int(toks[i]))
+                self._append_token(seq, int(toks[i]),
+                                   lp=self._lp_entry(seq, aux, i))
                 if seq.finished is None:
                     self.running.append(seq)
             else:
@@ -1095,11 +1110,15 @@ class JaxEngine:
         logits, self.kv_k, self.kv_v = self.decode_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots))
-        sampled = self._sample(batch, logits)
+        toks_d, aux_d = self._sample_device(batch, logits)
+        sampled = np.asarray(toks_d)[:len(batch)]
+        aux = (tuple(np.asarray(a) for a in aux_d)
+               if aux_d is not None else None)
         self.steps += 1
         self.decode_tokens_total += len(batch)
-        for seq, tok in zip(batch, sampled):
-            self._append_token(seq, int(tok))
+        for i, (seq, tok) in enumerate(zip(batch, sampled)):
+            self._append_token(seq, int(tok),
+                               lp=self._lp_entry(seq, aux, i))
 
     def _dispatch_decode_window(self) -> Optional[_PendingWindow]:
         """Enqueue the next fused K-step decode window WITHOUT reading
@@ -1180,13 +1199,22 @@ class JaxEngine:
             steps, rem = jnp.asarray(nsteps), jnp.asarray(nrem)
         sb = SamplingBatch.build([s.req.sampling for s in batch], B)
         pen = self._penalty_args(batch, sb, B)
-        toks, carry, self.kv_k, self.kv_v = self.decode_multi_fn(
+        topn = (self.ecfg.max_top_logprobs
+                if self._wants_logprobs(batch) else 0)
+        out = self.decode_multi_fn(
             self.params, tok, pos, done, steps, rem, self.kv_k, self.kv_v,
             jnp.asarray(table), jnp.asarray(sb.temperature),
             jnp.asarray(sb.top_k), jnp.asarray(sb.top_p),
-            jnp.asarray(sb.seeds), jnp.asarray(eos), pen, k_steps=K)
+            jnp.asarray(sb.seeds), jnp.asarray(eos), pen, k_steps=K,
+            logprobs_topn=topn)
+        if topn:
+            toks, aux, carry, self.kv_k, self.kv_v = out
+        else:
+            toks, carry, self.kv_k, self.kv_v = out
+            aux = None
         self.steps += 1
         pend = _PendingWindow(batch=list(batch), toks=toks, carry=carry,
+                              aux=aux,
                               index={id(s): i for i, s in enumerate(batch)})
         self._inflight.append(pend)
         return pend
@@ -1201,6 +1229,8 @@ class JaxEngine:
             return
         pend.processed = True
         toks = np.asarray(pend.toks)
+        aux = (tuple(np.asarray(a) for a in pend.aux)
+               if pend.aux is not None else None)
         if pend in self._inflight:
             self._inflight.remove(pend)
         if self._pending is pend:
@@ -1212,7 +1242,8 @@ class JaxEngine:
             for j in range(K):
                 if seq.finished is not None or seq.context.stopped:
                     break  # tokens past EOS/stop are discarded
-                self._append_token(seq, int(toks[i, j]))
+                self._append_token(seq, int(toks[i, j]),
+                                   lp=self._lp_entry(seq, aux, i, j))
                 self.decode_tokens_total += 1
 
     # -------------------------------------------- deferred page reclamation
@@ -1313,24 +1344,48 @@ class JaxEngine:
         steps = np.zeros(pad_to, np.int32)
         steps[:len(seqs)] = [s.generated for s in seqs]
         pen = self._penalty_args(seqs, sb, pad_to)
-        return sample_tokens(logits, jnp.asarray(sb.temperature),
+        toks = sample_tokens(logits, jnp.asarray(sb.temperature),
                              jnp.asarray(sb.top_k), jnp.asarray(sb.top_p),
                              jnp.asarray(sb.seeds), jnp.asarray(steps),
                              max_top_k=self.ecfg.max_top_k, penalties=pen)
+        aux = None
+        if self._wants_logprobs(seqs):
+            aux = logprob_aux(jnp.asarray(logits), toks,
+                              self.ecfg.max_top_logprobs)
+        return toks, aux
+
+    def _wants_logprobs(self, seqs: List[Sequence]) -> bool:
+        return any(s.req.output.logprobs is not None for s in seqs)
 
     def _sample(self, seqs: List[Sequence], logits) -> np.ndarray:
-        toks = self._sample_device(seqs, logits)
+        toks, _ = self._sample_device(seqs, logits)
         return np.asarray(toks)[:len(seqs)]  # host sync (executor thread)
 
-    def _append_token(self, seq: Sequence, tok: int) -> None:
+    def _lp_entry(self, seq: Sequence, aux, i: int, j: Optional[int] = None):
+        """(logprob, {token_id: logprob, ...}) for row i (step j in a
+        window) — None unless this sequence asked for logprobs."""
+        if aux is None or seq.req.output.logprobs is None:
+            return None
+        lp, tv, ti = aux
+        if j is None:
+            chosen, vals, ids = lp[i], tv[i], ti[i]
+        else:
+            chosen, vals, ids = lp[i, j], tv[i, j], ti[i, j]
+        topn = min(int(seq.req.output.logprobs), len(ids))
+        top = {int(t): float(v) for t, v in zip(ids[:topn], vals[:topn])}
+        return float(chosen), top
+
+    def _append_token(self, seq: Sequence, tok: int, lp=None) -> None:
         """Record a generated token: emit, check termination, commit pages."""
         seq.tokens.append(tok)
         seq.last_token = tok
         seq.generated += 1
         eos = (not seq.req.stop.ignore_eos and tok in seq.req.eos_token_ids) \
             or tok in (seq.req.stop.stop_token_ids or [])
-        self._emit(seq, EngineOutput(token_ids=[tok],
-                                     prompt_tokens=seq.num_prompt))
+        self._emit(seq, EngineOutput(
+            token_ids=[tok], prompt_tokens=seq.num_prompt,
+            logprobs=[lp[0]] if lp is not None else None,
+            top_logprobs=[lp[1]] if lp is not None else None))
         # prefix-cache publish: commit a page only once every slot in it
         # holds WRITTEN KV. The newest token's KV is written when it next
         # serves as a decode input — which never happens for a terminal
@@ -1593,11 +1648,12 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
     rows write DROP_SLOT so nothing lands in their pages."""
     from ..models.llama import carry_active, carry_step_update, logits_at
 
-    @partial(jax.jit, static_argnames=("k_steps",),
+    @partial(jax.jit, static_argnames=("k_steps", "logprobs_topn"),
              donate_argnames=("kv_k", "kv_v"))
     def decode_multi(params, tokens, positions, done, steps, remaining,
                      kv_k, kv_v, page_table, temperature, top_k, top_p,
-                     seeds, eos_table, penalties=None, *, k_steps: int):
+                     seeds, eos_table, penalties=None, *, k_steps: int,
+                     logprobs_topn: int = 0):
         B = tokens.shape[0]
         ps = kv_k.shape[3]
         P = page_table.shape[1]
@@ -1609,6 +1665,7 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
         # K-step program lets XLA alias the pool updates in place.
         tok, pos = tokens, positions
         toks = []
+        lps, tvs, tis = [], [], []
         for i in range(k_steps):
             active = carry_active(done, pos)
             page = page_table[rows, jnp.clip(pos // ps, 0, P - 1)]
@@ -1620,12 +1677,20 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
             nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
                                 steps, max_top_k=max_top_k,
                                 penalties=penalties)
+            if logprobs_topn:
+                lp, tv, ti = logprob_aux(logits, nxt, logprobs_topn)
+                lps.append(lp); tvs.append(tv); tis.append(ti)
             penalties = update_penalty_state(penalties, nxt, done)
             tok, pos, done, steps, remaining = carry_step_update(
                 nxt, tok, pos, done, steps, remaining, eos_table)
             toks.append(tok)
-        return (jnp.stack(toks, axis=1), (tok, pos, done, steps, remaining),
-                kv_k, kv_v)
+        out_toks = jnp.stack(toks, axis=1)
+        carry = (tok, pos, done, steps, remaining)
+        if logprobs_topn:
+            aux = (jnp.stack(lps, axis=1), jnp.stack(tvs, axis=1),
+                   jnp.stack(tis, axis=1))
+            return out_toks, aux, carry, kv_k, kv_v
+        return out_toks, carry, kv_k, kv_v
 
     return decode_multi
 

@@ -164,7 +164,20 @@ def sample_tokens(logits: jax.Array, temperature: jax.Array,
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 
+def _gather_rows(logp: jax.Array, chosen: jax.Array) -> jax.Array:
+    return logp[jnp.arange(logp.shape[0]), chosen]
+
+
 def compute_logprobs(logits: jax.Array, chosen: jax.Array) -> jax.Array:
     """Log-probability of the chosen tokens: logits [B, V], chosen [B]."""
+    return _gather_rows(jax.nn.log_softmax(logits, axis=-1), chosen)
+
+
+def logprob_aux(logits: jax.Array, chosen: jax.Array, topn: int):
+    """(chosen_logprob [B], top_vals [B, topn], top_ids [B, topn]) over
+    the RAW model logits — OpenAI logprobs describe the model's
+    distribution, so penalties/temperature are not reflected (vLLM's
+    default differs; this is the documented contract here)."""
     logp = jax.nn.log_softmax(logits, axis=-1)
-    return logp[jnp.arange(logits.shape[0]), chosen]
+    tv, ti = jax.lax.top_k(logp, topn)
+    return _gather_rows(logp, chosen), tv, ti

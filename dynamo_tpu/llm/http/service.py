@@ -243,7 +243,8 @@ class HttpService:
             for choice in data.get("choices", []):
                 agg.add_text(choice.get("text", ""),
                              choice.get("finish_reason"),
-                             index=choice.get("index", 0))
+                             index=choice.get("index", 0),
+                             logprobs=choice.get("logprobs"))
             if data.get("usage"):
                 from ..protocols.openai import Usage
 

@@ -105,12 +105,19 @@ class OpenAIPreprocessor:
             min_tokens=getattr(request, "min_tokens", None),
             ignore_eos=bool(ext.ignore_eos))
         raw_logprobs = getattr(request, "logprobs", None)
-        logprobs: Optional[int] = getattr(request, "top_logprobs", None)
+        top_lp: Optional[int] = getattr(request, "top_logprobs", None)
+        if top_lp is not None and raw_logprobs is not True:
+            # OpenAI: top_logprobs requires logprobs=true (400 otherwise)
+            raise ValueError("top_logprobs requires logprobs to be true")
+        logprobs: Optional[int] = top_lp
         if logprobs is None:
             if raw_logprobs is True:
                 logprobs = 0  # sampled-token logprob only
             elif isinstance(raw_logprobs, int) and not isinstance(raw_logprobs, bool):
                 logprobs = raw_logprobs  # completions-style integer
+        if logprobs is not None and not 0 <= logprobs <= 20:
+            raise ValueError("logprobs/top_logprobs must be between 0 "
+                             "and 20")
         output = OutputOptions(
             logprobs=logprobs, echo_prompt=bool(getattr(request, "echo", False)))
         return PreprocessedRequest(
@@ -147,8 +154,10 @@ class OpenAIPreprocessor:
             completion_tokens += len(out.token_ids)
             if out.completion_tokens is not None:
                 completion_tokens = out.completion_tokens
-            if out.text or out.finish_reason:
-                yield gen.content_chunk(out.text or "", out.finish_reason)
+            lp = chat_logprobs_content(out, self.tokenizer)
+            if out.text or out.finish_reason or lp:
+                yield gen.content_chunk(out.text or "", out.finish_reason,
+                                        logprobs=lp)
             if out.finish_reason:
                 finish = out.finish_reason
                 break
@@ -159,3 +168,53 @@ class OpenAIPreprocessor:
                 prompt_tokens=prompt_tokens,
                 completion_tokens=completion_tokens,
                 total_tokens=prompt_tokens + completion_tokens))
+
+def chat_logprobs_content(out, tokenizer) -> Optional[dict]:
+    """EngineOutput logprob fields → the OpenAI chat ``logprobs`` object
+    ({"content": [{token, logprob, bytes, top_logprobs}]}). None when
+    the request didn't ask (the engine attaches fields only then).
+    Logprobs describe the RAW model distribution (docs: sampling
+    penalties/temperature are not reflected)."""
+    if not out.logprobs or not out.token_ids:
+        return None
+
+    # "bytes" derives from the DECODED string: byte-fallback tokens that
+    # split a multi-byte character decode to U+FFFD, so their bytes show
+    # the replacement character, not the raw token bytes — a documented
+    # fidelity limit of this surface
+    def entry(tid: int, lp: float, tops: dict) -> dict:
+        s = tokenizer.decode([int(tid)])
+        return {"token": s, "logprob": lp, "bytes": list(s.encode()),
+                "top_logprobs": [
+                    {"token": tokenizer.decode([int(t)]), "logprob": v,
+                     "bytes": list(tokenizer.decode([int(t)]).encode())}
+                    for t, v in (tops or {}).items()]}
+
+    tops_list = out.top_logprobs or [{}] * len(out.token_ids)
+    return {"content": [entry(t, lp, tp) for t, lp, tp in
+                        zip(out.token_ids, out.logprobs, tops_list)]}
+
+
+def completion_logprobs(out, tokenizer, offset: int) -> Optional[dict]:
+    """Legacy completions logprobs object: parallel ``tokens`` /
+    ``token_logprobs`` / ``top_logprobs`` / ``text_offset`` lists.
+
+    ``offset`` is the caller's position in the ASSEMBLED response text
+    (echoed prompt included) at the start of this chunk; every token in
+    the chunk reports that offset. The engine emits one token per chunk,
+    so this is exact in practice — per-token decode lengths must NOT be
+    used here: the incremental detokenizer's emitted text differs from
+    the concatenation of single-token decodes (held UTF-8 bytes, jailed
+    stop prefixes), and offsets derived from it drift off the text."""
+    if not out.logprobs or not out.token_ids:
+        return None
+    tokens, t_lps, tops, offs = [], [], [], []
+    tops_list = out.top_logprobs or [{}] * len(out.token_ids)
+    for tid, lp, tp in zip(out.token_ids, out.logprobs, tops_list):
+        tokens.append(tokenizer.decode([int(tid)]))
+        t_lps.append(lp)
+        tops.append({tokenizer.decode([int(t)]): v
+                     for t, v in (tp or {}).items()})
+        offs.append(offset)
+    return {"tokens": tokens, "token_logprobs": t_lps,
+            "top_logprobs": tops, "text_offset": offs}

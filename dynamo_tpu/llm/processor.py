@@ -19,7 +19,7 @@ from ..runtime.engine import Context
 from .backend import Backend
 from .kv_router.router import KvRouter
 from .model_card import ModelDeploymentCard
-from .preprocessor import OpenAIPreprocessor
+from .preprocessor import completion_logprobs, OpenAIPreprocessor
 from .protocols.common import EngineOutput, PreprocessedRequest
 from .protocols.openai import (ChatCompletionRequest, CompletionRequest,
                                _finish_reason_openai)
@@ -103,25 +103,31 @@ class Processor:
         rid = f"cmpl-{context.id or uuid.uuid4().hex}"
         created = int(time.time())
         n_out = 0
+        text_off = 0
         if pre.output.echo_prompt:
             # OpenAI completions echo=true (same contract as the local
-            # chain, llm/engines.py)
+            # chain, llm/engines.py); offsets start after the prompt
+            echo_text = self.preprocessor.tokenizer.decode(
+                list(pre.token_ids))
+            text_off = len(echo_text)
             yield {"id": rid, "object": "text_completion",
                    "created": created, "model": request.model,
                    "choices": [{
-                       "index": 0,
-                       "text": self.preprocessor.tokenizer.decode(
-                           list(pre.token_ids)),
+                       "index": 0, "text": echo_text,
                        "finish_reason": None}]}
         async for out in backend.generate(pre, context):
             n_out += len(out.token_ids)
-            if out.text or out.finish_reason:
+            if out.text or out.finish_reason or out.logprobs:
+                choice = {"index": 0, "text": out.text or "",
+                          "finish_reason":
+                              _finish_reason_openai(out.finish_reason)}
+                lp = completion_logprobs(out, self.preprocessor.tokenizer, text_off)
+                if lp:
+                    choice["logprobs"] = lp
+                text_off += len(out.text or "")
                 yield {"id": rid, "object": "text_completion",
                        "created": created, "model": request.model,
-                       "choices": [{
-                           "index": 0, "text": out.text or "",
-                           "finish_reason":
-                               _finish_reason_openai(out.finish_reason)}]}
+                       "choices": [choice]}
             if out.finish_reason:
                 if request.stream_options and \
                         request.stream_options.include_usage:

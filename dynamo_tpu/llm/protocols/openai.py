@@ -244,6 +244,7 @@ class ChatAggregator:
         # keyed by choice index — n>1 streams interleave their chunks
         self.text_parts: Dict[int, List[str]] = {}
         self.finish_reason: Dict[int, str] = {}
+        self.lp_content: Dict[int, List[dict]] = {}
         self.usage: Optional[Usage] = None
 
     def add_chunk(self, chunk: ChatCompletionChunk) -> None:
@@ -251,6 +252,9 @@ class ChatAggregator:
             if choice.delta.content:
                 self.text_parts.setdefault(choice.index, []).append(
                     choice.delta.content)
+            if choice.logprobs and choice.logprobs.get("content"):
+                self.lp_content.setdefault(choice.index, []).extend(
+                    choice.logprobs["content"])
             if choice.finish_reason:
                 self.finish_reason[choice.index] = choice.finish_reason
         if chunk.usage is not None:
@@ -268,6 +272,8 @@ class ChatAggregator:
                 message=ChatMessage(
                     role="assistant",
                     content="".join(self.text_parts.get(i, []))),
+                logprobs=({"content": self.lp_content[i]}
+                          if i in self.lp_content else None),
                 finish_reason=self.finish_reason.get(i) or "stop")
                 for i in idxs],
             usage=self.usage)
@@ -280,12 +286,19 @@ class CompletionAggregator:
         self.created = int(time.time())
         self.text_parts: Dict[int, List[str]] = {}
         self.finish_reason: Dict[int, str] = {}
+        self.lp: Dict[int, dict] = {}
         self.usage: Optional[Usage] = None
 
     def add_text(self, text: str, finish_reason: Optional[str] = None,
-                 index: int = 0) -> None:
+                 index: int = 0, logprobs: Optional[dict] = None) -> None:
         if text:
             self.text_parts.setdefault(index, []).append(text)
+        if logprobs:
+            cur = self.lp.setdefault(index, {
+                "tokens": [], "token_logprobs": [], "top_logprobs": [],
+                "text_offset": []})
+            for k in cur:
+                cur[k].extend(logprobs.get(k) or [])
         if finish_reason:
             self.finish_reason[index] = finish_reason
 
@@ -295,6 +308,7 @@ class CompletionAggregator:
             id=self.id, created=self.created, model=self.model,
             choices=[CompletionChoice(
                 index=i, text="".join(self.text_parts.get(i, [])),
+                logprobs=self.lp.get(i),
                 finish_reason=_finish_reason_openai(
                     self.finish_reason.get(i)) or "stop")
                 for i in idxs],

@@ -749,7 +749,8 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
     move is keeping the sampling feedback loop on device.
 
     Signature matches engine._make_decode_multi's generic fallback."""
-    from ..engine.sampling import sample_tokens, update_penalty_state
+    from ..engine.sampling import (logprob_aux, sample_tokens,
+                                   update_penalty_state)
 
     inv_freq = rope_freqs(cfg)
     scale = cfg.attn_scale
@@ -775,11 +776,12 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
     use_pallas = (allow_pallas and (_use_pallas() or pallas_interpret)
                   and cfg.num_kv_heads % max(tp, 1) == 0)
 
-    @partial(jax.jit, static_argnames=("k_steps",),
+    @partial(jax.jit, static_argnames=("k_steps", "logprobs_topn"),
              donate_argnames=("kv_k", "kv_v"))
     def decode_window(params, tokens, positions, done, steps, remaining,
                       kv_k, kv_v, page_table, temperature, top_k, top_p,
-                      seeds, eos_table, penalties=None, *, k_steps: int):
+                      seeds, eos_table, penalties=None, *, k_steps: int,
+                      logprobs_topn: int = 0):
         B = tokens.shape[0]
         L = cfg.num_layers
         ps = kv_k.shape[3]
@@ -853,6 +855,7 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
 
         tok, pos = tokens, positions
         toks = []
+        lps, tvs, tis = [], [], []
         for i in range(k_steps):
             # frozen (done/pad) rows still flow through the matmuls — their
             # outputs are discarded and their KV never commits (commit mask
@@ -861,6 +864,9 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
             nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
                                 steps, max_top_k=max_top_k,
                                 penalties=penalties)
+            if logprobs_topn:
+                lp, tv, ti = logprob_aux(logits, nxt, logprobs_topn)
+                lps.append(lp); tvs.append(tv); tis.append(ti)
             penalties = update_penalty_state(penalties, nxt, done)
             tok, pos, done, steps, remaining = carry_step_update(
                 nxt, tok, pos, done, steps, remaining, eos_table)
@@ -878,8 +884,13 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
             flat, (cfg.num_layers,) + flat.shape))
         kv_v = jax.vmap(_scatter_pages)(kv_v, wv, jnp.broadcast_to(
             flat, (cfg.num_layers,) + flat.shape))
-        return (jnp.stack(toks, axis=1), (tok, pos, done, steps, remaining),
-                kv_k, kv_v)
+        out_toks = jnp.stack(toks, axis=1)
+        carry = (tok, pos, done, steps, remaining)
+        if logprobs_topn:
+            aux = (jnp.stack(lps, axis=1), jnp.stack(tvs, axis=1),
+                   jnp.stack(tis, axis=1))
+            return out_toks, aux, carry, kv_k, kv_v
+        return out_toks, carry, kv_k, kv_v
 
     return decode_window
 
