@@ -45,9 +45,10 @@ from ..models.config import ModelConfig
 from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
 from ..runtime.engine import Context
-from .kv_manager import PageManager, chain_hashes
+from .kv_manager import PageManager
 from .sampling import (SamplingBatch, logprob_aux, sample_tokens,
-                       update_penalty_state)
+                       update_penalty_state, verify_greedy_draft)
+from .spec_decode import propose_ngram_draft
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -115,6 +116,20 @@ class EngineConfig:
     # drain). None keeps pure prefill-priority. Overrides prefill_priority
     # when set.
     prefill_token_budget: Optional[int] = None
+    # self-speculative decoding: a host-side prompt-lookup drafter
+    # (engine/spec_decode.py) proposes up to spec_tokens candidates per
+    # greedy row from its own prompt+generated history; ONE batched
+    # [B, spec_tokens+1] verify forward checks them and the longest
+    # greedy-matching prefix (plus the bonus token) is accepted — 1..K+1
+    # tokens per dispatch. OFF by default so the compiled-program set
+    # (and the pipelined window path) is untouched; when on, the decode
+    # arm runs synchronously (the win is tokens-per-dispatch, not
+    # dispatch overlap). Non-greedy / penalty / logit_bias / logprobs
+    # rows transparently bypass speculation.
+    spec_decode: bool = False
+    spec_tokens: int = 4      # K: max draft tokens verified per step
+    spec_ngram_max: int = 4   # longest suffix n-gram the drafter matches
+    spec_ngram_min: int = 1   # shortest n-gram worth matching
     # on-device stop table width (eos_token_ids + stop_token_ids rows,
     # padded with -1); requests with more ids fall back to the (lagging
     # but correct) host-side check
@@ -140,6 +155,10 @@ class EngineConfig:
                 f"prefill_chunk ({self.prefill_chunk}) must be a multiple "
                 f"of page_size ({self.page_size}): chunk starts must stay "
                 f"page-aligned for the page-granular KV commit")
+        if self.spec_decode and self.spec_tokens < 1:
+            raise ValueError(
+                f"spec_tokens ({self.spec_tokens}) must be >= 1 when "
+                f"spec_decode is enabled")
 
     @staticmethod
     def _pick(buckets: Tuple[int, ...], n: int) -> int:
@@ -278,6 +297,21 @@ class JaxEngine:
         else:
             self.decode_multi_fn = _make_decode_multi(
                 model, model_cfg, self.ecfg.max_top_k, mesh=mesh)
+        # self-speculative decode: the [B, K+1] verify forward (only
+        # built — and only warmed — when the flag is on, so the default
+        # compiled-program set is untouched). Model families without a
+        # verify fn (MLA's latent cache) silently keep the standard path.
+        self.verify_fn = None
+        if self.ecfg.spec_decode:
+            if hasattr(model, "make_verify_fn"):
+                self.verify_fn = model.make_verify_fn(model_cfg, mesh=mesh)
+            else:
+                log.warning("spec_decode enabled but %s has no "
+                            "make_verify_fn; speculation disabled",
+                            model.__name__)
+        self.spec_steps = 0
+        self.spec_draft_tokens_total = 0
+        self.spec_accepted_tokens_total = 0
         # sequence-parallel long-prefill (ring attention over the mesh's
         # "seq" axis) — the serving wire-up of parallel/ring_attention.py
         # (r2 built it but nothing reached it; VERDICT r2 missing #5)
@@ -441,6 +475,20 @@ class JaxEngine:
                                   jnp.ones(B), jnp.zeros(B, jnp.uint32),
                                   jnp.zeros(B, jnp.int32),
                                   max_top_k=ecfg.max_top_k)
+                if self.verify_fn is not None:
+                    # speculative verify grid: one [B, K+1] program per
+                    # (B, P) bucket + the accept-mask program per B
+                    Kv = ecfg.spec_tokens + 1
+                    logits, self.kv_k, self.kv_v = self.verify_fn(
+                        self.params, jnp.zeros((B, Kv), jnp.int32),
+                        jnp.zeros((B, Kv), jnp.int32) - 1, self.kv_k,
+                        self.kv_v, tableB,
+                        jnp.full((B, Kv), DROP_SLOT, jnp.int32))
+                    verify_greedy_draft(logits,
+                                        jnp.zeros((B, Kv - 1), jnp.int32),
+                                        jnp.zeros(B, jnp.int32),
+                                        max_top_k=ecfg.max_top_k)
+                    n += 1
                 n += 1
                 if progress:
                     print(f"warmup: {n} programs, {time.monotonic()-t0:.0f}s",
@@ -540,6 +588,20 @@ class JaxEngine:
             "host_offload_pages_total": self.offload_pages_total,
             "host_restore_pages_total": self.restore_pages_total,
             "long_prefills_total": self.long_prefills_total,
+            # speculative decode observability: acceptance rate is
+            # accepted/drafted (drafter quality); mean accepted length is
+            # accepted drafts per verify step (tokens-per-dispatch gain —
+            # each step also emits its bonus token on top)
+            "spec_decode_steps": self.spec_steps,
+            "spec_decode_draft_tokens_total": self.spec_draft_tokens_total,
+            "spec_decode_accepted_tokens_total":
+                self.spec_accepted_tokens_total,
+            "spec_decode_acceptance_rate":
+                (self.spec_accepted_tokens_total /
+                 max(self.spec_draft_tokens_total, 1)),
+            "spec_decode_mean_accepted_len":
+                (self.spec_accepted_tokens_total /
+                 max(self.spec_steps, 1)),
         }
 
     # ------------------------------------------------------- scheduler loop
@@ -576,6 +638,9 @@ class JaxEngine:
         device compute. Unpipelined modes keep the reference-equivalent
         prefill-priority ordering."""
         self._drain_kv_tier()
+        if self.verify_fn is not None:
+            self._step_spec()
+            return
         if self.ecfg.decode_steps <= 1:
             # single-step decode: fully synchronous; budgeted mixing
             # interleaves a decode step behind the trimmed prefill batch
@@ -1080,9 +1145,13 @@ class JaxEngine:
         # poisoning the host tier with spliced pages
         self._drain_kv_tier()
 
-    def _decode_step_single(self) -> None:
-        """K=1 decode: one forward + sample per dispatch, synchronous."""
-        batch = [s for s in self.running if s.finished is None]
+    def _decode_step_single(self, batch: Optional[List[Sequence]] = None
+                            ) -> None:
+        """K=1 decode: one forward + sample per dispatch, synchronous.
+        ``batch`` restricts the step to a subset of running rows (the
+        spec-decode fallback arm); None takes every running row."""
+        if batch is None:
+            batch = [s for s in self.running if s.finished is None]
         batch = batch[: self.ecfg.max_batch]
         for seq in list(batch):
             if seq.context.stopped:
@@ -1120,17 +1189,163 @@ class JaxEngine:
             self._append_token(seq, int(tok),
                                lp=self._lp_entry(seq, aux, i))
 
-    def _dispatch_decode_window(self) -> Optional[_PendingWindow]:
-        """Enqueue the next fused K-step decode window WITHOUT reading
-        back. Rows carried over from the in-flight window take their
-        (token, position, done, step, budget) state from the on-device
-        carry — the host's lagging view never enters the feedback loop —
-        while newly admitted rows are seeded from host state."""
-        K = self.ecfg.decode_steps
+    # -------------------------------------------------- speculative decode
+
+    def _step_spec(self) -> None:
+        """Scheduler iteration with self-speculative decoding enabled.
+
+        Synchronous (no cross-iteration pipelining): the speculative win
+        is up to K+1 tokens per dispatch, not dispatch overlap — and the
+        drafter reads host token lists every step, so they must be
+        exact. Prefill keeps its existing policies (priority or budgeted
+        mixing). Rows whose drafter finds a candidate continuation take
+        the batched verify step; everything else — no draft found,
+        non-greedy sampling, penalties, logit_bias, logprobs — falls
+        back to the standard fused-window/single-token dispatch."""
+        budget = self.ecfg.prefill_token_budget
+        if self.prefilling:
+            pf = self._dispatch_prefill(budget)
+            if pf is not None:
+                self._process_prefill(pf)
+        if self.prefilling and budget is None and self.ecfg.prefill_priority:
+            return
         for seq in list(self.running):
             if seq.context.stopped:
                 self._terminate(seq, FINISH_CANCELLED)
         batch = [s for s in self.running if s.finished is None]
+        batch = batch[: self.ecfg.max_batch]
+        if not batch:
+            return
+        if self.prefilling:
+            self.mixed_dispatches += 1
+        spec_rows: List[Sequence] = []
+        drafts: Dict[int, List[int]] = {}
+        rest: List[Sequence] = []
+        for seq in batch:
+            d = self._draft_for(seq)
+            if d:
+                spec_rows.append(seq)
+                drafts[id(seq)] = d
+            else:
+                rest.append(seq)
+        if spec_rows:
+            self._decode_step_spec(spec_rows, drafts)
+        # the spec step's pool-pressure preemption can evict rows parked
+        # in `rest` (they lose their pages and requeue) — never dispatch
+        # a row the scheduler no longer runs
+        rest = [s for s in rest if s in self.running]
+        if rest:
+            if self.ecfg.decode_steps > 1:
+                pend = self._dispatch_decode_window(batch=rest)
+                if pend is not None:
+                    self._process_window(pend)
+            else:
+                self._decode_step_single(batch=rest)
+        self._drain_deferred()
+
+    def _draft_for(self, seq: Sequence) -> List[int]:
+        """Prompt-lookup draft for one row, or [] when the row bypasses
+        speculation. Bypass covers exactly the semantics a greedy
+        multi-token verify cannot reproduce: sampled rows (temperature),
+        count-state penalties and logit_bias (their logits depend on
+        tokens accepted earlier in the SAME step), and logprobs requests
+        (the verify path returns no per-token aux)."""
+        s = seq.req.sampling
+        if (not s.greedy or _wants_count_state(s)
+                or getattr(s, "logit_bias", None)
+                or seq.req.output.logprobs is not None):
+            return []
+        # clamp the draft so even a full accept (K drafts + bonus) stays
+        # inside the row's token budget and the warmed grid capacity
+        k = min(self.ecfg.spec_tokens,
+                seq.max_new() - seq.generated - 1,
+                self.cap_tokens - len(seq.tokens) - 1)
+        if k <= 0:
+            return []
+        return propose_ngram_draft(seq.tokens, k, self.ecfg.spec_ngram_max,
+                                   self.ecfg.spec_ngram_min)
+
+    def _decode_step_spec(self, batch: List[Sequence],
+                          drafts: Dict[int, List[int]]) -> None:
+        """One batched multi-token verify: each row's input is [pending
+        decode token, draft...], every input's KV scatters into its page
+        slot during the forward, and the vectorized greedy accept-mask
+        keeps the longest matching draft prefix plus the bonus token.
+
+        Rejected drafts leave junk KV past each row's accepted extent.
+        That is safe by the engine's standing invariants: causal masking
+        hides positions beyond any query's own position, a slot is
+        rewritten when its position's REAL token becomes the decode
+        input (before anything attends to it), and page commits only
+        ever publish positions strictly behind the newest token."""
+        K = self.ecfg.spec_tokens
+        # page coverage for every potential write this step (positions
+        # through len(tokens)-1+K) plus the next pending token's slot
+        self._grow_or_preempt(batch, K + 1)
+        batch = [s for s in batch
+                 if s.finished is None and not s.context.stopped]
+        if not batch:
+            return
+        B = self.ecfg.bucket_batch(len(batch))
+        P = self.ecfg.bucket_pages(max(len(s.pages) for s in batch))
+        T = K + 1
+        ps = self.ecfg.page_size
+        tokens = np.zeros((B, T), np.int32)
+        positions = np.full((B, T), -1, np.int32)
+        table = np.zeros((B, P), np.int32)
+        slots = np.full((B, T), DROP_SLOT, np.int32)
+        draft_arr = np.zeros((B, K), np.int32)
+        draft_len = np.zeros(B, np.int32)
+        for i, seq in enumerate(batch):
+            d = drafts[id(seq)][:K]
+            n = len(d)
+            pos0 = len(seq.tokens) - 1  # position of the pending token
+            tokens[i, :n + 1] = [seq.last_token] + d
+            pr = np.arange(pos0, pos0 + n + 1)
+            positions[i, :n + 1] = pr
+            table[i, :len(seq.pages)] = seq.pages
+            pages = np.asarray(seq.pages, np.int64)
+            slots[i, :n + 1] = pages[pr // ps] * ps + pr % ps
+            draft_arr[i, :n] = d
+            draft_len[i] = n
+        logits, self.kv_k, self.kv_v = self.verify_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots))
+        out_d, acc_d = verify_greedy_draft(
+            logits, jnp.asarray(draft_arr), jnp.asarray(draft_len),
+            max_top_k=self.ecfg.max_top_k)
+        out = np.asarray(out_d)  # host sync — the spec arm is synchronous
+        acc = np.asarray(acc_d)
+        self.steps += 1
+        self.spec_steps += 1
+        for i, seq in enumerate(batch):
+            accepted = int(acc[i])
+            self.spec_draft_tokens_total += int(draft_len[i])
+            self.spec_accepted_tokens_total += accepted
+            for j in range(accepted + 1):
+                if seq.finished is not None or seq.context.stopped:
+                    break  # tokens past an accepted stop are discarded
+                self._append_token(seq, int(out[i, j]))
+                self.decode_tokens_total += 1
+
+    def _dispatch_decode_window(self, batch: Optional[List[Sequence]] = None
+                                ) -> Optional[_PendingWindow]:
+        """Enqueue the next fused K-step decode window WITHOUT reading
+        back. Rows carried over from the in-flight window take their
+        (token, position, done, step, budget) state from the on-device
+        carry — the host's lagging view never enters the feedback loop —
+        while newly admitted rows are seeded from host state. ``batch``
+        restricts the window to a subset of running rows (the spec-decode
+        fallback arm, which has already swept cancellations)."""
+        K = self.ecfg.decode_steps
+        if batch is None:
+            for seq in list(self.running):
+                if seq.context.stopped:
+                    self._terminate(seq, FINISH_CANCELLED)
+            batch = [s for s in self.running if s.finished is None]
+        else:
+            batch = [s for s in batch if s.finished is None
+                     and not s.context.stopped]
         # submit_prefilled can push running past max_batch; overflow rows
         # simply wait a round (arrays below are sized ≤ max_batch)
         batch = batch[: self.ecfg.max_batch]
@@ -1396,11 +1611,11 @@ class JaxEngine:
         filled = len(seq.tokens)
         ps = self.ecfg.page_size
         if (filled - 1) >= ps and (filled - 1) % ps == 0:
-            nblocks = (filled - 1) // ps  # pages fully written
-            hashes = chain_hashes(seq.tokens[:nblocks * ps], ps)
-            parent = hashes[-2] if nblocks >= 2 else None
-            self.pm.commit(seq.pages[nblocks - 1], hashes[-1],
-                           parent_hash=parent)
+            # multi-token publish (commit() dedups the already-published
+            # blocks): speculative accepts can append several tokens
+            # between boundary checks, so commit everything the extent
+            # covers, not just the newest block
+            self.pm.commit_chain(seq.pages, seq.tokens, filled - 1)
         if eos:
             self._terminate(seq, FINISH_EOS)
         elif (seq.generated >= seq.max_new()
@@ -1423,13 +1638,7 @@ class JaxEngine:
         self._release_or_defer(seq)
 
     def _commit_full_pages(self, seq: Sequence) -> None:
-        ps = self.ecfg.page_size
-        nblocks = seq.prefill_extent // ps
-        hashes = chain_hashes(seq.tokens[:nblocks * ps], ps)
-        for i, h in enumerate(hashes):
-            self.pm.commit(seq.pages[i], h,
-                           parent_hash=hashes[i - 1] if i else None,
-                           token_ids=seq.tokens[i * ps:(i + 1) * ps])
+        self.pm.commit_chain(seq.pages, seq.tokens, seq.prefill_extent)
 
     def _release(self, seq: Sequence) -> None:
         if seq.hold_pages:

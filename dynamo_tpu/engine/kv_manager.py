@@ -266,6 +266,25 @@ class PageManager:
                                    parent_hash=parent_hash,
                                    token_ids=token_ids))
 
+    def commit_chain(self, pages: List[int], token_ids: Sequence[int],
+                     extent: int) -> int:
+        """Commit every FULL block covered by ``token_ids[:extent]`` in
+        one call — the multi-token publish path. Prefill completion,
+        decode-window boundary crossings, and speculative accepts (which
+        can advance a sequence K+1 tokens — several page boundaries — in
+        ONE step) all funnel through here so the chained-hash bookkeeping
+        lives in one place. Idempotent per block (:meth:`commit` dedups
+        on hash); returns the number of full blocks covered."""
+        nblocks = extent // self.page_size
+        hashes = chain_hashes(token_ids[:nblocks * self.page_size],
+                              self.page_size)
+        for i, h in enumerate(hashes):
+            self.commit(pages[i], h,
+                        parent_hash=hashes[i - 1] if i else None,
+                        token_ids=list(token_ids[i * self.page_size:
+                                                 (i + 1) * self.page_size]))
+        return nblocks
+
     def release_sequence(self, pages: List[int]) -> None:
         """Drop one reference on each page; refcount-0 pages become reusable
         (kept for prefix hits) or free (uncommitted)."""

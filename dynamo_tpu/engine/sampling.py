@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
+from typing import Tuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -162,6 +164,47 @@ def sample_tokens(logits: jax.Array, temperature: jax.Array,
 
     sampled = jax.vmap(row_sample)(jnp.arange(B))
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("max_top_k",))
+def verify_greedy_draft(logits: jax.Array, draft: jax.Array,
+                        draft_len: jax.Array, max_top_k: int = 64
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Vectorized accept-mask + bonus-token draw for self-speculative
+    decode (greedy rows only — the engine bypasses speculation for
+    sampled/penalized/logprobs requests).
+
+    logits: [B, K+1, V] from the multi-token verify forward, where
+    position j's logits predict the token AFTER input j (input 0 is the
+    row's pending decode token, inputs 1..K the draft); draft: [B, K];
+    draft_len: [B] valid draft tokens per row (rows ride with shorter —
+    or padded-empty — drafts in the same static program).
+
+    Returns (out_tokens [B, K+1], accepted [B]): row i emits
+    ``out_tokens[i, :accepted[i] + 1]`` — the accepted draft prefix plus
+    the bonus token greedily drawn at the first divergent (or final)
+    position; entries past that are -1.
+
+    The greedy target is computed exactly as :func:`sample_tokens`'
+    greedy arm (``lax.top_k`` first element over the temperature-1
+    logits), so speculation on/off is token-identical by construction,
+    tie-breaking included.
+    """
+    B, K1, V = logits.shape
+    K = K1 - 1
+    _, k_idx = jax.lax.top_k(logits.reshape(B * K1, V), max_top_k)
+    greedy = k_idx[:, 0].reshape(B, K1).astype(jnp.int32)
+    match = jnp.logical_and(draft == greedy[:, :K],
+                            jnp.arange(K)[None, :] < draft_len[:, None])
+    # longest all-true prefix: cumprod zeroes everything past a miss
+    accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    bonus = jnp.take_along_axis(greedy, accepted[:, None], axis=1)
+    steps = jnp.arange(K1)[None, :]
+    draft_ext = jnp.concatenate(
+        [draft.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1)
+    out = jnp.where(steps < accepted[:, None], draft_ext,
+                    jnp.where(steps == accepted[:, None], bonus, -1))
+    return out.astype(jnp.int32), accepted
 
 
 def _gather_rows(logp: jax.Array, chosen: jax.Array) -> jax.Array:

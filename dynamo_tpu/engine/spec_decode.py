@@ -1,0 +1,66 @@
+"""Self-speculative decoding: model-free prompt-lookup drafting.
+
+The decode loop is memory-bandwidth-bound — one token per device step
+leaves the MXU idle between HBM sweeps. Speculative decoding (Leviathan
+et al. 2023) converts that slack into accepted tokens: a cheap drafter
+proposes K candidates, ONE batched multi-token forward verifies them
+against the target model, and the longest matching prefix (plus the
+"bonus" token from the first divergent position) is accepted — every
+step emits between 1 and K+1 tokens for roughly the cost of one.
+
+The drafter here is the model-free prompt-lookup scheme (Saxena 2023,
+"Prompt Lookup Decoding"): the sequence's OWN history (prompt +
+generated tokens) is the draft model. The longest suffix n-gram that
+also occurs earlier in the history predicts its historical continuation.
+This costs no second model, no extra HBM, and shines exactly where
+serving workloads repeat themselves — code, RAG quotes, multi-turn
+summaries, JSON schemas.
+
+Host-side by design: the lookup is a few-microsecond numpy scan per
+sequence per step, and keeping it on the host means the device program
+set stays a single static-[B, K+1] verify forward (see
+models/llama.py:make_verify_fn and jax_engine._decode_step_spec).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def propose_ngram_draft(tokens: Sequence[int], max_draft: int,
+                        ngram_max: int, ngram_min: int = 1) -> List[int]:
+    """Propose up to ``max_draft`` tokens continuing ``tokens``.
+
+    Matches the longest suffix n-gram (``ngram_max`` down to
+    ``ngram_min`` tokens, the last of which is the pending decode input)
+    against every earlier position in the history. Among the hits, the
+    MOST RECENT one that can supply a full ``max_draft``-token
+    continuation wins — recency because generation loops continue their
+    latest cycle, fullness because short-period loops (the common greedy
+    cycle) would otherwise always truncate the draft to the period
+    length. Returns [] when nothing matches (the caller falls back to
+    the standard decode path for this row).
+    """
+    L = len(tokens)
+    if max_draft <= 0 or L < ngram_min + 1:
+        return []
+    arr = np.asarray(tokens, dtype=np.int64)
+    for n in range(min(ngram_max, L - 1), max(ngram_min, 1) - 1, -1):
+        pat = arr[L - n:]
+        # candidate starts 0..L-1-n: strictly earlier than the suffix
+        # itself, but allowed to overlap it (self-periodic continuations)
+        hay = np.lib.stride_tricks.sliding_window_view(arr[:L - 1], n)
+        hits = np.nonzero((hay == pat).all(axis=1))[0]
+        if hits.size == 0:
+            continue
+        avail = (L - hits) - n  # continuation tokens before history ends
+        full = hits[avail >= max_draft]
+        # hits ascend, so avail descends: argmax picks the longest
+        # continuation when no hit can fill the whole draft
+        start = int(full[-1]) if full.size else int(hits[np.argmax(avail)])
+        follow = arr[start + n:start + n + max_draft]
+        if follow.size:
+            return [int(t) for t in follow]
+    return []

@@ -277,7 +277,9 @@ class Reconciler:
         st = job.get("status") or {}
         conds = {c.get("type"): c.get("status")
                  for c in st.get("conditions") or []}
-        if conds.get("Complete") == "True" or st.get("succeeded", 0) >= 1:
+        # `or 0`, not a .get default: the API server can report an
+        # explicit `"succeeded": null`, which .get passes through
+        if conds.get("Complete") == "True" or (st.get("succeeded") or 0) >= 1:
             phase = "Ready"
         elif conds.get("Failed") == "True":
             phase = "Failed"

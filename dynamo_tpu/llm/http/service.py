@@ -273,8 +273,11 @@ async def _fanout_choices(engine, req, ctx: Context, n: int):
     DONE = object()
     kids = [Context(f"{ctx.id}-c{i}") for i in range(n)]
     # ONE stream identity: OpenAI streaming semantics give all chunks of
-    # a response a single id/created, choices distinguished by index
-    stream_id = f"chatcmpl-{_uuid.uuid4().hex}"
+    # a response a single id/created, choices distinguished by index.
+    # The id PREFIX is derived from the first child chunk that carries
+    # one ("cmpl-..." for completions, "chatcmpl-..." for chat) so n>1
+    # completions streams keep their endpoint's id shape.
+    stream_id = None
     created = int(_time.time())
 
     def child_req(i):
@@ -328,6 +331,12 @@ async def _fanout_choices(engine, req, ctx: Context, n: int):
                 if not _chunk_choices(item):
                     continue  # usage-only chunk: held back entirely
                 item = _strip_usage(item)
+            if stream_id is None:
+                cid = _chunk_id(item)
+                if cid is not None:
+                    prefix = cid.split("-", 1)[0] if "-" in cid \
+                        else "chatcmpl"
+                    stream_id = f"{prefix}-{_uuid.uuid4().hex}"
             yield _reindex(item, i, stream_id, created)
         if merged_usage is not None and usage_template is not None:
             yield _reindex(_set_usage(usage_template, merged_usage),
@@ -350,6 +359,13 @@ def _chunk_usage(chunk):
         return t.get("usage")
     u = getattr(t, "usage", None)
     return u.model_dump() if u is not None else None
+
+
+def _chunk_id(chunk):
+    t = _chunk_target(chunk)
+    if isinstance(t, dict):
+        return t.get("id")
+    return getattr(t, "id", None)
 
 
 def _chunk_choices(chunk):

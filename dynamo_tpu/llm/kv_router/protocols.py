@@ -25,6 +25,10 @@ class ForwardPassMetrics:
     num_requests_waiting: int = 0
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hit_rate: float = 0.0
+    # self-speculative decoding observability (engine/spec_decode.py):
+    # accepted/drafted tokens, and accepted drafts per verify step
+    spec_decode_acceptance_rate: float = 0.0
+    spec_decode_mean_accepted_len: float = 0.0
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)

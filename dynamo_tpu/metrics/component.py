@@ -116,6 +116,12 @@ class MetricsAggregator:
              lambda m: m.gpu_cache_usage_perc),
             ("dyn_worker_prefix_cache_hit_rate", "engine prefix hit rate",
              lambda m: m.gpu_prefix_cache_hit_rate),
+            ("dyn_worker_spec_decode_acceptance_rate",
+             "speculative-draft tokens accepted / drafted",
+             lambda m: m.spec_decode_acceptance_rate),
+            ("dyn_worker_spec_decode_mean_accepted_len",
+             "mean accepted draft length per verify step",
+             lambda m: m.spec_decode_mean_accepted_len),
         ]
         for name, help_, get in per_worker:
             rows = [

@@ -96,7 +96,22 @@ def fetch_model_cli(argv) -> int:
     src = resolve_model(args.model_id, revision=args.revision)
     os.makedirs(args.dest, exist_ok=True)
     open(marker, "w").close()
-    shutil.copytree(src, args.dest, dirs_exist_ok=True)
+    if os.path.realpath(src) != os.path.realpath(args.dest):
+        # a changed modelId/revision re-seeds over a destination that may
+        # still hold the OLD checkpoint's shards — copytree(dirs_exist_ok)
+        # alone would leave stale files (e.g. extra safetensors shards)
+        # mixed into the new one. Clear everything but the in-progress
+        # marker first; the stamp only lands after a complete copy, so an
+        # interrupted clear+copy stays "not done" and re-runs.
+        for entry in os.listdir(args.dest):
+            if entry == os.path.basename(marker):
+                continue
+            path = os.path.join(args.dest, entry)
+            if os.path.isdir(path) and not os.path.islink(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+        shutil.copytree(src, args.dest, dirs_exist_ok=True)
     with open(stamp, "w") as f:
         json.dump(want, f)
     os.unlink(marker)

@@ -699,6 +699,39 @@ def make_step_fns(cfg: ModelConfig, allow_pallas: bool = True, mesh=None):
     return prefill_step, decode_step
 
 
+def make_verify_fn(cfg: ModelConfig, allow_pallas: bool = True, mesh=None):
+    """Speculative-verify forward: ONE [B, K+1] multi-token decode step
+    against the paged pool, returning logits at EVERY position (unlike
+    prefill_step's last-position gather — the accept mask needs the
+    greedy target after each draft token).
+
+    Reuses the chunked-prefill program shape exactly: the K+1 input
+    tokens' K/V scatter into their page slots before attention, and the
+    causal position mask lets draft token j attend to drafts 0..j-1 plus
+    the whole cached sequence. K is static (one compile per batch/page
+    bucket), so the verify grid stays as bounded as the decode grid.
+
+    Rejected drafts leave their K/V in slots PAST the row's accepted
+    extent — harmless by the same invariant that protects prefill tail
+    pages: a position's K/V is always rewritten when its real token is
+    the decode input, before any query can see it (causal masking hides
+    positions beyond the current query, and pages only publish to the
+    prefix cache once every slot holds accepted content)."""
+
+    @partial(jax.jit, donate_argnames=("kv_k", "kv_v"))
+    def verify_step(params: Params, tokens: jax.Array, positions: jax.Array,
+                    kv_k: jax.Array, kv_v: jax.Array, page_table: jax.Array,
+                    flat_slots: jax.Array):
+        """tokens/positions/flat_slots: [B, K+1] (-1 / DROP_SLOT padding)
+        → (logits [B, K+1, V] float32, kv_k, kv_v)."""
+        h, kv_k2, kv_v2 = forward(params, cfg, tokens, positions, kv_k,
+                                  kv_v, page_table, flat_slots,
+                                  allow_pallas=allow_pallas, mesh=mesh)
+        return project_logits(params, cfg, h), kv_k2, kv_v2
+
+    return verify_step
+
+
 # ------------------------------------------------- fused decode window
 
 

@@ -70,6 +70,13 @@ def parse_args(argv=None):
     ap.add_argument("--sequence-parallel-size", type=int, default=1,
                     help="seq-axis mesh size for ring-attention long "
                          "prefill (long-context serving)")
+    ap.add_argument("--spec-decode", action="store_true",
+                    help="self-speculative decoding: prompt-lookup drafts "
+                         "verified in one [B, K+1] forward; greedy rows "
+                         "only (token-identical), others bypass "
+                         "(docs/serve.md 'Speculative decoding')")
+    ap.add_argument("--spec-tokens", type=int, default=4,
+                    help="max draft tokens verified per step (K)")
     ap.add_argument("--prefill-token-budget", type=int, default=None,
                     help="cap prompt tokens prefilled per engine "
                          "iteration and interleave decode windows "
@@ -197,6 +204,9 @@ def build_engine(args) -> Tuple[object, object, bool]:
             overrides["max_batch"] = args.max_batch_size
         if args.prefill_token_budget is not None:
             overrides["prefill_token_budget"] = args.prefill_token_budget
+        if args.spec_decode:
+            overrides["spec_decode"] = True
+            overrides["spec_tokens"] = args.spec_tokens
         if overrides:
             # replace() re-runs __post_init__ — CLI overrides get the same
             # validation as direct construction

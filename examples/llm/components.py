@@ -41,7 +41,9 @@ def _build_engine(cfg: dict):
                             max_batch=8, prefill_chunk=64,
                             prefill_buckets=(64,), batch_buckets=(8,),
                             page_buckets=(16,),
-                            host_pages=cfg.get("host_pages", 0))
+                            host_pages=cfg.get("host_pages", 0),
+                            spec_decode=cfg.get("spec_decode", False),
+                            spec_tokens=cfg.get("spec_tokens", 4))
         mdc = ModelDeploymentCard(name=cfg.get("served_model_name", "tiny"),
                                   kv_block_size=ecfg.page_size)
     else:
@@ -51,7 +53,9 @@ def _build_engine(cfg: dict):
         ecfg = EngineConfig(page_size=cfg.get("kv_block_size", 64),
                             num_pages=cfg.get("num_pages", 2048),
                             max_batch=cfg.get("max_batch", 32),
-                            host_pages=cfg.get("host_pages", 0))
+                            host_pages=cfg.get("host_pages", 0),
+                            spec_decode=cfg.get("spec_decode", False),
+                            spec_tokens=cfg.get("spec_tokens", 4))
         mdc = ModelDeploymentCard.from_local_path(
             model, name=cfg.get("served_model_name"))
         mdc.kv_block_size = ecfg.page_size
