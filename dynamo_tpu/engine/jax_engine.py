@@ -1722,23 +1722,70 @@ class JaxEngine:
 
         await loop.run_in_executor(self._exec, _do)
 
-    async def extract_pages(self, page_ids: List[int]
+    async def extract_pages(self, page_ids: List[int], *,
+                            drain: bool = True
                             ) -> Tuple[np.ndarray, np.ndarray]:
         """Gather KV pages to host memory: returns (k, v) arrays of shape
         [L, n, KV, page_size, hd] (kv-head-major pool layout). Serialized
         with engine steps on the single-worker executor so it never races
-        buffer donation."""
+        buffer donation. ``drain=False`` skips the host-tier drain — safe
+        only for follow-up ranged extracts of a request whose first
+        extract already drained (the streaming transfer plane)."""
         loop = asyncio.get_running_loop()
 
         def _do():
             # restored pages must be resident first (full: the chunked
             # drain could leave some queued)
-            self._drain_kv_tier(full=True)
+            if drain:
+                self._drain_kv_tier(full=True)
             idx = jnp.asarray(page_ids, jnp.int32)
             return (np.asarray(self.kv_k[:, idx]),
                     np.asarray(self.kv_v[:, idx]))
 
         return await loop.run_in_executor(self._exec, _do)
+
+    async def extract_pages_chunked(self, page_ids: List[int],
+                                    chunk_pages: int):
+        """Ranged/async extract for the streaming transfer plane: yields
+        ``(offset, k, v, seconds)`` per ``chunk_pages``-sized slice of
+        ``page_ids``. The device gather + D2H copy for slice i+1 is
+        dispatched (``copy_to_host_async``) before slice i's host sync
+        completes, so the device→host stage of the next chunk runs under
+        whatever the consumer does with the current one (compress, socket
+        write). ``seconds`` is the blocking time this chunk cost — the
+        extract-stage figure for the transfer breakdown."""
+        loop = asyncio.get_running_loop()
+        cp = max(int(chunk_pages), 1)
+        slices = [page_ids[i:i + cp] for i in range(0, len(page_ids), cp)]
+
+        def _gather(ids, first):
+            if first:
+                self._drain_kv_tier(full=True)
+            idx = jnp.asarray(ids, jnp.int32)
+            kg, vg = self.kv_k[:, idx], self.kv_v[:, idx]
+            for a in (kg, vg):
+                if hasattr(a, "copy_to_host_async"):
+                    a.copy_to_host_async()
+            return kg, vg
+
+        def _host(kg, vg):
+            return np.asarray(kg), np.asarray(vg)
+
+        if not slices:
+            return
+        t0 = time.monotonic()
+        pending = await loop.run_in_executor(self._exec, _gather,
+                                             slices[0], True)
+        for i in range(len(slices)):
+            nxt = (loop.run_in_executor(self._exec, _gather,
+                                        slices[i + 1], False)
+                   if i + 1 < len(slices) else None)
+            k, v = await loop.run_in_executor(self._exec, _host, *pending)
+            dt = time.monotonic() - t0
+            yield i * cp, k, v, dt
+            t0 = time.monotonic()
+            if nxt is not None:
+                pending = await nxt
 
     async def inject_pages(self, page_ids: List[int], k: np.ndarray,
                            v: np.ndarray) -> None:

@@ -17,10 +17,10 @@ from .prefill_worker import PrefillWorker
 from .protocols import RemotePrefillRequest
 from .queue import PrefillQueue
 from .router import DisaggRouter
-from .transfer import KvTransferClient, KvTransferServer
+from .transfer import KvTransferClient, KvTransferServer, TransferStats
 
 __all__ = [
     "DisaggDecodeEngine", "DisaggRouter", "KvTransferClient",
     "KvTransferServer", "PrefillQueue", "PrefillWorker",
-    "RemotePrefillRequest",
+    "RemotePrefillRequest", "TransferStats",
 ]

@@ -56,7 +56,12 @@ class DisaggDecodeEngine:
         s.update(remote_prefills=self.remote_prefills,
                  local_prefills=self.local_prefills,
                  remote_fallbacks=self.remote_fallbacks,
-                 remote_wait_total_s=round(self.remote_wait_total_s, 3))
+                 remote_wait_total_s=round(self.remote_wait_total_s, 3),
+                 remote_prefill_wait_seconds_total=round(
+                     self.remote_wait_total_s, 3))
+        # transfer-plane ingest counters (streaming chunk pipeline) — fed
+        # into ForwardPassMetrics for the Prometheus gauges
+        s.update(self.transfer.stats())
         return s
 
     async def generate(self, request, context: Context
@@ -147,8 +152,13 @@ class DisaggDecodeEngine:
             # generate()'s finally releases the reserved pages
             self.transfer.cancel(context.id)
             raise
-        except Exception:  # noqa: BLE001
-            log.exception("remote prefill failed for %s", context.id)
+        except Exception as exc:  # noqa: BLE001
+            # a failed stream sets this exception on the waiter the moment
+            # the transfer plane knows (ingest error, sender abort, conn
+            # drop) — falling back NOW instead of idling out the full
+            # prefill_timeout
+            log.warning("remote prefill failed for %s (%s); falling back "
+                        "to local", context.id, exc)
             self.transfer.cancel(context.id)
             return None
 

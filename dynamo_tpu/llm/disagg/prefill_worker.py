@@ -1,10 +1,13 @@
-"""The prefill worker: pull queue → prefill → push KV pages.
+"""The prefill worker: pull queue → prefill → stream KV pages.
 
 Reference examples/llm/components/prefill_worker.py:37-141: pulls the
 JetStream prefill queue, lazily fetches the decode engine's NIXL metadata
 from etcd on first contact, runs a max_tokens=1 generate, and RDMA-writes
 the computed blocks into decode VRAM. Here: DCP work queue, DCP-stored TCP
-endpoints, engine.prefill_only + extract_pages, TwoPartCodec page push.
+endpoints, engine.prefill_only + a chunked extract→compress→send pipeline
+(transfer.py streaming protocol) so the device→host extract of chunk i+1
+overlaps the socket write of chunk i — decode-side TTFT stops being the
+sum of prefill + extract + wire + inject.
 
 Elastic xPyD: any number of prefill workers pull the one shared queue;
 joining/leaving needs no coordination (docs/disagg_serving.md:93-100).
@@ -15,22 +18,28 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
-from typing import Dict, Optional, Set
+import time
+from typing import Dict, List, Optional, Set
+
+import numpy as np
 
 from ...runtime.engine import Context
 from ..protocols.common import (PreprocessedRequest, SamplingOptions,
                                 StopConditions)
 from .protocols import RemotePrefillRequest
 from .queue import PrefillQueue
-from .transfer import KvTransferClient
+from .transfer import KvTransferClient, TransferStats
 
 log = logging.getLogger("dynamo_tpu.llm.disagg")
+
+DEFAULT_CHUNK_PAGES = 4
 
 
 class PrefillWorker:
     def __init__(self, drt, engine, *, namespace: str = "dynamo",
                  max_inflight: int = 4,
-                 compress_kv: Optional[bool] = None):
+                 compress_kv: Optional[bool] = None,
+                 chunk_pages: Optional[int] = None):
         import os
 
         self.drt = drt
@@ -41,6 +50,12 @@ class PrefillWorker:
         self.compress_kv = (compress_kv if compress_kv is not None
                             else os.environ.get("DYN_KV_TRANSFER_INT8",
                                                 "") == "1")
+        # pages per streamed chunk frame; 0 = legacy single bulk frame.
+        # Arg, else DYN_KV_TRANSFER_CHUNK_PAGES, else the default.
+        if chunk_pages is None:
+            chunk_pages = int(os.environ.get("DYN_KV_TRANSFER_CHUNK_PAGES",
+                                             DEFAULT_CHUNK_PAGES))
+        self.chunk_pages = max(int(chunk_pages), 0)
         self.queue = PrefillQueue(drt.dcp, namespace)
         self.max_inflight = max_inflight
         self._clients: Dict[int, KvTransferClient] = {}
@@ -49,6 +64,9 @@ class PrefillWorker:
         self._stopped = False
         self.completed = 0
         self.failed = 0
+        self.client_evictions = 0
+        # per-stage transfer-pipeline accounting, shared by all clients
+        self.xfer = TransferStats()
 
     def start(self) -> None:
         if self._run_task is None:
@@ -103,28 +121,107 @@ class PrefillWorker:
             n_prompt_pages = math.ceil(len(req.token_ids) / ps)
             local_send = pages[req.skip_pages:n_prompt_pages]
             remote_dst = req.page_ids[req.skip_pages:n_prompt_pages]
-            k, v = await self.engine.extract_pages(local_send)
-
-            client = await self._client(req.engine_id)
-            await client.send_kv(req.request_id, remote_dst, k, v, first,
-                                 compress=self.compress_kv)
+            await self._send(req, local_send, remote_dst, first)
             self.completed += 1
         except Exception:  # noqa: BLE001 — a bad job must not kill the loop
             self.failed += 1
             log.exception("remote prefill job %s failed (decode side will "
-                          "fall back on timeout)", req.request_id)
+                          "fall back)", req.request_id)
         finally:
             if pages is not None:
                 await self.engine.release_pages(pages)
+
+    async def _send(self, req: RemotePrefillRequest, local_send: List[int],
+                    remote_dst: List[int], first: int) -> None:
+        """Ship the pages, surviving a decode-worker restart: the cached
+        client may point at a dead host:port, so on failure evict it,
+        re-resolve the endpoint from DCP, and retry once with a fresh
+        connection before giving up on the job."""
+        client = await self._client(req.engine_id)
+        try:
+            await self._send_once(client, req, local_send, remote_dst, first)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — retry via fresh endpoint
+            self._evict(req.engine_id, client)
+            self.client_evictions += 1
+            log.warning("KV send for %s to engine %x failed (%s); "
+                        "re-resolving endpoint and retrying",
+                        req.request_id, req.engine_id, exc)
+            client = await self._client(req.engine_id)
+            await self._send_once(client, req, local_send, remote_dst, first)
+
+    async def _send_once(self, client: KvTransferClient,
+                         req: RemotePrefillRequest, local_send: List[int],
+                         remote_dst: List[int], first: int) -> None:
+        cp = self.chunk_pages
+        if cp and local_send:
+            n_chunks = math.ceil(len(local_send) / cp)
+            frames = self._frames(local_send, remote_dst, cp)
+            await client.send_kv_chunked(req.request_id, n_chunks, frames,
+                                         first)
+        else:
+            t0 = time.monotonic()
+            k, v = await self.engine.extract_pages(local_send)
+            dt = time.monotonic() - t0
+            self.xfer.extract_seconds += dt
+            # bulk runs extract BEFORE the send; count it into the wall so
+            # the stage-sum-vs-wall overlap comparison is apples-to-apples
+            # with the chunked pipeline (whose wall covers extraction)
+            self.xfer.wall_seconds += dt
+            await client.send_kv(req.request_id, remote_dst, k, v, first,
+                                 compress=self.compress_kv)
+
+    async def _frames(self, local_send: List[int], remote_dst: List[int],
+                      cp: int):
+        """Chunk producer for the streaming protocol: ranged device→host
+        extract (pipelined inside the engine) + optional int8 compression
+        off the event loop. The client consumes this one chunk ahead, so
+        this body runs under the previous chunk's socket write."""
+        loop = asyncio.get_running_loop()
+        async for off, k, v, dt in self.engine.extract_pages_chunked(
+                local_send, cp):
+            self.xfer.extract_seconds += dt
+            dst = remote_dst[off:off + cp]
+            k = np.ascontiguousarray(k)
+            v = np.ascontiguousarray(v)
+            extra = {"shape": list(k.shape), "dtype": str(k.dtype),
+                     "k_len": k.nbytes}
+            if self.compress_kv:
+                from ...engine.kv_compress import quantize_pages_np
+
+                t0 = time.monotonic()
+                kq, ks = await loop.run_in_executor(None, quantize_pages_np,
+                                                    k)
+                vq, vs = await loop.run_in_executor(None, quantize_pages_np,
+                                                    v)
+                self.xfer.compress_seconds += time.monotonic() - t0
+                extra.update(quant="int8", k_len=kq.nbytes)
+                yield dst, extra, [kq, vq, ks, vs], (kq.nbytes + vq.nbytes
+                                                     + ks.nbytes + vs.nbytes)
+            else:
+                yield dst, extra, [k, v], k.nbytes + v.nbytes
 
     async def _client(self, engine_id: int) -> KvTransferClient:
         client = self._clients.get(engine_id)
         if client is None:
             client = await KvTransferClient.lookup(self.drt.dcp,
-                                                   self.namespace, engine_id)
+                                                   self.namespace, engine_id,
+                                                   stats=self.xfer)
             self._clients[engine_id] = client
         return client
 
+    def _evict(self, engine_id: int, client: Optional[KvTransferClient]
+               ) -> None:
+        cached = self._clients.get(engine_id)
+        if cached is not None and (client is None or cached is client):
+            del self._clients[engine_id]
+        if client is not None:
+            client.close()
+
     def stats(self) -> dict:
         return {"inflight": len(self._tasks), "completed": self.completed,
-                "failed": self.failed}
+                "failed": self.failed,
+                "client_evictions": self.client_evictions,
+                "chunk_pages": self.chunk_pages,
+                **{f"kv_send_{k}": v for k, v in self.xfer.to_dict().items()}}

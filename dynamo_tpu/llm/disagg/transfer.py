@@ -11,6 +11,18 @@ the receiver (DCN host-staged transfer, SURVEY §5 "Distributed
 communication backend"). Endpoint metadata lives in the DCP KV store under
 the decode worker's lease, exactly like NIXL metadata in etcd.
 
+Streaming protocol (the DistServe/Mooncake-style chunk pipeline): a
+request's pages travel as ``chunk_pages``-sized frames tagged
+``{request_id, chunk_idx, n_chunks}``, interleaved freely with other
+requests' frames on one connection. The sender pipelines device→host
+extract (and optional int8 compression) of chunk *i+1* under the socket
+write of chunk *i*; the receiver ingests each chunk as it arrives through
+a per-request worker task and resolves the decode-side waiter only on the
+final commit chunk. Acks are demultiplexed by request_id, so nothing holds
+a lock across a remote wait and concurrent sends to one decode engine make
+progress together. The legacy single-frame bulk format (``chunk_pages=0``)
+stays on the same wire, bit-compatible.
+
 Layout conversion between prefill TP and decode TP (the Triton
 ``kv_rearrange`` kernel, patch:743) is unnecessary here: pages travel in
 the logical host layout ``[L, n, KV, page_size, hd]`` and each side's
@@ -22,7 +34,9 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-from typing import Dict, Optional, Tuple
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -46,28 +60,102 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
+@dataclass
+class TransferStats:
+    """Sender-side per-stage accounting for the streaming pipeline.
+
+    The stages run overlapped (extract of chunk i+1 under the wire write
+    of chunk i), so ``extract + compress + wire`` legitimately exceeds
+    ``wall`` — that inequality is the observable proof the pipeline is
+    actually pipelining (bench stage breakdown)."""
+
+    extract_seconds: float = 0.0
+    compress_seconds: float = 0.0
+    wire_seconds: float = 0.0
+    ack_wait_seconds: float = 0.0
+    wall_seconds: float = 0.0
+    bytes_sent: int = 0
+    chunks_sent: int = 0
+    sends: int = 0
+
+    def to_dict(self) -> dict:
+        return {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self.__dict__.items()}
+
+
+def _decode_body(h: dict, body: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """Frame body → (k, v) host arrays in the header's declared layout.
+    Shared by the bulk and chunk paths so both speak one body format:
+    raw ``k‖v`` or int8 ``k_q‖v_q‖k_s‖v_s`` (engine/kv_compress.py)."""
+    shape = tuple(h["shape"])  # [L, n, KV, ps, hd]
+    dtype = _np_dtype(h["dtype"])
+    k_len = h["k_len"]
+    if h.get("quant") == "int8":
+        # the header dtype is the ORIGINAL pool dtype to restore to
+        from ...engine.kv_compress import dequantize_pages_np
+
+        sshape = shape[:-1] + (1,)
+        s_len = int(np.prod(sshape)) * 4
+        kq = np.frombuffer(body[:k_len], np.int8).reshape(shape)
+        vq = np.frombuffer(body[k_len:2 * k_len], np.int8).reshape(shape)
+        ks = np.frombuffer(body[2 * k_len:2 * k_len + s_len],
+                           np.float32).reshape(sshape)
+        vs = np.frombuffer(body[2 * k_len + s_len:],
+                           np.float32).reshape(sshape)
+        k = dequantize_pages_np(kq, ks, dtype)
+        v = dequantize_pages_np(vq, vs, dtype)
+    else:
+        k = np.frombuffer(body[:k_len], dtype).reshape(shape)
+        v = np.frombuffer(body[k_len:], dtype).reshape(shape)
+    return k, v
+
+
+class _IngestState:
+    """Per-request receive state: frames from one connection funnel into
+    ``queue``; ``task`` drains it so a slow inject for one request never
+    head-of-line-blocks other requests sharing the connection."""
+
+    __slots__ = ("queue", "task", "received", "injected", "failed", "error",
+                 "committed")
+
+    def __init__(self):
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.task: Optional[asyncio.Task] = None
+        self.received = 0
+        self.injected: List[int] = []
+        self.failed = False
+        self.error: Optional[str] = None
+        self.committed = False
+
+
 class KvTransferServer:
     """Decode-side ingest listener.
 
-    Accepts KV page payloads, scatters them into the engine's pool, and
-    resolves the waiter registered under the request id with the remotely
-    sampled first token. One message per request:
-    header {request_id, page_ids, shape, dtype, first_token, k_len} with
-    shape = [L, n, KV, page_size, hd] (kv-head-major pool layout),
-    body = k_bytes || v_bytes; replies {ok, request_id} once injection
-    completes (the NIXL completion-notification analog).
-    """
+    Accepts KV page payloads — chunked streams or legacy single bulk
+    frames — scatters them into the engine's pool, and resolves the waiter
+    registered under the request id with the remotely sampled first token
+    once the stream commits. Each frame is acked
+    ``{ok, request_id, chunk_idx[, committed]}`` (the NIXL
+    completion-notification analog); a mid-stream failure sets the error
+    on the waiter immediately so the decode side falls back without
+    burning its prefill timeout, and partial state is torn down without
+    ever writing into pages the decode side may have reassigned
+    (per-chunk late-write guard)."""
 
     def __init__(self, engine):
         self.engine = engine
         self._server: Optional[asyncio.AbstractServer] = None
         self._waiters: Dict[str, asyncio.Future] = {}
+        self._ingests: Dict[str, _IngestState] = {}
         self.host: str = ""
         self.port: int = 0
+        self._conns: Set[asyncio.StreamWriter] = set()
         # transfer-plane accounting (disagg bench breakdown)
         self.bytes_ingested = 0
         self.pages_ingested = 0
+        self.chunks_ingested = 0
         self.ingest_seconds = 0.0
+        self.streams_failed = 0
 
     async def start(self, host: str = "0.0.0.0") -> None:
         self._server = await asyncio.start_server(self._on_conn, host, 0)
@@ -78,6 +166,16 @@ class KvTransferServer:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+        # drop established connections too — a stop() is a restart from the
+        # sender's point of view, and senders probe liveness through the
+        # socket, not the (gone) listener
+        for w in list(self._conns):
+            w.close()
+        self._conns.clear()
+        for st in list(self._ingests.values()):
+            if st.task is not None:
+                st.task.cancel()
+        self._ingests.clear()
         for fut in self._waiters.values():
             if not fut.done():
                 fut.cancel()
@@ -93,7 +191,8 @@ class KvTransferServer:
 
     def expect(self, request_id: str) -> asyncio.Future:
         """Future resolving to the first sampled token once the KV for
-        request_id has been injected."""
+        request_id has been injected (or failing fast when the stream
+        errors — the decode side falls back immediately)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._waiters[request_id] = fut
         return fut
@@ -103,158 +202,379 @@ class KvTransferServer:
         if fut and not fut.done():
             fut.cancel()
 
+    def stats(self) -> dict:
+        return {
+            "kv_transfer_bytes_total": self.bytes_ingested,
+            "kv_transfer_pages_total": self.pages_ingested,
+            "kv_transfer_chunks_total": self.chunks_ingested,
+            "kv_transfer_inject_seconds_total": round(self.ingest_seconds, 4),
+            "kv_transfer_streams_failed_total": self.streams_failed,
+        }
+
+    def _fail_waiter(self, request_id: Optional[str], exc: Exception) -> None:
+        """Surface a stream failure to the decode side NOW instead of
+        letting it idle out the full prefill timeout."""
+        fut = self._waiters.pop(request_id, None) if request_id else None
+        if fut is not None and not fut.done():
+            fut.set_exception(exc)
+
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
         peer = writer.get_extra_info("peername")
+        wlock = asyncio.Lock()  # ack frames from concurrent workers
+        conn_rids: Set[str] = set()
+        self._conns.add(writer)
         try:
             while True:
                 try:
                     msg = await codec.decode(reader)
-                except (asyncio.IncompleteReadError, ConnectionError):
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        codec.CodecError):
                     return
-                try:
-                    await self._ingest(msg)
-                    writer.write(codec.encode(TwoPartMessage(
-                        header={"ok": True,
-                                "request_id": msg.header["request_id"]})))
-                except Exception as exc:  # noqa: BLE001 — report to sender
-                    log.exception("KV ingest failed")
-                    writer.write(codec.encode(TwoPartMessage(
-                        header={"ok": False, "error": str(exc),
-                                "request_id": msg.header.get("request_id")})))
-                await writer.drain()
+                h = msg.header
+                rid = h.get("request_id")
+                if h.get("kind") == "abort":
+                    st = self._ingests.get(rid)
+                    if st is not None and rid in conn_rids:
+                        st.queue.put_nowait(None)  # sentinel → teardown
+                    else:
+                        self._fail_waiter(rid, RuntimeError(
+                            "sender aborted transfer"))
+                    continue
+                st = self._ingests.get(rid)
+                if st is None or rid not in conn_rids:
+                    st = _IngestState()
+                    self._ingests[rid] = st
+                    conn_rids.add(rid)
+                    st.task = asyncio.ensure_future(
+                        self._ingest_worker(rid, st, writer, wlock))
+                st.queue.put_nowait(msg)
         finally:
+            # connection dropped mid-stream: fail every uncommitted stream
+            # it owned so decode falls back immediately; the worker's
+            # cancel handler releases the partial state
+            for rid in conn_rids:
+                st = self._ingests.get(rid)
+                if st is not None and st.task is not None and not st.committed:
+                    st.task.cancel()
+            self._conns.discard(writer)
             writer.close()
             log.debug("transfer conn from %s closed", peer)
 
-    async def _ingest(self, msg: TwoPartMessage) -> None:
-        h = msg.header
-        request_id = h["request_id"]
-        # claim the waiter FIRST: if the decode side already timed out and
-        # released the pages, they may belong to another request now — a
-        # late write would corrupt it, so drop the payload instead
-        fut = self._waiters.pop(request_id, None)
-        if fut is None:
-            log.warning("dropping KV for unknown/cancelled request %s",
-                        request_id)
-            return
+    async def _ingest_worker(self, request_id: str, st: _IngestState,
+                             writer: asyncio.StreamWriter,
+                             wlock: asyncio.Lock) -> None:
+        """Drain one request's frames: inject each chunk, ack it, resolve
+        the waiter on the commit (final) chunk. Interleaved requests on
+        the same connection each get their own worker, so one slow inject
+        no longer serializes the whole transfer plane."""
+        try:
+            while True:
+                msg = await st.queue.get()
+                if msg is None:  # sender abort
+                    self.streams_failed += 1
+                    self._fail_waiter(request_id, RuntimeError(
+                        "sender aborted transfer mid-stream"))
+                    return
+                h = msg.header
+                legacy = "kind" not in h
+                chunk_idx = 0 if legacy else int(h["chunk_idx"])
+                n_chunks = 1 if legacy else int(h["n_chunks"])
+                final = chunk_idx >= n_chunks - 1
+                ack = {"ok": True, "request_id": request_id,
+                       "chunk_idx": chunk_idx}
+                if st.failed:
+                    ack.update(ok=False, error=st.error or "stream failed")
+                elif request_id not in self._waiters:
+                    # per-chunk late-write guard: the decode side may have
+                    # timed out and released these pages — they can belong
+                    # to another request now, so drop the payload
+                    st.failed = True
+                    st.error = "unknown/cancelled request"
+                    log.warning("dropping KV chunk %d for unknown/cancelled "
+                                "request %s", chunk_idx, request_id)
+                    ack.update(ok=False, error=st.error)
+                else:
+                    try:
+                        await self._inject_chunk(h, msg.body, st)
+                    except Exception as exc:  # noqa: BLE001 — report + fail fast
+                        log.exception("KV ingest failed for %s chunk %d",
+                                      request_id, chunk_idx)
+                        st.failed = True
+                        st.error = str(exc)
+                        self.streams_failed += 1
+                        self._fail_waiter(request_id, exc)
+                        ack.update(ok=False, error=st.error)
+                if not st.failed and final:
+                    if st.received == n_chunks:
+                        fut = self._waiters.pop(request_id, None)
+                        if fut is not None and not fut.done():
+                            fut.set_result(int(h["first_token"]))
+                        st.committed = True
+                        ack["committed"] = True
+                    else:
+                        st.failed = True
+                        st.error = (f"incomplete stream: {st.received}"
+                                    f"/{n_chunks} chunks")
+                        self.streams_failed += 1
+                        self._fail_waiter(request_id,
+                                          RuntimeError(st.error))
+                        ack.update(ok=False, error=st.error)
+                async with wlock:
+                    writer.write(codec.encode(TwoPartMessage(header=ack)))
+                    await writer.drain()
+                if final:
+                    return
+        except asyncio.CancelledError:
+            if not st.committed:
+                self.streams_failed += 1
+                self._fail_waiter(request_id, ConnectionError(
+                    "KV transfer connection dropped mid-stream"))
+            raise
+        except Exception as exc:  # noqa: BLE001 — ack write failure etc.
+            if not st.committed:
+                self.streams_failed += 1
+            self._fail_waiter(request_id, exc)
+        finally:
+            if self._ingests.get(request_id) is st:
+                del self._ingests[request_id]
+
+    async def _inject_chunk(self, h: dict, body: bytes,
+                            st: _IngestState) -> None:
         page_ids = list(h["page_ids"])
         if page_ids:
-            import time as _time
-
-            t0 = _time.monotonic()
-            shape = tuple(h["shape"])  # [L, n, KV, ps, hd]
-            dtype = _np_dtype(h["dtype"])
-            k_len = h["k_len"]
-            if h.get("quant") == "int8":
-                # compressed frame (sender opted in — see
-                # engine/kv_compress.py): body = k_q‖v_q‖k_s‖v_s; the
-                # header dtype is the ORIGINAL pool dtype to restore to
-                from ...engine.kv_compress import dequantize_pages_np
-
-                sshape = shape[:-1] + (1,)
-                s_len = int(np.prod(sshape)) * 4
-                kq = np.frombuffer(msg.body[:k_len],
-                                   np.int8).reshape(shape)
-                vq = np.frombuffer(msg.body[k_len:2 * k_len],
-                                   np.int8).reshape(shape)
-                ks = np.frombuffer(msg.body[2 * k_len:2 * k_len + s_len],
-                                   np.float32).reshape(sshape)
-                vs = np.frombuffer(msg.body[2 * k_len + s_len:],
-                                   np.float32).reshape(sshape)
-                k = dequantize_pages_np(kq, ks, dtype)
-                v = dequantize_pages_np(vq, vs, dtype)
-            else:
-                k = np.frombuffer(msg.body[:k_len], dtype).reshape(shape)
-                v = np.frombuffer(msg.body[k_len:], dtype).reshape(shape)
+            t0 = time.monotonic()
+            k, v = _decode_body(h, body)
             await self.engine.inject_pages(page_ids, k, v)
-            self.bytes_ingested += len(msg.body)
+            self.bytes_ingested += len(body)
             self.pages_ingested += len(page_ids)
-            self.ingest_seconds += _time.monotonic() - t0
-        if not fut.done():
-            fut.set_result(int(h["first_token"]))
+            self.ingest_seconds += time.monotonic() - t0
+            st.injected.extend(page_ids)
+        self.chunks_ingested += 1
+        st.received += 1
+
+
+def _bulk_frame(request_id: str, page_ids, k: np.ndarray, v: np.ndarray,
+                first_token: int, compress: bool) -> Tuple[dict, list]:
+    """Legacy single-frame encoding: header + zero-copy body parts."""
+    k = np.ascontiguousarray(k)
+    v = np.ascontiguousarray(v)
+    header = {
+        "request_id": request_id,
+        "page_ids": list(int(p) for p in page_ids),
+        "shape": list(k.shape),
+        "dtype": str(k.dtype),
+        "k_len": k.nbytes,
+        "first_token": int(first_token),
+    }
+    if compress:
+        from ...engine.kv_compress import quantize_pages_np
+
+        kq, ks = quantize_pages_np(k)
+        vq, vs = quantize_pages_np(v)
+        header["quant"] = "int8"
+        header["k_len"] = kq.nbytes
+        parts = [kq, vq, ks, vs]
+    else:
+        parts = [k, v]
+    return header, parts
 
 
 class KvTransferClient:
-    """Prefill-side sender: one persistent connection per decode engine."""
+    """Prefill-side sender: one persistent connection per decode engine.
 
-    def __init__(self, host: str, port: int):
+    A background ack loop demultiplexes replies by request_id, so any
+    number of sends — bulk or chunked streams — share the connection
+    concurrently; nothing holds a lock across a remote ack wait (the seed
+    serialized all in-flight jobs to one decode engine here). Frames are
+    written atomically (synchronous ``writelines`` of zero-copy parts), so
+    interleaving between awaits never splits a frame."""
+
+    def __init__(self, host: str, port: int,
+                 stats: Optional[TransferStats] = None):
         self.host = host
         self.port = port
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
-        self._lock = asyncio.Lock()
+        self._ack_task: Optional[asyncio.Task] = None
+        self._conn_lock = asyncio.Lock()  # held for connect only, never acks
+        self._pending: Dict[str, asyncio.Queue] = {}
+        self.stats = stats if stats is not None else TransferStats()
 
     @classmethod
-    async def lookup(cls, dcp: DcpClient, namespace: str,
-                     engine_id: int) -> "KvTransferClient":
+    async def lookup(cls, dcp: DcpClient, namespace: str, engine_id: int,
+                     stats: Optional[TransferStats] = None
+                     ) -> "KvTransferClient":
         raw = await dcp.kv_get(metadata_key(namespace, engine_id))
         if raw is None:
             raise RuntimeError(
                 f"no KV transfer endpoint registered for engine "
                 f"{engine_id:x} (decode worker down?)")
         meta = json.loads(raw)
-        return cls(meta["host"], meta["port"])
+        return cls(meta["host"], meta["port"], stats=stats)
 
     async def _ensure(self) -> None:
-        if self._writer is None or self._writer.is_closing():
-            self._reader, self._writer = await asyncio.open_connection(
-                self.host, self.port)
+        async with self._conn_lock:
+            if self._writer is None or self._writer.is_closing():
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port)
+                self._ack_task = asyncio.ensure_future(
+                    self._ack_loop(self._reader, self._writer))
+
+    async def _ack_loop(self, reader: asyncio.StreamReader,
+                        writer: asyncio.StreamWriter) -> None:
+        """Demux acks to per-request queues; on connection loss fail every
+        pending send so none of them idles out its timeout."""
+        try:
+            while True:
+                msg = await codec.decode(reader)
+                q = self._pending.get(msg.header.get("request_id"))
+                if q is not None:
+                    q.put_nowait(msg.header)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — conn loss/desync
+            err = {"ok": False, "conn_lost": True,
+                   "error": f"transfer connection lost: {exc}"}
+            for q in self._pending.values():
+                q.put_nowait(err)
+            if self._writer is writer:
+                self._writer = None
+            writer.close()
+
+    def _register(self, request_id: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._pending[request_id] = q
+        return q
+
+    @staticmethod
+    def _check_ack(ack: dict) -> None:
+        if not ack.get("ok"):
+            if ack.get("conn_lost"):
+                raise ConnectionError(ack.get("error"))
+            raise RuntimeError(
+                f"decode-side KV ingest failed: {ack.get('error')}")
 
     async def send_kv(self, request_id: str, page_ids, k: np.ndarray,
                       v: np.ndarray, first_token: int,
                       timeout: float = 60.0,
                       compress: bool = False) -> None:
-        """Ship pages [L, n, KV, ps, hd] + first token; returns once the
-        decode side has injected them (raises on remote failure).
+        """Bulk mode (``chunk_pages=0``): ship all pages
+        [L, n, KV, ps, hd] + the first token in one frame; returns once
+        the decode side has injected them (raises on remote failure).
         ``compress=True`` quantizes each (token, head) row to int8 +
         f32 scale before framing — ~half the DCN bytes, lossy (see
         engine/kv_compress.py); the header's dtype stays the ORIGINAL
         so the receiver restores into its pool dtype."""
-        k = np.ascontiguousarray(k)
-        v = np.ascontiguousarray(v)
-        header = {
-            "request_id": request_id,
-            "page_ids": list(int(p) for p in page_ids),
-            "shape": list(k.shape),
-            "dtype": str(k.dtype),
-            "k_len": k.nbytes,
-            "first_token": int(first_token),
-        }
-        if compress:
-            from ...engine.kv_compress import quantize_pages_np
+        header, parts = _bulk_frame(request_id, page_ids, k, v,
+                                    first_token, compress)
+        q = self._register(request_id)
+        t_wall = time.monotonic()
+        try:
+            await self._ensure()
+            t0 = time.monotonic()
+            self._writer.writelines(codec.encode_parts(header, parts))
+            await self._writer.drain()
+            now = time.monotonic()
+            self.stats.wire_seconds += now - t0
+            self.stats.bytes_sent += sum(p.nbytes for p in parts)
+            ack = await asyncio.wait_for(q.get(), timeout)
+            self.stats.ack_wait_seconds += time.monotonic() - now
+        finally:
+            self._pending.pop(request_id, None)
+            self.stats.wall_seconds += time.monotonic() - t_wall
+            self.stats.sends += 1
+        self._check_ack(ack)
 
-            kq, ks = quantize_pages_np(k)
-            vq, vs = quantize_pages_np(v)
-            header["quant"] = "int8"
-            header["k_len"] = kq.nbytes
-            body = (kq.tobytes() + vq.tobytes()
-                    + ks.tobytes() + vs.tobytes())
-        else:
-            body = k.tobytes() + v.tobytes()
-        async with self._lock:  # frame-atomic per request
-            try:
-                await self._ensure()
-                self._writer.write(codec.encode(TwoPartMessage(
-                    header=header, body=body)))
+    async def send_kv_chunked(self, request_id: str, n_chunks: int, frames,
+                              first_token: int,
+                              timeout: float = 60.0) -> None:
+        """Streamed mode: consume ``frames`` — an async iterator yielding
+        ``(dst_page_ids, header_extra, body_parts, nbytes)`` per chunk —
+        one chunk ahead, so producing chunk i+1 (device→host extract +
+        optional compression) overlaps the socket write of chunk i. The
+        final chunk carries the first token and acts as the commit; the
+        call returns once the decode side acks that commit. On any
+        failure an abort frame tears down the receiver's partial state
+        (which fails the decode-side waiter → immediate local fallback)."""
+        q = self._register(request_id)
+        t_wall = time.monotonic()
+        nxt: Optional[asyncio.Future] = None
+        committed = False
+        try:
+            await self._ensure()
+            nxt = asyncio.ensure_future(frames.__anext__())
+            idx = 0
+            while True:
+                try:
+                    dst, extra, parts, nbytes = await nxt
+                    nxt = None
+                except StopAsyncIteration:
+                    nxt = None
+                    break
+                if idx + 1 < n_chunks:
+                    # pipeline: start producing chunk i+1 before writing i
+                    nxt = asyncio.ensure_future(frames.__anext__())
+                header = {"kind": "chunk", "request_id": request_id,
+                          "chunk_idx": idx, "n_chunks": n_chunks,
+                          "page_ids": [int(p) for p in dst], **extra}
+                if idx == n_chunks - 1:
+                    header["first_token"] = int(first_token)
+                t0 = time.monotonic()
+                self._writer.writelines(codec.encode_parts(header, parts))
                 await self._writer.drain()
-                ack = await asyncio.wait_for(codec.decode(self._reader),
-                                             timeout)
-            except Exception:
-                # a timed-out/aborted read leaves the stream mid-frame —
-                # drop the connection so the next send starts clean
-                self.close()
-                raise
-            if ack.header.get("request_id") != request_id:
-                self.close()  # desynced: stale ack from an earlier request
+                self.stats.wire_seconds += time.monotonic() - t0
+                self.stats.bytes_sent += nbytes
+                self.stats.chunks_sent += 1
+                idx += 1
+                # early-failure check: abort the remaining extract/send
+                # work the moment the receiver reports a chunk failure
+                while not q.empty():
+                    ack = q.get_nowait()
+                    self._check_ack(ack)
+                    committed = committed or bool(ack.get("committed"))
+                if idx >= n_chunks:
+                    break
+            if idx != n_chunks:
                 raise RuntimeError(
-                    f"KV transfer ack mismatch: sent {request_id}, "
-                    f"got {ack.header.get('request_id')}")
-        if not ack.header.get("ok"):
-            raise RuntimeError(
-                f"decode-side KV ingest failed: {ack.header.get('error')}")
+                    f"chunk producer yielded {idx}/{n_chunks} chunks")
+            t1 = time.monotonic()
+            while not committed:
+                ack = await asyncio.wait_for(q.get(), timeout)
+                self._check_ack(ack)
+                committed = bool(ack.get("committed"))
+            self.stats.ack_wait_seconds += time.monotonic() - t1
+        except BaseException:
+            if nxt is not None:
+                nxt.cancel()
+            await self._abort(request_id)
+            raise
+        finally:
+            if hasattr(frames, "aclose"):
+                try:
+                    await frames.aclose()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            self._pending.pop(request_id, None)
+            self.stats.wall_seconds += time.monotonic() - t_wall
+            self.stats.sends += 1
+
+    async def _abort(self, request_id: str) -> None:
+        """Best-effort abort frame: lets the receiver drop partial state
+        and fail the waiter now, without closing the shared connection
+        under other in-flight requests."""
+        try:
+            if self._writer is not None and not self._writer.is_closing():
+                self._writer.writelines(codec.encode_parts(
+                    {"kind": "abort", "request_id": request_id}))
+                await self._writer.drain()
+        except Exception:  # noqa: BLE001 — the conn may be the failure
+            pass
 
     def close(self) -> None:
+        if self._ack_task is not None:
+            self._ack_task.cancel()
+            self._ack_task = None
         if self._writer:
             self._writer.close()
             self._writer = None

@@ -29,6 +29,14 @@ class ForwardPassMetrics:
     # accepted/drafted tokens, and accepted drafts per verify step
     spec_decode_acceptance_rate: float = 0.0
     spec_decode_mean_accepted_len: float = 0.0
+    # disaggregation transfer plane (llm/disagg/transfer.py streaming
+    # chunk pipeline): decode-side ingest volume/time + the remote-prefill
+    # wait the decode engine accumulates (enqueue → KV committed)
+    kv_transfer_bytes_total: int = 0
+    kv_transfer_chunks_total: int = 0
+    kv_transfer_inject_seconds_total: float = 0.0
+    kv_transfer_streams_failed_total: int = 0
+    remote_prefill_wait_seconds_total: float = 0.0
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)

@@ -122,6 +122,21 @@ class MetricsAggregator:
             ("dyn_worker_spec_decode_mean_accepted_len",
              "mean accepted draft length per verify step",
              lambda m: m.spec_decode_mean_accepted_len),
+            ("dyn_worker_kv_transfer_bytes_total",
+             "disagg KV bytes ingested over the transfer plane",
+             lambda m: m.kv_transfer_bytes_total),
+            ("dyn_worker_kv_transfer_chunks_total",
+             "disagg KV chunk frames ingested",
+             lambda m: m.kv_transfer_chunks_total),
+            ("dyn_worker_kv_transfer_inject_seconds_total",
+             "seconds spent injecting transferred KV into the pool",
+             lambda m: m.kv_transfer_inject_seconds_total),
+            ("dyn_worker_kv_transfer_streams_failed_total",
+             "KV transfer streams torn down before commit",
+             lambda m: m.kv_transfer_streams_failed_total),
+            ("dyn_worker_remote_prefill_wait_seconds_total",
+             "decode-side wait for remote prefill (enqueue to KV commit)",
+             lambda m: m.remote_prefill_wait_seconds_total),
         ]
         for name, help_, get in per_worker:
             rows = [

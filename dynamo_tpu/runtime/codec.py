@@ -41,6 +41,28 @@ def encode(msg: TwoPartMessage) -> bytes:
     return PRELUDE.pack(len(header), len(body), h.intdigest()) + header + body
 
 
+def encode_parts(header: dict, body_parts=()) -> list:
+    """Zero-copy multi-buffer framing: same wire format as ``encode`` but
+    the body is a sequence of buffer-protocol parts (numpy array views,
+    bytes) that are hashed and emitted in place — no ``b"".join`` copy of
+    a multi-hundred-MB KV payload. Returns the buffer list to hand to
+    ``StreamWriter.writelines``; a ``decode`` on the other end sees one
+    body of the concatenated parts."""
+    hdr = msgpack.packb(header, use_bin_type=True)
+    h = xxhash.xxh3_64()
+    h.update(hdr)
+    parts = []
+    body_len = 0
+    for p in body_parts:
+        mv = p if isinstance(p, (bytes, memoryview)) else memoryview(p)
+        if isinstance(mv, memoryview) and (mv.ndim != 1 or mv.itemsize != 1):
+            mv = mv.cast("B")
+        h.update(mv)
+        body_len += len(mv)
+        parts.append(mv)
+    return [PRELUDE.pack(len(hdr), body_len, h.intdigest()) + hdr, *parts]
+
+
 async def decode(reader: asyncio.StreamReader) -> TwoPartMessage:
     prelude = await reader.readexactly(PRELUDE_SIZE)
     header_len, body_len, checksum = PRELUDE.unpack(prelude)

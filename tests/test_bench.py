@@ -256,3 +256,38 @@ def test_disagg_label_reflects_transfer_int8(monkeypatch):
     assert "kv-int8" in bench.metric_name(args)
     monkeypatch.delenv("DYN_KV_TRANSFER_INT8")
     assert bench.metric_name(args) == base
+    assert "kv-chunks 0,4" in bench.metric_name(
+        make_args(scenario="disagg", kv_chunk_pages="0,4"))
+
+
+def test_disagg_streaming_smoke_cpu():
+    """Tier-1 CPU smoke for the streaming transfer plane through the REAL
+    disagg bench path: a bulk leg (chunk_pages=0) and a chunked leg on the
+    same engines, each reporting the per-stage extract/compress/wire/
+    inject breakdown. Pins the sweep plumbing, the per-leg stat deltas,
+    and that multi-chunk streams actually went over the wire."""
+    args = make_args(scenario="disagg", model="tiny", requests=4,
+                     concurrency=2, isl=96, osl=4, seed=0,
+                     decode_steps=2, disagg_threshold=16,
+                     kv_chunk_pages="0,2", prefill_token_budget=None,
+                     host_pages=0, host_tier_int8=False, max_batch=None,
+                     spec=False, dtype="bf16")
+    report = asyncio.run(bench.run_disagg(args))
+    legs = report["disagg_legs"]
+    assert [leg["kv_chunk_pages"] for leg in legs] == [0, 2]
+    bulk, chunked = legs
+    for leg in legs:
+        assert leg["errors"] == 0
+        assert leg["remote_prefills"] > 0
+        assert leg["remote_fallbacks"] == 0
+        stages = leg["transfer_stages"]
+        assert stages["extract_s"] > 0 and stages["inject_s"] > 0
+        assert stages["send_wall_s"] > 0
+    # bulk mode sends exactly one frame per request → no chunk frames
+    assert bulk["transfer_stages"]["chunks_sent"] == 0
+    # 96-token prompts = 6 pages of 16 → ≥3 chunk frames per request
+    assert (chunked["transfer_stages"]["chunks_sent"]
+            >= 3 * chunked["remote_prefills"])
+    assert chunked["transfer_pages"] > 0
+    # both legs moved the same pages per request (same workload shape)
+    assert report["disagg_over_agg_req_per_s"] > 0

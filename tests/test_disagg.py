@@ -181,6 +181,101 @@ def test_disagg_end_to_end_matches_local(run_async, prompt_len):
     run_async(main())
 
 
+def test_disagg_chunked_vs_bulk_token_identity(run_async):
+    """Greedy outputs through the remote-prefill path are token-identical
+    between bulk mode (chunk_pages=0) and the multi-chunk stream
+    (chunk_pages=1 → one frame per page), and both match a local run."""
+
+    async def main():
+        import jax
+
+        params = init_params(tiny_cfg(), jax.random.PRNGKey(7))
+        prompt = [(i * 13) % 90 + 1 for i in range(26)]  # 4 pages of 8
+
+        local = make_engine(params)
+        want, _ = await collect(local, greedy_request(prompt))
+        await local.stop()
+
+        for chunk_pages in (0, 1):
+            drt = await DistributedRuntime.detached()
+            try:
+                decode_eng = make_engine(params)
+                prefill_eng = make_engine(params)
+                router = DisaggRouter(max_local_prefill_length=4)
+                disagg = await build_disagg_decode(drt, decode_eng,
+                                                   namespace="test",
+                                                   router=router,
+                                                   watch_config=False)
+                pw = PrefillWorker(drt, prefill_eng, namespace="test",
+                                   chunk_pages=chunk_pages)
+                pw.start()
+                got, _ = await collect(disagg, greedy_request(prompt))
+                assert disagg.remote_prefills == 1, f"cp={chunk_pages}"
+                assert disagg.remote_fallbacks == 0, f"cp={chunk_pages}"
+                assert got == want, f"cp={chunk_pages} diverged"
+                if chunk_pages == 1:
+                    # one frame per page actually went over the wire
+                    assert disagg.transfer.chunks_ingested >= 4
+                    assert pw.xfer.chunks_sent >= 4
+                    assert pw.xfer.extract_seconds > 0
+                await pw.stop()
+                await disagg.transfer.stop()
+                await prefill_eng.stop()
+                await decode_eng.stop()
+            finally:
+                await drt.shutdown()
+
+    run_async(main())
+
+
+def test_prefill_worker_evicts_stale_client_on_decode_restart(run_async):
+    """A decode-worker restart invalidates the cached transfer endpoint;
+    the prefill worker must evict the stale client, re-resolve from DCP,
+    and retry — not fail every subsequent job to that engine."""
+
+    async def main():
+        import jax
+
+        params = init_params(tiny_cfg(), jax.random.PRNGKey(8))
+        drt = await DistributedRuntime.detached()
+        prompt = [(i * 5) % 80 + 1 for i in range(20)]
+        prompt2 = [(i * 9) % 80 + 3 for i in range(21)]
+        try:
+            decode_eng = make_engine(params)
+            prefill_eng = make_engine(params)
+            router = DisaggRouter(max_local_prefill_length=4)
+            disagg = await build_disagg_decode(drt, decode_eng,
+                                               namespace="test",
+                                               router=router,
+                                               watch_config=False)
+            pw = PrefillWorker(drt, prefill_eng, namespace="test")
+            pw.start()
+            got1, _ = await collect(disagg, greedy_request(prompt))
+            assert pw.completed == 1
+
+            # "restart" the decode side's listener: new socket, new port,
+            # re-registered under the same engine id — the worker's cached
+            # client now points at a dead endpoint
+            await disagg.transfer.stop()
+            await disagg.transfer.start()
+            await disagg.transfer.register(drt.dcp, "test", drt.instance_id)
+
+            got2, _ = await collect(disagg, greedy_request(prompt2))
+            assert disagg.remote_prefills == 2
+            assert disagg.remote_fallbacks == 0
+            assert pw.completed == 2 and pw.failed == 0
+            assert pw.client_evictions == 1
+
+            await pw.stop()
+            await disagg.transfer.stop()
+            await prefill_eng.stop()
+            await decode_eng.stop()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
 def test_disagg_fallback_on_no_prefill_worker(run_async):
     """No prefill worker alive → decode times out and falls back locally."""
 

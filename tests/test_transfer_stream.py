@@ -1,0 +1,420 @@
+"""Streaming chunked KV transfer plane (llm/disagg/transfer.py).
+
+Protocol-level tests against a fake engine: interleaved multi-request
+chunk streams on one connection, per-chunk late-write guards, mid-stream
+failure/abort/connection-drop teardown (waiter fails fast → decode-side
+fallback), int8 chunk round-trips matching the bulk path, and the
+zero-copy multi-buffer codec framing. Everything runs on plain asyncio —
+no JAX engine — so this is the fast tier-1 smoke for the wire protocol.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.disagg.transfer import (KvTransferClient,
+                                            KvTransferServer, TransferStats)
+from dynamo_tpu.runtime import codec
+
+SHAPE = (2, 1, 2, 4, 8)  # [L, n=1 page per unit, KV, ps, hd]
+
+
+class FakeEngine:
+    """Page-keyed sink standing in for JaxEngine.inject_pages."""
+
+    def __init__(self, inject_delay=0.0, fail_on_page=None):
+        self.pages = {}
+        self.inject_delay = inject_delay
+        self.fail_on_page = fail_on_page
+        self.inject_calls = 0
+
+    async def inject_pages(self, page_ids, k, v):
+        self.inject_calls += 1
+        if self.fail_on_page is not None and self.fail_on_page in page_ids:
+            raise RuntimeError(f"boom on page {self.fail_on_page}")
+        if self.inject_delay:
+            await asyncio.sleep(self.inject_delay)
+        for i, p in enumerate(page_ids):
+            self.pages[int(p)] = (np.asarray(k)[:, i].copy(),
+                                  np.asarray(v)[:, i].copy())
+
+
+def _pages(n, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    shape = (SHAPE[0], n) + SHAPE[2:]
+    return (rng.randn(*shape).astype(dtype) * 0.3,
+            rng.randn(*shape).astype(dtype) * 0.3)
+
+
+async def _frames(page_ids, k, v, chunk_pages, compress=False):
+    """Chunk producer mirroring PrefillWorker._frames, fed from arrays."""
+    for off in range(0, len(page_ids), chunk_pages):
+        kc = np.ascontiguousarray(k[:, off:off + chunk_pages])
+        vc = np.ascontiguousarray(v[:, off:off + chunk_pages])
+        dst = page_ids[off:off + chunk_pages]
+        extra = {"shape": list(kc.shape), "dtype": str(kc.dtype),
+                 "k_len": kc.nbytes}
+        if compress:
+            from dynamo_tpu.engine.kv_compress import quantize_pages_np
+
+            kq, ks = quantize_pages_np(kc)
+            vq, vs = quantize_pages_np(vc)
+            extra.update(quant="int8", k_len=kq.nbytes)
+            yield dst, extra, [kq, vq, ks, vs], (kq.nbytes + vq.nbytes
+                                                 + ks.nbytes + vs.nbytes)
+        else:
+            yield dst, extra, [kc, vc], kc.nbytes + vc.nbytes
+
+
+def n_chunks(n_pages, cp):
+    return -(-n_pages // cp)
+
+
+async def _server(engine):
+    server = KvTransferServer(engine)
+    await server.start(host="127.0.0.1")
+    return server
+
+
+def test_encode_parts_matches_encode():
+    """Multi-buffer zero-copy framing is byte-identical on the wire to the
+    concatenating encoder, and decodable by both decoders."""
+    k = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    v = np.ones((2, 3, 4), np.float32)
+    header = {"request_id": "r", "k_len": k.nbytes}
+    whole = codec.encode(codec.TwoPartMessage(
+        header=header, body=k.tobytes() + v.tobytes()))
+    parts = codec.encode_parts(header, [k, v])
+    assert b"".join(bytes(p) for p in parts) == whole
+    msg, rest = codec.decode_buffer(whole)
+    assert rest == b""
+    assert msg.header == header
+    np.testing.assert_array_equal(
+        np.frombuffer(msg.body[:k.nbytes], np.float32).reshape(k.shape), k)
+
+
+def test_chunked_stream_roundtrip(run_async):
+    """A multi-chunk stream lands every page exactly and resolves the
+    waiter only on the final commit chunk."""
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+        k, v = _pages(5, seed=1)
+        dst = [10, 11, 12, 13, 14]
+        client = KvTransferClient("127.0.0.1", server.port)
+        fut = server.expect("r1")
+        await client.send_kv_chunked(
+            "r1", n_chunks(5, 2), _frames(dst, k, v, 2), first_token=99)
+        tok = await asyncio.wait_for(fut, 5)
+        assert tok == 99
+        assert server.chunks_ingested == 3
+        assert server.pages_ingested == 5
+        assert not server._ingests  # state torn down on commit
+        for i, p in enumerate(dst):
+            np.testing.assert_array_equal(eng.pages[p][0], k[:, i])
+            np.testing.assert_array_equal(eng.pages[p][1], v[:, i])
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_interleaved_streams_one_connection_concurrent_progress(run_async):
+    """Two requests stream concurrently over ONE client/connection; a slow
+    inject for request A must not block request B's commit (the seed held
+    a per-client lock across the whole ack wait, serializing them — this
+    is the no-head-of-line-blocking regression test)."""
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+
+        slow_real = eng.inject_pages
+
+        async def slow_inject(page_ids, k, v):
+            if 0 in page_ids:  # request A's pages
+                await asyncio.sleep(0.5)
+            await slow_real(page_ids, k, v)
+
+        eng.inject_pages = slow_inject
+        client = KvTransferClient("127.0.0.1", server.port)
+        ka, va = _pages(4, seed=2)
+        kb, vb = _pages(4, seed=3)
+        fut_a = server.expect("a")
+        fut_b = server.expect("b")
+        t0 = time.monotonic()
+        done_at = {}
+
+        async def send(rid, dst, k, v):
+            await client.send_kv_chunked(
+                rid, n_chunks(4, 2), _frames(dst, k, v, 2), first_token=1)
+            done_at[rid] = time.monotonic() - t0
+
+        await asyncio.gather(send("a", [0, 1, 2, 3], ka, va),
+                             send("b", [20, 21, 22, 23], kb, vb))
+        assert await fut_a == 1 and await fut_b == 1
+        # B finished while A's first inject was still sleeping (0.5s)
+        assert done_at["b"] < 0.45, done_at
+        assert done_at["a"] >= 0.45, done_at
+        for p in (0, 1, 2, 3, 20, 21, 22, 23):
+            assert p in eng.pages
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_concurrent_bulk_sends_share_connection(run_async):
+    """Bulk-mode sends also demux acks by request_id — no client-side lock
+    across the remote ack wait."""
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+
+        real = eng.inject_pages
+
+        async def slow_inject(page_ids, k, v):
+            if 0 in page_ids:
+                await asyncio.sleep(0.5)
+            await real(page_ids, k, v)
+
+        eng.inject_pages = slow_inject
+        client = KvTransferClient("127.0.0.1", server.port)
+        k, v = _pages(2, seed=4)
+        fa, fb = server.expect("a"), server.expect("b")
+        t0 = time.monotonic()
+        done = {}
+
+        async def send(rid, dst):
+            await client.send_kv(rid, dst, k, v, first_token=5)
+            done[rid] = time.monotonic() - t0
+
+        await asyncio.gather(send("a", [0, 1]), send("b", [30, 31]))
+        assert await fa == 5 and await fb == 5
+        assert done["b"] < 0.45, done
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_chunked_int8_matches_bulk_dequant(run_async):
+    """int8-compressed chunks restore byte-identically to what the bulk
+    int8 path restores (same quantize → dequantize per page row)."""
+
+    async def main():
+        k, v = _pages(4, seed=5)
+        dst = [1, 2, 3, 4]
+
+        eng_bulk = FakeEngine()
+        server_b = await _server(eng_bulk)
+        cb = KvTransferClient("127.0.0.1", server_b.port)
+        fut = server_b.expect("r")
+        await cb.send_kv("r", dst, k, v, first_token=0, compress=True)
+        await asyncio.wait_for(fut, 5)
+        cb.close()
+        await server_b.stop()
+
+        eng_ch = FakeEngine()
+        server_c = await _server(eng_ch)
+        cc = KvTransferClient("127.0.0.1", server_c.port)
+        fut = server_c.expect("r")
+        await cc.send_kv_chunked(
+            "r", n_chunks(4, 3), _frames(dst, k, v, 3, compress=True),
+            first_token=0)
+        await asyncio.wait_for(fut, 5)
+        cc.close()
+        await server_c.stop()
+
+        for p in dst:
+            np.testing.assert_array_equal(eng_bulk.pages[p][0],
+                                          eng_ch.pages[p][0])
+            np.testing.assert_array_equal(eng_bulk.pages[p][1],
+                                          eng_ch.pages[p][1])
+
+    run_async(main())
+
+
+def test_ingest_failure_fails_waiter_immediately(run_async):
+    """A decode-side inject error must fail the waiter NOW (satellite: the
+    seed only nacked the sender while the waiter idled out the full
+    prefill timeout) and nack the sender."""
+
+    async def main():
+        eng = FakeEngine(fail_on_page=12)
+        server = await _server(eng)
+        client = KvTransferClient("127.0.0.1", server.port)
+        k, v = _pages(4, seed=6)
+        fut = server.expect("r")
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="ingest failed"):
+            await client.send_kv_chunked(
+                "r", n_chunks(4, 2), _frames([10, 11, 12, 13], k, v, 2),
+                first_token=0, timeout=30.0)
+        with pytest.raises(RuntimeError, match="boom"):
+            await asyncio.wait_for(fut, 1)
+        assert time.monotonic() - t0 < 5  # nowhere near any timeout
+        assert server.streams_failed >= 1
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_bulk_ingest_failure_fails_waiter(run_async):
+    """Same fast-fail contract on the legacy bulk frame."""
+
+    async def main():
+        eng = FakeEngine(fail_on_page=11)
+        server = await _server(eng)
+        client = KvTransferClient("127.0.0.1", server.port)
+        k, v = _pages(2, seed=7)
+        fut = server.expect("r")
+        with pytest.raises(RuntimeError, match="ingest failed"):
+            await client.send_kv("r", [10, 11], k, v, first_token=0)
+        with pytest.raises(RuntimeError, match="boom"):
+            await asyncio.wait_for(fut, 1)
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_connection_drop_mid_stream_releases_state(run_async):
+    """Killing the connection between chunks fails the waiter immediately
+    (decode falls back, releasing/quarantining the partially-injected
+    pages it owns) and tears down the server's partial ingest state."""
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+        client = KvTransferClient("127.0.0.1", server.port)
+        k, v = _pages(6, seed=8)
+        dst = [1, 2, 3, 4, 5, 6]
+        fut = server.expect("r")
+
+        async def two_chunks_then_die():
+            agen = _frames(dst, k, v, 2)
+            i = 0
+            async for item in agen:
+                yield item
+                i += 1
+                if i == 2:
+                    client._writer.close()  # simulate sender crash
+                    await asyncio.sleep(0.05)
+
+        with pytest.raises(Exception):
+            await client.send_kv_chunked("r", 3, two_chunks_then_die(),
+                                         first_token=0, timeout=5.0)
+        # the waiter fails the moment the server notices the drop (ack
+        # write fails or the reader EOFs) — never idles out a timeout
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(fut, 2)
+        await asyncio.sleep(0.05)
+        assert not server._ingests  # partial state torn down
+        assert "r" not in server._waiters
+        assert server.streams_failed >= 1
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_abort_frame_tears_down_stream(run_async):
+    """A producer error aborts the stream: the server drops partial state
+    and fails the waiter without the connection dying (other requests on
+    the connection keep working)."""
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+        client = KvTransferClient("127.0.0.1", server.port)
+        k, v = _pages(4, seed=9)
+        fut = server.expect("r")
+
+        async def broken_producer():
+            agen = _frames([1, 2, 3, 4], k, v, 2)
+            yield await agen.__anext__()
+            raise RuntimeError("extract exploded")
+
+        with pytest.raises(RuntimeError, match="extract exploded"):
+            await client.send_kv_chunked("r", 2, broken_producer(),
+                                         first_token=0)
+        with pytest.raises(RuntimeError, match="aborted"):
+            await asyncio.wait_for(fut, 2)
+        await asyncio.sleep(0.05)
+        assert not server._ingests
+
+        # connection still usable for the next request
+        k2, v2 = _pages(2, seed=10)
+        fut2 = server.expect("r2")
+        await client.send_kv_chunked(
+            "r2", 1, _frames([7, 8], k2, v2, 2), first_token=3)
+        assert await asyncio.wait_for(fut2, 2) == 3
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_late_chunk_after_cancel_never_writes(run_async):
+    """Per-chunk late-write guard: once the decode side cancels (timeout →
+    pages may be reassigned), arriving chunks are dropped, not injected."""
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+        client = KvTransferClient("127.0.0.1", server.port)
+        k, v = _pages(4, seed=11)
+        fut = server.expect("r")
+
+        async def cancel_after_first():
+            agen = _frames([1, 2, 3, 4], k, v, 2)
+            yield await agen.__anext__()
+            # wait until the server has injected chunk 0, THEN simulate the
+            # decode-side timeout before chunk 1 goes out
+            while 2 not in eng.pages:
+                await asyncio.sleep(0.005)
+            server.cancel("r")
+            yield await agen.__anext__()
+
+        with pytest.raises(RuntimeError, match="unknown/cancelled"):
+            await client.send_kv_chunked("r", 2, cancel_after_first(),
+                                         first_token=0)
+        assert fut.cancelled()
+        # chunk 1 landed (waiter was live), chunk 2 must have been dropped
+        assert 1 in eng.pages and 2 in eng.pages
+        assert 3 not in eng.pages and 4 not in eng.pages
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_sender_stage_stats_accumulate(run_async):
+    """The sender's per-stage breakdown counts every chunk and byte."""
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+        stats = TransferStats()
+        client = KvTransferClient("127.0.0.1", server.port, stats=stats)
+        k, v = _pages(4, seed=12)
+        fut = server.expect("r")
+        await client.send_kv_chunked(
+            "r", n_chunks(4, 1), _frames([1, 2, 3, 4], k, v, 1),
+            first_token=0)
+        await asyncio.wait_for(fut, 2)
+        assert stats.chunks_sent == 4
+        assert stats.bytes_sent == k.nbytes + v.nbytes
+        assert stats.sends == 1
+        assert stats.wall_seconds > 0
+        assert server.bytes_ingested == stats.bytes_sent
+        client.close()
+        await server.stop()
+
+    run_async(main())
