@@ -56,8 +56,8 @@ def metric_name(args) -> str:
         return (f"TTFT p50 (later turns), multiturn {args.users}u x "
                 f"{args.turns}t, host_pages={tier}")
     if args.scenario == "disagg":
-        x8 = (", kv-int8" if os.environ.get("DYN_KV_TRANSFER_INT8") == "1"
-              else "")
+        from dynamo_tpu.runtime.config import env_bool
+        x8 = ", kv-int8" if env_bool("DYN_KV_TRANSFER_INT8") else ""
         ch = (f", kv-chunks {args.kv_chunk_pages}"
               if getattr(args, "kv_chunk_pages", None) else "")
         return (f"disagg/agg req/s ratio (1-chip time-shared, threshold "
@@ -412,7 +412,8 @@ async def measure(engine, reqs, concurrency):
     # hard per-request watchdog: a wedged generator must surface as an
     # error row, never hang the whole bench (the driver runs this
     # unattended at end of round)
-    req_timeout = float(os.environ.get("DYN_BENCH_REQ_TIMEOUT", "600"))
+    from dynamo_tpu.runtime.config import env_float
+    req_timeout = env_float("DYN_BENCH_REQ_TIMEOUT")
 
     async def one(req_idx, token_ids, osl):
         async with sem:
@@ -740,8 +741,8 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
     else:
-        ok, reason = probe_backend(
-            float(os.environ.get("DYN_BENCH_PROBE_TIMEOUT", "240")))
+        from dynamo_tpu.runtime.config import env_float
+        ok, reason = probe_backend(env_float("DYN_BENCH_PROBE_TIMEOUT"))
         if not ok and args.spec:
             # --spec degrades to a CPU smoke A/B (tiny model, few
             # requests) instead of reporting chip-unavailable: the A/B
@@ -766,7 +767,7 @@ def main():
             return
         else:
             watchdog = arm_watchdog(
-                args, float(os.environ.get("DYN_BENCH_WALL_BUDGET", "3000")))
+                args, env_float("DYN_BENCH_WALL_BUDGET"))
     try:
         record = _run_scenario(args)
     except BaseException as e:

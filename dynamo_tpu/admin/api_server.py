@@ -38,6 +38,7 @@ from aiohttp import web
 from ..llm.entry import MODEL_PREFIX, ModelEntry, register_model, remove_model
 from ..llm.model_card import MDC_PREFIX
 from ..runtime.component import INSTANCE_ROOT, EndpointInstance
+from ..runtime.config import env_str
 from ..runtime.dcp_client import pack, unpack
 from ..runtime.runtime import DistributedRuntime
 
@@ -190,7 +191,8 @@ class AdminApiServer:
                 out.append(EndpointInstance.from_dict(unpack(i.value))
                            .to_dict())
             except Exception:
-                pass
+                log.debug("skipping malformed instance record %s", i.key,
+                          exc_info=True)
         return web.json_response({"instances": out})
 
     async def _services(self, _req):
@@ -264,7 +266,6 @@ class AdminApiServer:
 
 def main(argv=None) -> int:
     import argparse
-    import os
 
     ap = argparse.ArgumentParser(prog="dynamo-admin")
     ap.add_argument("--host", default="0.0.0.0")
@@ -282,12 +283,12 @@ def main(argv=None) -> int:
     if args.tokens_file:
         with open(args.tokens_file) as f:
             tokens = _json.load(f)
-    elif os.environ.get("DYN_ADMIN_TOKENS"):
-        tokens = _json.loads(os.environ["DYN_ADMIN_TOKENS"])
+    elif env_str("DYN_ADMIN_TOKENS"):
+        tokens = _json.loads(env_str("DYN_ADMIN_TOKENS"))
 
     async def amain():
         drt = await DistributedRuntime.attach(
-            args.dcp or os.environ.get("DYN_DCP_ADDRESS"))
+            args.dcp or env_str("DYN_DCP_ADDRESS"))
         srv = AdminApiServer(drt, tokens=tokens)
         await srv.start(args.host, args.port)
         try:

@@ -20,6 +20,8 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional, Protocol
 
+from ..runtime.config import env_str
+
 # group/version/plural routing for the kinds the controller manages
 _ROUTES = {
     "DynamoDeployment": ("apis/dynamo-tpu.dev/v1alpha1", "dynamodeployments"),
@@ -79,8 +81,8 @@ class InClusterClient:
                  token: Optional[str] = None,
                  ca_path: Optional[str] = None):
         self.base = host or (
-            f"https://{os.environ['KUBERNETES_SERVICE_HOST']}:"
-            f"{os.environ.get('KUBERNETES_SERVICE_PORT', '443')}")
+            f"https://{env_str('KUBERNETES_SERVICE_HOST', required=True)}:"
+            f"{env_str('KUBERNETES_SERVICE_PORT')}")
         # bound service-account tokens rotate on disk (~hourly); keep the
         # PATH and re-read per request so the operator survives rotation
         self._token = token

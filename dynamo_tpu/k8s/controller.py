@@ -16,11 +16,11 @@ dynamo-tpu-operator``; it never touches anything else.
 
 from __future__ import annotations
 
+import asyncio
 import copy
 import json
 import hashlib
 import logging
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .client import KubeClient
@@ -312,23 +312,31 @@ class Reconciler:
 
     # ---------------------------------------------------------------- loop
 
-    def run(self, namespace: str, interval: float = 10.0) -> None:
+    async def run_async(self, namespace: str,
+                        interval: float = 10.0) -> None:
         """Poll-based level-triggered loop (a watch is an optimization the
         fake-client tests don't need; the reconcile itself is identical).
         Transient API failures (token rotation races, apiserver restarts)
         back off and retry — the operator pod must not crash-loop on
-        them."""
+        them. The reconcile pass itself is synchronous HTTP against the
+        apiserver, so it runs in a worker thread: anything else sharing
+        this event loop (health endpoints, future watches) keeps serving
+        during a slow pass, and the retry sleep never blocks the loop."""
         log.info("dynamo-tpu operator reconciling namespace %s", namespace)
         backoff = interval
         while True:
             try:
-                self.reconcile_all(namespace)
+                await asyncio.to_thread(self.reconcile_all, namespace)
                 backoff = interval
             except Exception:  # noqa: BLE001
                 log.exception("reconcile pass failed; backing off %.0fs",
                               backoff)
                 backoff = min(backoff * 2, 300.0)
-            time.sleep(backoff)
+            await asyncio.sleep(backoff)
+
+    def run(self, namespace: str, interval: float = 10.0) -> None:
+        """Blocking entrypoint for the operator main()."""
+        asyncio.run(self.run_async(namespace, interval))
 
 
 def main(argv=None) -> int:

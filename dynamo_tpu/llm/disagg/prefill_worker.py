@@ -40,7 +40,7 @@ class PrefillWorker:
                  max_inflight: int = 4,
                  compress_kv: Optional[bool] = None,
                  chunk_pages: Optional[int] = None):
-        import os
+        from ...runtime.config import env_bool, env_int
 
         self.drt = drt
         self.engine = engine
@@ -48,13 +48,12 @@ class PrefillWorker:
         # int8-compress shipped pages (~half the DCN bytes; lossy —
         # engine/kv_compress.py). Opt-in: arg, else DYN_KV_TRANSFER_INT8
         self.compress_kv = (compress_kv if compress_kv is not None
-                            else os.environ.get("DYN_KV_TRANSFER_INT8",
-                                                "") == "1")
+                            else env_bool("DYN_KV_TRANSFER_INT8"))
         # pages per streamed chunk frame; 0 = legacy single bulk frame.
         # Arg, else DYN_KV_TRANSFER_CHUNK_PAGES, else the default.
         if chunk_pages is None:
-            chunk_pages = int(os.environ.get("DYN_KV_TRANSFER_CHUNK_PAGES",
-                                             DEFAULT_CHUNK_PAGES))
+            chunk_pages = env_int("DYN_KV_TRANSFER_CHUNK_PAGES",
+                                  DEFAULT_CHUNK_PAGES)
         self.chunk_pages = max(int(chunk_pages), 0)
         self.queue = PrefillQueue(drt.dcp, namespace)
         self.max_inflight = max_inflight

@@ -15,6 +15,7 @@ from typing import Dict, Optional
 
 from ...runtime.dcp_client import unpack
 from ...runtime.runtime import DistributedRuntime
+from ...runtime.tasks import cancel_join, spawn_tracked
 from ..engines import RemoteOpenAIEngine
 from ..entry import MODEL_PREFIX, ModelEntry
 from .service import ModelManager
@@ -35,13 +36,12 @@ class ModelWatcher:
         self._watch = watch
         for item in items:
             await self._register(ModelEntry.from_dict(unpack(item.value)))
-        self._task = asyncio.create_task(self._loop())
+        self._task = spawn_tracked(self._loop(), name="model-watcher")
 
     async def stop(self) -> None:
         if self._watch:
             await self._watch.stop()
-        if self._task:
-            self._task.cancel()
+        await cancel_join(self._task)
 
     async def _loop(self) -> None:
         async for ev in self._watch:
@@ -64,7 +64,7 @@ class ModelWatcher:
             self.manager.add_completions_model(entry.name, engine)
         old = self._clients.pop(entry.kv_key(), None)
         if old is not None:  # re-registration (worker restart/card refresh)
-            asyncio.ensure_future(old.close())
+            spawn_tracked(old.close(), name="stale-client-close")
         self._clients[entry.kv_key()] = client
         log.info("discovered model %r -> %s", entry.name, entry.endpoint)
 
@@ -77,5 +77,5 @@ class ModelWatcher:
         self.manager.remove_model(name, model_type=mtype)
         client = self._clients.pop(kv_key, None)
         if client is not None:
-            asyncio.ensure_future(client.close())
+            spawn_tracked(client.close(), name="withdrawn-client-close")
         log.info("model %r withdrawn (type=%s)", name, mtype)

@@ -19,6 +19,7 @@ from typing import AsyncIterator, Callable, Dict, Optional
 from aiohttp import web
 
 from ...runtime.engine import Annotated, Context
+from ...runtime.tasks import spawn_tracked
 from ..protocols.openai import (ChatAggregator, ChatCompletionRequest,
                                 CompletionAggregator, CompletionRequest,
                                 ModelInfo, ModelList)
@@ -300,8 +301,9 @@ async def _fanout_choices(engine, req, ctx: Context, n: int):
         for k in kids:
             (k.kill if ctx.killed else k.stop_generating)()
 
-    tasks = [asyncio.ensure_future(pump(i)) for i in range(n)]
-    canceller = asyncio.ensure_future(propagate_cancel())
+    tasks = [spawn_tracked(pump(i), name=f"fanout-pump-{i}")
+             for i in range(n)]
+    canceller = spawn_tracked(propagate_cancel(), name="fanout-cancel")
     live = n
     merged_usage = None
     usage_template = None

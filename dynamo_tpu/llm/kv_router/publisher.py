@@ -15,6 +15,7 @@ from typing import Optional
 
 from ...engine.jax_engine import JaxEngine
 from ...runtime.dcp_client import DcpClient, pack
+from ...runtime.tasks import cancel_join, spawn_tracked
 from .protocols import KV_EVENT_SUBJECT, KvCacheEventWire
 
 log = logging.getLogger("dynamo_tpu.kv_router.publisher")
@@ -36,12 +37,11 @@ class KvEventPublisher:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.create_task(self._loop())
+            self._task = spawn_tracked(self._loop(), name="kv-event-pub")
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-            self._task = None
+        await cancel_join(self._task)
+        self._task = None
         await self.flush()
 
     async def flush(self) -> None:
@@ -129,12 +129,12 @@ class NativeEventBridge:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.create_task(self._loop())
+            self._task = spawn_tracked(self._loop(),
+                                       name="native-kv-event-bridge")
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-            self._task = None
+        await cancel_join(self._task)
+        self._task = None
         await self.flush()
 
     async def _loop(self) -> None:

@@ -17,6 +17,7 @@ from typing import Dict, Optional, Sequence
 from ...runtime.component import Client
 from ...runtime.dcp_client import DcpClient, pack, unpack
 from ...runtime.runtime import DistributedRuntime
+from ...runtime.tasks import backoff_interval, cancel_join, spawn_tracked
 from .indexer import KvIndexer, OverlapScores
 from .protocols import (KV_EVENT_SUBJECT, KV_HIT_RATE_SUBJECT,
                         ForwardPassMetrics, KvCacheEventWire)
@@ -55,16 +56,16 @@ class KvRouter:
         self._sid = await drt.dcp.subscribe(
             f"{self.namespace}.{self.component}.{KV_EVENT_SUBJECT}",
             self._on_events)
-        self._scrape_task = asyncio.create_task(self._scrape_loop())
+        self._scrape_task = spawn_tracked(self._scrape_loop(),
+                                          name="kv-router-scrape")
 
     async def stop(self) -> None:
         if self._sid is not None:
             try:
                 await self.drt.dcp.unsubscribe(self._sid)
             except Exception:
-                pass
-        if self._scrape_task:
-            self._scrape_task.cancel()
+                log.debug("unsubscribe failed during stop", exc_info=True)
+        await cancel_join(self._scrape_task)
         if self.client:
             await self.client.close()
 
@@ -78,12 +79,19 @@ class KvRouter:
             log.exception("bad kv event payload")
 
     async def _scrape_loop(self) -> None:
+        failures = 0
         while True:
             try:
                 await self.scrape_once()
+                failures = 0
             except Exception:
-                log.exception("stats scrape failed")
-            await asyncio.sleep(self.scrape_interval)
+                # bounded backoff: a worker pool that stays unreachable
+                # gets probed gently, and every failure is on the record
+                failures += 1
+                log.exception("stats scrape failed "
+                              "(%d consecutive failures)", failures)
+            await asyncio.sleep(
+                backoff_interval(self.scrape_interval, failures))
 
     async def scrape_once(self) -> None:
         """Scrape worker stats + reconcile live instances (reference
@@ -127,7 +135,7 @@ class KvRouter:
         self._hit_events += 1
         self._overlap_blocks_total += ev.overlap_blocks
         self._isl_blocks_total += ev.isl_blocks
-        asyncio.ensure_future(self._publish_hit_rate(ev))
+        spawn_tracked(self._publish_hit_rate(ev), name="kv-hit-rate-pub")
 
     async def _publish_hit_rate(self, ev) -> None:
         try:
@@ -135,7 +143,7 @@ class KvRouter:
                 f"{self.namespace}.{KV_HIT_RATE_SUBJECT}",
                 pack(ev.to_dict()))
         except Exception:
-            pass
+            log.debug("hit-rate publish failed", exc_info=True)
 
     def stats(self) -> dict:
         return {

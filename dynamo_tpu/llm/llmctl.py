@@ -13,10 +13,10 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import os
 import sys
 from typing import List, Optional
 
+from ..runtime.config import env_str
 from ..runtime.dcp_client import DcpClient
 from .entry import ModelEntry, list_models, register_model, remove_model
 
@@ -25,7 +25,7 @@ _KIND_TO_TYPE = {"chat-models": "chat", "completion-models": "completions",
 
 
 async def amain(args) -> int:
-    address = args.dcp or os.environ.get("DYN_DCP_ADDRESS", "127.0.0.1:6650")
+    address = args.dcp or env_str("DYN_DCP_ADDRESS", "127.0.0.1:6650")
     dcp = await DcpClient.connect(address)
     try:
         if args.verb == "add":

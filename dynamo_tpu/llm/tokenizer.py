@@ -19,8 +19,10 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Sequence
 
-os.environ.setdefault("HF_HUB_OFFLINE", "1")
-os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+from ..runtime.config import env_set_default
+
+env_set_default("HF_HUB_OFFLINE", "1")
+env_set_default("TRANSFORMERS_OFFLINE", "1")
 
 DEFAULT_CHAT_TEMPLATE = (
     "{% for message in messages %}"

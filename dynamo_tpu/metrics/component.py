@@ -18,8 +18,10 @@ from typing import Dict, Optional
 
 from ..llm.kv_router.protocols import KV_HIT_RATE_SUBJECT, ForwardPassMetrics
 from ..runtime.component import Client, EndpointAddress
+from ..runtime.config import env_str
 from ..runtime.dcp_client import unpack
 from ..runtime.runtime import DistributedRuntime
+from ..runtime.tasks import backoff_interval, cancel_join, spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.metrics")
 
@@ -48,16 +50,15 @@ class MetricsAggregator:
             self.address.component).endpoint(self.address.endpoint).client()
         self._sid = await self.drt.dcp.subscribe(
             f"{self.namespace}.{KV_HIT_RATE_SUBJECT}", self._on_hit_rate)
-        self._task = asyncio.create_task(self._loop())
+        self._task = spawn_tracked(self._loop(), name="metrics-scrape")
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
+        await cancel_join(self._task)
         if self._sid is not None:
             try:
                 await self.drt.dcp.unsubscribe(self._sid)
             except Exception:
-                pass
+                log.debug("unsubscribe failed during stop", exc_info=True)
         if self._client:
             await self._client.close()
 
@@ -68,12 +69,18 @@ class MetricsAggregator:
         self.hit_rate_overlap_blocks += int(ev.get("overlap_blocks", 0))
 
     async def _loop(self) -> None:
+        failures = 0
         while True:
             try:
                 await self.scrape_once()
+                failures = 0
             except Exception:
-                log.exception("metrics scrape failed")
-            await asyncio.sleep(self.interval)
+                # bounded backoff: a persistently-down stats plane gets
+                # polled gently instead of hammered every interval forever
+                failures += 1
+                log.exception("metrics scrape failed "
+                              "(%d consecutive failures)", failures)
+            await asyncio.sleep(backoff_interval(self.interval, failures))
 
     async def scrape_once(self) -> None:
         stats = await self._client.collect_stats()
@@ -196,7 +203,6 @@ def main(argv=None) -> int:
     """Standalone aggregator process (reference components/metrics
     src/main.rs)."""
     import argparse
-    import os
 
     ap = argparse.ArgumentParser(prog="dynamo-metrics")
     ap.add_argument("--namespace", default="dynamo")
@@ -208,7 +214,7 @@ def main(argv=None) -> int:
 
     async def amain():
         drt = await DistributedRuntime.attach(
-            args.dcp or os.environ.get("DYN_DCP_ADDRESS"))
+            args.dcp or env_str("DYN_DCP_ADDRESS"))
         agg, runner = await serve_metrics(
             drt, args.namespace, args.component,
             endpoint=args.endpoint, port=args.port)

@@ -12,8 +12,10 @@ from typing import Optional
 
 from ..llm.kv_router.protocols import (KV_HIT_RATE_SUBJECT,
                                        ForwardPassMetrics)
+from ..runtime.config import env_str
 from ..runtime.dcp_client import pack
 from ..runtime.runtime import DistributedRuntime
+from ..runtime.tasks import cancel_join, spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.metrics.mock")
 
@@ -53,11 +55,11 @@ class MockWorker:
         await comp.create_service()
         self._handle = await comp.endpoint(self.endpoint).serve(
             handler, stats_handler=self._stats)
-        self._task = asyncio.create_task(self._hit_rate_loop())
+        self._task = spawn_tracked(self._hit_rate_loop(),
+                                   name="mock-hit-rate")
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
+        await cancel_join(self._task)
         if self._handle:
             await self._handle.stop()
 
@@ -73,7 +75,6 @@ class MockWorker:
 
 def main(argv=None) -> int:
     import argparse
-    import os
 
     ap = argparse.ArgumentParser(prog="dynamo-mock-worker")
     ap.add_argument("--namespace", default="dynamo")
@@ -83,7 +84,7 @@ def main(argv=None) -> int:
 
     async def amain():
         drt = await DistributedRuntime.attach(
-            args.dcp or os.environ.get("DYN_DCP_ADDRESS"))
+            args.dcp or env_str("DYN_DCP_ADDRESS"))
         w = MockWorker(drt, args.namespace, args.component)
         await w.start()
         try:

@@ -25,7 +25,6 @@ the scheduler (dynamo_tpu/engine/scheduler.py).
 from __future__ import annotations
 
 import math
-import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, Optional, Tuple
@@ -39,6 +38,7 @@ from ..ops.paged_attention import (effective_window,
                                    paged_attention_decode_sharded,
                                    paged_attention_prefill,
                                    paged_attention_prefill_sharded)
+from ..runtime.config import env_flag, env_int
 from .config import ModelConfig
 
 Params = Dict[str, jax.Array]
@@ -250,7 +250,7 @@ def _scatter_pages(cache_layer: jax.Array, new: jax.Array,
 def _use_pallas() -> bool:
     """Route decode attention through the Pallas kernel on TPU backends
     (DYN_DISABLE_PALLAS=1 forces the XLA gather path everywhere)."""
-    if os.environ.get("DYN_DISABLE_PALLAS"):
+    if env_flag("DYN_DISABLE_PALLAS"):
         return False
     try:
         return jax.default_backend() == "tpu"
@@ -298,8 +298,8 @@ def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     # path in interpret mode — but NEVER on a real TPU backend (a
     # lingering env var must not silently interpret-mode a hardware
     # bench), and never past the DYN_DISABLE_PALLAS kill switch
-    interp = (bool(os.environ.get("DYN_PALLAS_INTERPRET"))
-              and not os.environ.get("DYN_DISABLE_PALLAS")
+    interp = (env_flag("DYN_PALLAS_INTERPRET")
+              and not env_flag("DYN_DISABLE_PALLAS")
               and not _use_pallas())
     B, T, H, hd = q.shape
     KV = k_pages.shape[1]
@@ -335,7 +335,7 @@ def _attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                 q[:, 0], k_pages, v_pages, page_table,
                 lengths, scale=scale, softcap=softcap,
                 lower=lower)[:, None]
-    if (T > 1 and pallas_ok and os.environ.get("DYN_PREFILL_PALLAS")):
+    if (T > 1 and pallas_ok and env_flag("DYN_PREFILL_PALLAS")):
         # opt-in flash prefill (any non-empty value, like the sibling
         # DYN_DISABLE_PALLAS flag): pages stream through VMEM instead of
         # the XLA path's dense [B, P*ps, KV, hd] gather per layer
@@ -519,7 +519,7 @@ def moe_experts_blocked(x: jax.Array, weights: jax.Array, idx: jax.Array,
 
 # scanned block height for the sorted dispatch (MXU-friendly; also the
 # per-expert padding quantum, so it enters the cost model below)
-_MOE_BLOCK = int(os.environ.get("DYN_MOE_BLOCK", "256"))
+_MOE_BLOCK = env_int("DYN_MOE_BLOCK")
 
 
 def _moe_use_blocked(mesh, n_tokens: int, n_experts: int,
@@ -803,8 +803,8 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
     # the same CPU test hook _attention honors: engine-level window tests
     # drive the kernel path in interpret mode (never on a real TPU)
     pallas_interpret = pallas_interpret or (
-        bool(os.environ.get("DYN_PALLAS_INTERPRET"))
-        and not os.environ.get("DYN_DISABLE_PALLAS")
+        env_flag("DYN_PALLAS_INTERPRET")
+        and not env_flag("DYN_DISABLE_PALLAS")
         and not _use_pallas())
     use_pallas = (allow_pallas and (_use_pallas() or pallas_interpret)
                   and cfg.num_kv_heads % max(tp, 1) == 0)

@@ -26,8 +26,10 @@ from typing import Dict, List, Optional
 
 from ..llm.kv_router.protocols import ForwardPassMetrics
 from ..runtime.component import Client
+from ..runtime.config import env_str
 from ..runtime.dcp_client import pack, unpack
 from ..runtime.runtime import DistributedRuntime
+from ..runtime.tasks import cancel_join, spawn_tracked
 from .policy import (PLANNER_ADVISORY_SUBJECT, PLANNER_KV_PREFIX,
                      ComponentSnapshot, PlannerConfig, ScaleAdvisory, decide)
 
@@ -72,15 +74,13 @@ class Planner:
             self._clients[t.component] = await self.drt.namespace(
                 self.namespace).component(t.component).endpoint(
                 t.endpoint).client()
-        self._task = asyncio.create_task(self._loop())
+        self._task = spawn_tracked(self._loop(), name="planner-tick")
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-            # wait the cancellation out before closing the clients the
-            # in-flight tick may still be using
-            await asyncio.gather(self._task, return_exceptions=True)
-            self._task = None
+        # wait the cancellation out before closing the clients the
+        # in-flight tick may still be using
+        await cancel_join(self._task)
+        self._task = None
         for c in self._clients.values():
             await c.close()
         self._clients.clear()
@@ -192,7 +192,6 @@ def main(argv=None) -> int:
             --queue prefill_queue --apply --deployment my-graph
     """
     import argparse
-    import os
 
     ap = argparse.ArgumentParser(prog="dynamo-planner")
     ap.add_argument("--namespace", default="dynamo")
@@ -219,7 +218,7 @@ def main(argv=None) -> int:
 
     async def amain():
         drt = await DistributedRuntime.attach(
-            args.dcp or os.environ.get("DYN_DCP_ADDRESS"))
+            args.dcp or env_str("DYN_DCP_ADDRESS"))
         planner = Planner(drt, args.namespace, targets,
                           interval=args.interval, apply=args.apply)
         await planner.start()

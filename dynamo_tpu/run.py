@@ -33,10 +33,11 @@ import argparse
 import asyncio
 import json
 import logging
-import os
 import signal
 import sys
 import time
+
+from .runtime.config import env_str
 from typing import Optional, Tuple
 
 log = logging.getLogger("dynamo_tpu.run")
@@ -99,7 +100,7 @@ def parse_args(argv=None):
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--max-tokens", type=int, default=128,
                     help="text/batch mode generation cap")
-    ap.add_argument("--profile-dir", default=os.environ.get(
+    ap.add_argument("--profile-dir", default=env_str(
         "DYN_PROFILE_DIR"), help="capture a JAX/XLA profiler trace of the "
         "serving session into this directory (view with xprof/tensorboard)")
     ap.add_argument("--seed", type=int, default=0)
@@ -375,12 +376,18 @@ async def run_batch(args, path: str) -> None:
 
     engine, mdc, full = build_engine(args)
     chain = engine if full else LocalChatChain(mdc, engine)
-    entries = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                entries.append(json.loads(line))
+
+    def _read_jsonl() -> list:
+        entries = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    entries.append(json.loads(line))
+        return entries
+
+    # file IO off the event loop: batch inputs can be large
+    entries = await asyncio.to_thread(_read_jsonl)
     results = []
     t0 = time.monotonic()
 
@@ -454,7 +461,7 @@ async def run_none(args) -> None:
 async def _attach(args):
     from .runtime.runtime import DistributedRuntime
 
-    address = args.dcp or os.environ.get("DYN_DCP_ADDRESS")
+    address = args.dcp or env_str("DYN_DCP_ADDRESS")
     if address:
         return await DistributedRuntime.attach(address)
     log.warning("no control plane configured; starting embedded DCP server")
@@ -508,7 +515,7 @@ async def _dispatch(args) -> int:
 
 
 def main(argv=None) -> int:
-    logging.basicConfig(level=os.environ.get("DYN_LOG", "INFO"))
+    logging.basicConfig(level=env_str("DYN_LOG"))
     return asyncio.run(amain(parse_args(argv)))
 
 

@@ -13,6 +13,7 @@ from .dcp_client import (DcpClient, DcpError, KvItem, Message,
 from .dcp_server import DcpServer
 from .engine import Annotated, AsyncEngine, Context
 from .runtime import (DistributedRuntime, Runtime, Worker, dynamo_worker)
+from .tasks import backoff_interval, cancel_join, spawn_tracked
 from .tcp import TcpCallHome, TcpConnectionInfo, TcpStreamServer
 
 __all__ = [
@@ -21,6 +22,7 @@ __all__ = [
     "Endpoint", "EndpointAddress", "EndpointInstance", "KvItem", "Message",
     "Namespace", "NoRespondersError", "PrefixWatch", "Runtime",
     "RuntimeConfig", "TcpCallHome", "TcpConnectionInfo", "TcpStreamServer",
-    "TwoPartMessage", "WatchEvent", "Worker", "decode_buffer", "dynamo_worker",
-    "encode", "pack", "unpack",
+    "TwoPartMessage", "WatchEvent", "Worker", "backoff_interval",
+    "cancel_join", "decode_buffer", "dynamo_worker", "encode", "pack",
+    "spawn_tracked", "unpack",
 ]

@@ -20,6 +20,7 @@ from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
 from .codec import TwoPartMessage
 from .dcp_client import DcpClient, Message, NoRespondersError, pack, unpack
 from .engine import Annotated, Context
+from .tasks import cancel_join, spawn_tracked
 from .tcp import (STREAM_COMPLETE, StreamError, TcpCallHome, TcpConnectionInfo,
                   TcpStreamServer)
 
@@ -221,7 +222,8 @@ class ServeHandle:
             try:
                 await drt.dcp.unsubscribe(sid)
             except Exception:
-                pass
+                log.debug("unsubscribe %d failed during stop", sid,
+                          exc_info=True)
         key = instance_key(self.instance.namespace, self.instance.component,
                            self.instance.endpoint, self.instance.instance_id)
         try:
@@ -260,7 +262,8 @@ class ServeHandle:
         if msg.needs_reply:
             await msg.respond(pack({"accepted": True,
                                     "instance_id": self.instance.instance_id}))
-        asyncio.ensure_future(self._run_request(req_id, conn_info, request))
+        spawn_tracked(self._run_request(req_id, conn_info, request),
+                      name=f"serve-{req_id}")
 
     async def _run_request(self, req_id: str, conn_info: TcpConnectionInfo,
                            request: Any) -> None:
@@ -363,7 +366,8 @@ class Client:
         if self.instances:
             self._instances_event.set()
         self._watch = watch
-        self._watch_task = asyncio.create_task(self._watch_loop())
+        self._watch_task = spawn_tracked(
+            self._watch_loop(), name=f"client-watch-{self.address}")
 
     async def _watch_loop(self) -> None:
         async for ev in self._watch:
@@ -383,8 +387,7 @@ class Client:
     async def close(self) -> None:
         if self._watch:
             await self._watch.stop()
-        if self._watch_task:
-            self._watch_task.cancel()
+        await cancel_join(self._watch_task)
 
     def instance_ids(self) -> List[int]:
         return sorted(self.instances)

@@ -1,17 +1,213 @@
-"""Layered runtime configuration.
+"""Layered runtime configuration + the environment-variable registry.
 
 Reference lib/runtime/src/config.rs: figment-layered settings from env
 (``DYN_WORKER_*`` / ``DYN_RUNTIME_*``) + optional TOML. Here: env
 (``DYN_*``) + optional YAML/JSON file named by ``DYN_CONFIG_PATH``.
+
+This module is also the single place in the tree allowed to touch
+``os.environ`` (enforced by dynalint rule ``untracked-env-read``): every
+knob the fleet reads is declared in :data:`ENV_REGISTRY` with a default,
+an owning component, and a description, and read through the typed
+``env_*`` helpers. ``docs/env_vars.md`` is generated from the registry
+(``python -m tools.dynalint --write-env-docs docs/env_vars.md``) and
+tier-1 asserts it stays in sync — an undeclared knob fails the build.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from dataclasses import dataclass, field, fields
-from typing import Optional
+from dataclasses import dataclass, fields
+from typing import Dict, Optional
 
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One registered environment knob (name, documented default, owning
+    component, human description)."""
+
+    name: str
+    default: Optional[str]
+    component: str
+    description: str
+
+
+ENV_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def register_env(name: str, default: Optional[str], component: str,
+                 description: str) -> str:
+    ENV_REGISTRY[name] = EnvVar(name, default, component, description)
+    return name
+
+
+# ------------------------------------------------------------- the registry
+# Keep alphabetical within each component block; docs/env_vars.md renders
+# straight from this table.
+
+register_env("DYN_CONFIG_PATH", None, "runtime",
+             "Path to a YAML/JSON RuntimeConfig overlay file.")
+register_env("DYN_DCP_ADDRESS", None, "runtime",
+             "host:port of the DCP control plane. Unset: workers embed an "
+             "in-process server; CLIs fall back to 127.0.0.1:6650.")
+register_env("DYN_LEASE_TTL", "10.0", "runtime",
+             "Primary-lease TTL in seconds (worker liveness).")
+register_env("DYN_LOG", "INFO", "runtime",
+             "Root log level (DEBUG/INFO/WARNING/...).")
+register_env("DYN_LOGGING_JSONL", "0", "runtime",
+             "Emit JSONL structured logs instead of text (1/true).")
+register_env("DYN_REQUEST_TIMEOUT", "60.0", "runtime",
+             "Default request-plane timeout in seconds.")
+
+register_env("DYN_ADMIN_TOKENS", None, "admin",
+             "Inline JSON token map for the admin API (absent = open API).")
+
+register_env("DYN_KV_TRANSFER_CHUNK_PAGES", "4", "llm/disagg",
+             "KV pages per streamed transfer chunk frame; 0 = legacy "
+             "single bulk frame.")
+register_env("DYN_KV_TRANSFER_INT8", "0", "llm/disagg",
+             "int8-compress shipped KV pages (~half the DCN bytes; "
+             "lossy). 1/true enables.")
+
+register_env("DYN_DISABLE_PALLAS", None, "models",
+             "Any non-empty value forces the XLA gather attention path "
+             "everywhere (Pallas kill switch).")
+register_env("DYN_MOE_BLOCK", "256", "models",
+             "Scanned block height for the sorted MoE dispatch.")
+register_env("DYN_PALLAS_INTERPRET", None, "models",
+             "CPU test hook: any non-empty value runs Pallas kernels in "
+             "interpret mode (never on a real TPU backend).")
+register_env("DYN_PREFILL_PALLAS", None, "models",
+             "Any non-empty value opts prefill into the flash Pallas "
+             "kernel (pages stream through VMEM).")
+
+register_env("DYN_DISABLE_NATIVE", None, "utils",
+             "Any non-empty value disables building/loading the native "
+             "C++ helper library.")
+register_env("DYN_PROFILE_DIR", None, "run",
+             "Capture a JAX/XLA profiler trace of the serving session "
+             "into this directory.")
+
+register_env("DYN_BENCH_PROBE_TIMEOUT", "240", "bench",
+             "bench.py: seconds allowed for the server-readiness probe.")
+register_env("DYN_BENCH_REQ_TIMEOUT", "600", "bench",
+             "bench.py: per-request timeout in seconds.")
+register_env("DYN_BENCH_WALL_BUDGET", "3000", "bench",
+             "bench.py: total wall-clock budget in seconds.")
+
+register_env("DYN_TEST_TPU", None, "tests",
+             "Set to run the test suite against real TPU hardware instead "
+             "of the forced-CPU 8-device virtual mesh.")
+
+register_env("DYNAMO_SERVICE_CONFIG", None, "sdk",
+             "Inline JSON ServiceConfig ({service: {key: value}}) "
+             "injected into @service workers by `dynamo serve`.")
+
+# Externally-defined variables the tree reads (documented here so the
+# full environment surface is one table; defaults are the upstream ones).
+register_env("HF_HUB_OFFLINE", "1", "external",
+             "Set by dynamo_tpu.llm.tokenizer unless already present: "
+             "never hit the HuggingFace hub at serve time.")
+register_env("TRANSFORMERS_OFFLINE", "1", "external",
+             "Set alongside HF_HUB_OFFLINE for the transformers library.")
+register_env("KUBERNETES_SERVICE_HOST", None, "external",
+             "In-cluster apiserver host (set by kubelet); required by the "
+             "operator's InClusterClient.")
+register_env("KUBERNETES_SERVICE_PORT", "443", "external",
+             "In-cluster apiserver port.")
+register_env("JAX_PLATFORMS", None, "external",
+             "JAX backend selector; the SDK/bench pin control-plane "
+             "processes to cpu so only TPU workers touch the chip.")
+
+
+class UnregisteredEnvVar(KeyError):
+    """Reading an env var that is not in ENV_REGISTRY: register it in
+    runtime/config.py so it lands in docs/env_vars.md."""
+
+
+def _lookup(name: str) -> EnvVar:
+    var = ENV_REGISTRY.get(name)
+    if var is None:
+        raise UnregisteredEnvVar(
+            f"env var {name!r} is not registered; declare it in "
+            f"dynamo_tpu/runtime/config.py (register_env) so it is "
+            f"documented in docs/env_vars.md")
+    return var
+
+
+def env_str(name: str, default: Optional[str] = None, *,
+            required: bool = False) -> Optional[str]:
+    """The registered variable's value, else the explicit ``default``,
+    else the registry default. ``required=True`` raises when unset."""
+    var = _lookup(name)
+    val = os.environ.get(name)
+    if val is None:
+        val = default if default is not None else var.default
+    if val is None and required:
+        raise KeyError(f"required env var {name} is not set")
+    return val
+
+
+def env_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    val = env_str(name, None if default is None else str(default))
+    return None if val is None else int(val)
+
+
+def env_float(name: str, default: Optional[float] = None) -> Optional[float]:
+    val = env_str(name, None if default is None else str(default))
+    return None if val is None else float(val)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    """Truthy string values: 1/true/yes/on (case-insensitive)."""
+    val = env_str(name)
+    if val is None or val == "":
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_flag(name: str) -> bool:
+    """Reference semantics for DYN_DISABLE_* style switches: ANY non-empty
+    value (even '0') enables the flag."""
+    _lookup(name)
+    return bool(os.environ.get(name))
+
+
+def env_set_default(name: str, value: str) -> None:
+    """Registered setdefault (import-time offline pins and the like)."""
+    _lookup(name)
+    os.environ.setdefault(name, value)
+
+
+def render_env_docs() -> str:
+    """docs/env_vars.md content, generated from the registry."""
+    lines = [
+        "# Environment variables",
+        "",
+        "Generated from `dynamo_tpu/runtime/config.py` — do not edit by "
+        "hand. Regenerate with:",
+        "",
+        "```",
+        "python -m tools.dynalint --write-env-docs docs/env_vars.md",
+        "```",
+        "",
+        "Every env read in the tree goes through this registry's typed "
+        "helpers (`env_str`/`env_int`/`env_float`/`env_bool`/`env_flag`); "
+        "dynalint rule `untracked-env-read` rejects direct `os.environ` "
+        "access anywhere else, so this table is the complete knob surface.",
+        "",
+        "| Variable | Default | Component | Description |",
+        "|---|---|---|---|",
+    ]
+    for var in sorted(ENV_REGISTRY.values(),
+                      key=lambda v: (v.component, v.name)):
+        default = "(unset)" if var.default is None else f"`{var.default}`"
+        lines.append(f"| `{var.name}` | {default} | {var.component} "
+                     f"| {var.description} |")
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------- RuntimeConfig
 
 @dataclass
 class RuntimeConfig:
@@ -24,7 +220,7 @@ class RuntimeConfig:
     @classmethod
     def from_settings(cls) -> "RuntimeConfig":
         cfg = cls()
-        path = os.environ.get("DYN_CONFIG_PATH")
+        path = env_str("DYN_CONFIG_PATH")
         if path and os.path.exists(path):
             with open(path) as f:
                 if path.endswith((".yaml", ".yml")):
@@ -41,7 +237,8 @@ class RuntimeConfig:
             "DYN_LEASE_TTL": ("lease_ttl", float),
             "DYN_REQUEST_TIMEOUT": ("request_timeout", float),
             "DYN_LOG": ("log_level", str),
-            "DYN_LOGGING_JSONL": ("log_jsonl", lambda v: v.lower() in ("1", "true")),
+            "DYN_LOGGING_JSONL": ("log_jsonl",
+                                  lambda v: v.lower() in ("1", "true")),
         }
         for env, (name, conv) in env_map.items():
             if env in os.environ:

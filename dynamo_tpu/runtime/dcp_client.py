@@ -18,6 +18,7 @@ from typing import AsyncIterator, Awaitable, Callable, Dict, List, Optional, Tup
 import msgpack
 
 from .dcp_server import pack_frame, read_frame
+from .tasks import cancel_join, spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.dcp.client")
 
@@ -75,14 +76,14 @@ class DcpClient:
         self = cls()
         host, _, port = address.rpartition(":")
         self._reader, self._writer = await asyncio.open_connection(host, int(port))
-        self._rx_task = asyncio.create_task(self._rx_loop())
+        self._rx_task = spawn_tracked(self._rx_loop(),
+                                      name=f"dcp-client-rx-{address}")
         self.address = address
         return self
 
     async def close(self) -> None:
         self._closed = True
-        if self._rx_task:
-            self._rx_task.cancel()
+        await cancel_join(self._rx_task)
         if self._writer:
             try:
                 self._writer.close()
@@ -132,7 +133,8 @@ class DcpClient:
         elif kind in ("msg", "req"):
             handler = self._sub_handlers.get(msg["sid"])
             if handler is not None:
-                asyncio.ensure_future(self._run_handler(handler, msg))
+                spawn_tracked(self._run_handler(handler, msg),
+                              name=f"dcp-sub-{msg.get('subject')}")
             elif kind == "req":
                 await self._send_raw(
                     {"op": "reply", "seq": next(self._seq), "reply": msg["reply"],

@@ -39,6 +39,8 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import msgpack
 
+from .tasks import cancel_join, spawn_tracked
+
 log = logging.getLogger("dynamo_tpu.dcp")
 
 MAX_FRAME = 64 * 1024 * 1024
@@ -122,7 +124,8 @@ class _Conn:
         self.id = conn_id
         self.alive = True
         self._outq: asyncio.Queue = asyncio.Queue()
-        self._wtask = asyncio.create_task(self._writer_loop())
+        self._wtask = spawn_tracked(self._writer_loop(),
+                                    name=f"dcp-conn-{conn_id}-writer")
 
     async def _writer_loop(self) -> None:
         try:
@@ -198,7 +201,8 @@ class DcpServer:
         self._server = await asyncio.start_server(self._on_conn, host, port)
         sock = self._server.sockets[0]
         self.host, self.port = sock.getsockname()[:2]
-        self._lease_task = asyncio.create_task(self._lease_reaper())
+        self._lease_task = spawn_tracked(self._lease_reaper(),
+                                         name="dcp-lease-reaper")
         log.info("dcp server listening on %s:%d", self.host, self.port)
         return self
 
@@ -216,8 +220,7 @@ class DcpServer:
             j.snapshot(self._rev, self._durable_kv(), self._queues)
 
     async def stop(self) -> None:
-        if self._lease_task:
-            self._lease_task.cancel()
+        await cancel_join(self._lease_task)
         if self._server:
             self._server.close()
         # close live connections so wait_closed() (which waits for all
@@ -252,7 +255,8 @@ class DcpServer:
             while True:
                 msg = await read_frame(reader)
                 if msg.get("op") in self._BLOCKING_OPS:
-                    asyncio.ensure_future(self._dispatch(conn, msg))
+                    spawn_tracked(self._dispatch(conn, msg),
+                                  name=f"dcp-op-{msg.get('op')}")
                 else:
                     await self._dispatch(conn, msg)
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -306,12 +310,12 @@ class DcpServer:
     def _notify_watchers(self, event: str, key: str, value: Optional[bytes]) -> None:
         for w in list(self._watches.values()):
             if key.startswith(w.prefix):
-                asyncio.ensure_future(
+                spawn_tracked(
                     w.conn.send(
                         {"push": "watch", "watch_id": w.watch_id, "event": event,
                          "key": key, "value": value}
-                    )
-                )
+                    ),
+                    name="dcp-watch-notify")
 
     async def _op_kv_put(self, conn, msg):
         key, value, lease = msg["key"], msg["value"], msg.get("lease", 0)

@@ -6,9 +6,10 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import sys
 import time
+
+from .config import env_bool, env_str
 
 
 class JsonlFormatter(logging.Formatter):
@@ -32,9 +33,9 @@ def init(level: str | None = None, jsonl: bool | None = None) -> None:
     if _initialized:
         return
     _initialized = True
-    level = level or os.environ.get("DYN_LOG", "INFO").upper()
+    level = (level or env_str("DYN_LOG")).upper()
     if jsonl is None:
-        jsonl = os.environ.get("DYN_LOGGING_JSONL", "").lower() in ("1", "true")
+        jsonl = env_bool("DYN_LOGGING_JSONL")
     handler = logging.StreamHandler(sys.stderr)
     if jsonl:
         handler.setFormatter(JsonlFormatter())

@@ -12,19 +12,19 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 import signal
 from typing import Awaitable, Callable, Optional
 
 from .component import Namespace
-from .config import RuntimeConfig
+from .config import RuntimeConfig, env_str
 from .dcp_client import DcpClient, KeepaliveThread
 from .dcp_server import DcpServer
+from .tasks import spawn_tracked
 from .tcp import TcpStreamServer
 
 log = logging.getLogger("dynamo_tpu.runtime")
 
-DEFAULT_DCP = os.environ.get("DYN_DCP_ADDRESS", "127.0.0.1:6650")
+DEFAULT_DCP = env_str("DYN_DCP_ADDRESS", "127.0.0.1:6650")
 
 
 class Runtime:
@@ -53,7 +53,7 @@ class Runtime:
             await self._shutdown.wait()
             ev.set()
 
-        asyncio.ensure_future(_link())
+        spawn_tracked(_link(), name="runtime-shutdown-link")
         return ev
 
 

@@ -1,0 +1,80 @@
+"""Tracked background tasks: the dynalint-mandated replacement for bare
+``asyncio.create_task`` / ``asyncio.ensure_future``.
+
+The Rust reference gets this from the type system: an ``AsyncEngine``
+task handle must be joined or aborted, and a dropped ``JoinHandle``
+detaches loudly. A bare Python task, by contrast, swallows its exception
+until the object is GC'd (the "Task exception was never retrieved" log
+nobody sees) and keeps only a weak reference in the loop, so it can even
+be collected mid-flight. Every background task in this codebase goes
+through :func:`spawn_tracked`, which pins a strong reference and logs
+crashes at error level the moment they happen, and every ``stop()`` path
+goes through :func:`cancel_join`, which bounds how long a wedged task
+can stall shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Coroutine, Optional, Set
+
+log = logging.getLogger("dynamo_tpu.tasks")
+
+# strong refs: the event loop only keeps weak ones, so a fire-and-forget
+# task with no other referent can be GC'd before it finishes
+_BACKGROUND: Set[asyncio.Task] = set()
+
+
+def spawn_tracked(coro: Coroutine, *, name: Optional[str] = None,
+                  logger: Optional[logging.Logger] = None) -> asyncio.Task:
+    """``asyncio.create_task`` + crash logging + GC pinning.
+
+    The returned task is still a plain :class:`asyncio.Task` — await it,
+    cancel it, or hand it to :func:`cancel_join` on stop. Exceptions that
+    would otherwise vanish are logged (and marked retrieved) the moment
+    the task finishes.
+    """
+    task = asyncio.create_task(coro, name=name)  # dynalint: disable=fire-and-forget-task
+    _BACKGROUND.add(task)
+    task.add_done_callback(lambda t: _on_task_done(t, logger or log))
+    return task
+
+
+def _on_task_done(task: asyncio.Task, logger: logging.Logger) -> None:
+    _BACKGROUND.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()  # marks the exception retrieved
+    if exc is not None:
+        logger.error("background task %r crashed", task.get_name(),
+                     exc_info=exc)
+
+
+async def cancel_join(*tasks: Optional[asyncio.Task],
+                      timeout: float = 5.0) -> None:
+    """Cancel task(s) and wait for them to actually exit.
+
+    ``None`` entries are skipped so ``await cancel_join(self._task)``
+    works before ``start()``. A task that ignores cancellation for
+    ``timeout`` seconds is abandoned with a warning instead of wedging
+    the caller's shutdown forever.
+    """
+    live = [t for t in tasks if t is not None]
+    for t in live:
+        t.cancel()
+    if not live:
+        return
+    _done, pending = await asyncio.wait(live, timeout=timeout)
+    for t in pending:
+        log.warning("task %r ignored cancellation for %.1fs; abandoning",
+                    t.get_name(), timeout)
+
+
+def backoff_interval(base: float, failures: int, cap: float = 30.0) -> float:
+    """Bounded exponential backoff for scrape/poll loops: ``base`` while
+    healthy, doubling per consecutive failure up to ``cap`` — a
+    persistently-failing dependency gets polled gently, not hammered."""
+    if failures <= 0:
+        return base
+    return min(base * (2.0 ** min(failures, 16)), max(cap, base))

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 from typing import Dict, Optional
 
 from .codec import TwoPartMessage, decode, encode
+from .tasks import cancel_join, spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.tcp")
 
@@ -70,7 +71,8 @@ class PendingStream:
         self._writer = writer
         self._connected.set()
         for kind in self._pending_ctrl:
-            asyncio.ensure_future(self.send_ctrl(kind))
+            spawn_tracked(self.send_ctrl(kind),
+                          name=f"tcp-ctrl-flush-{kind}")
         self._pending_ctrl.clear()
 
     async def wait_connected(self, timeout: float = 30.0) -> None:
@@ -136,7 +138,7 @@ class TcpStreamServer:
             try:
                 w.close()
             except Exception:
-                pass
+                log.debug("writer close failed during stop", exc_info=True)
         if self._server:
             try:
                 await asyncio.wait_for(self._server.wait_closed(), 5.0)
@@ -209,7 +211,8 @@ class TcpCallHome:
         self._writer = writer
         self._on_ctrl = on_ctrl
         self._wlock = asyncio.Lock()
-        self._ctrl_task = asyncio.create_task(self._ctrl_loop())
+        self._ctrl_task = spawn_tracked(self._ctrl_loop(),
+                                        name="tcp-callhome-ctrl")
 
     @classmethod
     async def connect(cls, info: TcpConnectionInfo, on_ctrl=None,
@@ -249,7 +252,7 @@ class TcpCallHome:
                                          "kind": kind}))
 
     async def close(self) -> None:
-        self._ctrl_task.cancel()
+        await cancel_join(self._ctrl_task)
         try:
             self._writer.close()
             await self._writer.wait_closed()

@@ -26,6 +26,7 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Tuple
 
+from ..runtime.config import env_str
 from .config import ENV_KEY, ServiceConfig
 from .service import DynamoService
 
@@ -163,7 +164,7 @@ async def cmd_serve_worker(args) -> int:
         except NotImplementedError:
             pass
     drt = await DistributedRuntime.attach(
-        os.environ.get("DYN_DCP_ADDRESS"), runtime)
+        env_str("DYN_DCP_ADDRESS"), runtime)
     worker = ServiceWorker(svc, drt, cfg)
     try:
         await worker.start()
@@ -175,7 +176,7 @@ async def cmd_serve_worker(args) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    logging.basicConfig(level=os.environ.get("DYN_LOG", "INFO"))
+    logging.basicConfig(level=env_str("DYN_LOG"))
     ap = argparse.ArgumentParser(prog="dynamo")
     sub = ap.add_subparsers(dest="cmd", required=True)
 

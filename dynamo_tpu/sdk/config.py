@@ -5,8 +5,9 @@ exploded into the service instance)."""
 from __future__ import annotations
 
 import json
-import os
 from typing import Any, Dict, Optional
+
+from ..runtime.config import env_str
 
 ENV_KEY = "DYNAMO_SERVICE_CONFIG"
 
@@ -33,7 +34,7 @@ class ServiceConfig:
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
-        raw = os.environ.get(ENV_KEY)
+        raw = env_str(ENV_KEY)
         return cls(json.loads(raw)) if raw else cls()
 
     @classmethod

@@ -1,0 +1,409 @@
+"""Tier-1 self-enforcement of the dynalint static-analysis suite.
+
+Three layers:
+
+1. **The gate** — the analyzer runs over ``dynamo_tpu/``, ``bench.py``
+   and ``tools/`` and fails on any violation not grandfathered in
+   ``tools/dynalint/baseline.txt`` (ratchet-only: the baseline may
+   shrink, never grow).
+2. **Per-rule fixtures** — every rule demonstrably fires on its bad
+   snippet and stays quiet on its good one, plus suppression-comment
+   and baseline-ratchet behavior.
+3. **Generated artifacts** — ``docs/env_vars.md`` must match the env
+   registry, and the optional ruff gate runs when ruff is installed.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.dynalint import (analyze_paths, analyze_source,  # noqa: E402
+                            apply_baseline, load_baseline)
+
+BASELINE = os.path.join(REPO, "tools", "dynalint", "baseline.txt")
+GATE_PATHS = [os.path.join(REPO, "dynamo_tpu"),
+              os.path.join(REPO, "bench.py"),
+              os.path.join(REPO, "tools")]
+
+
+def lint(src: str, path: str = "dynamo_tpu/fixture.py"):
+    return analyze_source(src, path)
+
+
+def codes(src: str, path: str = "dynamo_tpu/fixture.py"):
+    return [v.code for v in lint(src, path)]
+
+
+# ------------------------------------------------------------------ the gate
+
+
+def test_repo_is_dynalint_clean():
+    """The analyzer is green on its own repo modulo the baseline."""
+    violations = analyze_paths(GATE_PATHS, root=REPO)
+    allowed = load_baseline(BASELINE) if os.path.exists(BASELINE) else {}
+    fresh, _stale = apply_baseline(violations, allowed)
+    assert not fresh, (
+        "new dynalint violations (fix them, add an inline "
+        "`# dynalint: disable=<rule>` with a justification, or — last "
+        "resort — baseline them):\n" +
+        "\n".join(v.render() for v in fresh))
+
+
+def test_baseline_is_not_stale():
+    """Fixed violations must leave the baseline (ratchet-only gate)."""
+    violations = analyze_paths(GATE_PATHS, root=REPO)
+    allowed = load_baseline(BASELINE) if os.path.exists(BASELINE) else {}
+    _fresh, stale = apply_baseline(violations, allowed)
+    assert not stale, f"stale baseline entries — delete them: {stale}"
+
+
+def test_cli_entrypoint():
+    """`python -m tools.dynalint <paths>` exits 0 on the clean tree."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dynalint",
+         "dynamo_tpu", "bench.py", "tools"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ----------------------------------------------- DL001 blocking-call-in-async
+
+
+DL001_BAD = """
+import time, subprocess, requests
+async def handler():
+    time.sleep(1)
+    subprocess.run(["ls"])
+    requests.get("http://x")
+    open("/tmp/f")
+"""
+
+DL001_GOOD = """
+import asyncio, time
+def sync_helper():
+    time.sleep(1)           # sync context: fine
+    open("/tmp/f")
+async def handler():
+    await asyncio.sleep(1)
+    await asyncio.to_thread(time.sleep, 1)   # routed off-loop: fine
+    def inner():
+        time.sleep(1)       # nested sync def: runs elsewhere
+"""
+
+
+def test_dl001_fires_on_bad():
+    assert codes(DL001_BAD).count("DL001") == 4
+
+
+def test_dl001_quiet_on_good():
+    assert "DL001" not in codes(DL001_GOOD)
+
+
+# -------------------------------------------------- DL002 fire-and-forget-task
+
+
+DL002_BAD = """
+import asyncio
+async def start():
+    asyncio.create_task(work())          # dropped outright
+"""
+
+DL002_BAD_ATTR = """
+import asyncio
+class Svc:
+    async def start(self):
+        self._task = asyncio.create_task(self.loop())
+    async def stop(self):
+        pass                              # no cancel path anywhere
+"""
+
+DL002_GOOD = """
+import asyncio
+from dynamo_tpu.runtime.tasks import cancel_join, spawn_tracked
+class Svc:
+    async def start(self):
+        self._task = asyncio.create_task(self.loop())
+        self._other = spawn_tracked(self.loop())   # tracked wrapper
+    async def stop(self):
+        await cancel_join(self._task)
+async def inline():
+    t = asyncio.create_task(work())
+    await t                                # awaited local
+    results = await asyncio.gather(*[asyncio.create_task(w())
+                                     for w in fns])
+"""
+
+
+def test_dl002_fires_on_dropped():
+    assert "DL002" in codes(DL002_BAD)
+
+
+def test_dl002_fires_on_never_cancelled_attr():
+    assert "DL002" in codes(DL002_BAD_ATTR)
+
+
+def test_dl002_quiet_on_good():
+    assert "DL002" not in codes(DL002_GOOD)
+
+
+# -------------------------------------------------- DL003 swallowed-loop-error
+
+
+DL003_BAD = """
+async def loop():
+    while True:
+        try:
+            await tick()
+        except Exception:
+            pass
+"""
+
+DL003_GOOD = """
+import asyncio, logging
+log = logging.getLogger(__name__)
+async def loop():
+    while True:
+        try:
+            await tick()
+        except Exception:
+            log.exception("tick failed")
+async def loop2():
+    while True:
+        try:
+            await tick()
+        except Exception:
+            await asyncio.sleep(1.0)      # backoff counts
+async def loop3():
+    while True:
+        try:
+            await tick()
+        except Exception:
+            break                          # exits the loop: fine
+def not_a_loop():
+    try:
+        tick()
+    except Exception:
+        pass                               # broad but not spinning
+"""
+
+
+def test_dl003_fires_on_silent_spin():
+    assert "DL003" in codes(DL003_BAD)
+
+
+def test_dl003_quiet_on_good():
+    assert "DL003" not in codes(DL003_GOOD)
+
+
+# ------------------------------------------------- DL004 lock-across-blocking
+
+
+DL004_BAD = """
+import asyncio, time
+class S:
+    async def send(self):
+        async with self._wlock:
+            time.sleep(1)
+    async def wait_under_lock(self):
+        async with self._lock:
+            await asyncio.sleep(30)
+"""
+
+DL004_GOOD = """
+import asyncio, time
+class S:
+    async def send(self):
+        async with self._wlock:
+            self.writer.write(b"x")
+            await self.writer.drain()      # short await: fine
+    async def capped(self):
+        async with self._sem:              # semaphore = concurrency cap,
+            await asyncio.sleep(30)        # holding it long is the point
+    def sync_path(self):
+        time.sleep(1)                      # no lock held
+"""
+
+
+def test_dl004_fires_on_blocking_under_lock():
+    assert codes(DL004_BAD).count("DL004") == 2
+
+
+def test_dl004_quiet_on_good():
+    assert "DL004" not in codes(DL004_GOOD)
+
+
+# --------------------------------------------- DL005 jax-host-sync-in-hot-path
+
+
+DL005_BAD = """
+import numpy as np
+class JaxEngine:
+    def _step(self):
+        toks = np.asarray(self.dev_toks)
+        jax.block_until_ready(self.kv)
+        n = self.counter.item()
+"""
+
+DL005_GOOD = """
+import numpy as np
+import jax.numpy as jnp
+class JaxEngine:
+    def _step(self):
+        x = jnp.asarray(self.rows)         # device-side: fine
+    def warmup(self):
+        np.asarray(self.kv)                # not a hot-path function
+    def _decode_step_spec(self):
+        np.asarray(self.kv)                # allowlisted sync arm
+"""
+
+
+def test_dl005_fires_in_engine_hot_path():
+    assert codes(DL005_BAD, "dynamo_tpu/engine/fixture.py").count(
+        "DL005") == 3
+
+
+def test_dl005_quiet_on_good_and_allowlist():
+    assert "DL005" not in codes(DL005_GOOD, "dynamo_tpu/engine/fixture.py")
+
+
+def test_dl005_scoped_to_engine_modules():
+    assert "DL005" not in codes(DL005_BAD, "dynamo_tpu/llm/fixture.py")
+
+
+# ---------------------------------------------------- DL006 untracked-env-read
+
+
+DL006_BAD = """
+import os
+ADDR = os.environ.get("DYN_DCP_ADDRESS")
+TOK = os.environ["DYN_ADMIN_TOKENS"]
+LOG = os.getenv("DYN_LOG")
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+HAVE = "DYN_LOG" in os.environ
+"""
+
+DL006_GOOD = """
+import os, subprocess
+from dynamo_tpu.runtime.config import env_str
+ADDR = env_str("DYN_DCP_ADDRESS")
+os.environ["JAX_PLATFORMS"] = "cpu"        # write, not a read
+child_env = dict(os.environ)               # whole-env passthrough
+subprocess.run(["x"], env={**os.environ})
+"""
+
+
+def test_dl006_fires_on_direct_reads():
+    assert codes(DL006_BAD).count("DL006") == 5
+
+
+def test_dl006_quiet_on_registry_and_writes():
+    assert "DL006" not in codes(DL006_GOOD)
+
+
+def test_dl006_allows_config_module():
+    assert "DL006" not in codes(DL006_BAD,
+                                "dynamo_tpu/runtime/config.py")
+
+
+# ----------------------------------------------------------------- suppression
+
+
+def test_inline_suppression_same_line():
+    src = ("import time\n"
+           "async def f():\n"
+           "    time.sleep(1)  # dynalint: disable=blocking-call-in-async\n")
+    assert "DL001" not in codes(src)
+
+
+def test_inline_suppression_line_above():
+    src = ("import time\n"
+           "async def f():\n"
+           "    # dynalint: disable=DL001\n"
+           "    time.sleep(1)\n")
+    assert "DL001" not in codes(src)
+
+
+def test_suppression_is_rule_scoped():
+    src = ("import time\n"
+           "async def f():\n"
+           "    time.sleep(1)  # dynalint: disable=untracked-env-read\n")
+    assert "DL001" in codes(src)  # wrong rule named: still fires
+
+
+# ------------------------------------------------------------ baseline ratchet
+
+
+def test_baseline_ratchet(tmp_path):
+    violations = lint(DL003_BAD, "dynamo_tpu/somefile.py")
+    assert violations, "fixture must produce a violation"
+    key = violations[0].baseline_key
+
+    # 1. baselined violation passes
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(f"# grandfathered\n{key}\n")
+    fresh, stale = apply_baseline(violations, load_baseline(str(bl)))
+    assert not fresh and not stale
+
+    # 2. a NEW violation (not in the baseline) fails
+    more = violations + lint(DL001_BAD, "dynamo_tpu/otherfile.py")
+    fresh, _ = apply_baseline(more, load_baseline(str(bl)))
+    assert fresh and all(v.code == "DL001" for v in fresh)
+
+    # 3. stale entry (violation fixed) is reported for deletion
+    bl.write_text(f"{key}\ndynamo_tpu/gone.py::swallowed-loop-error::f\n")
+    fresh, stale = apply_baseline(violations, load_baseline(str(bl)))
+    assert not fresh
+    assert stale == ["dynamo_tpu/gone.py::swallowed-loop-error::f"]
+
+
+def test_baseline_count_suffix(tmp_path):
+    """path::rule::scope::N grandfathers N instances in one line."""
+    two = lint(DL003_BAD, "dynamo_tpu/somefile.py") * 2
+    key = two[0].baseline_key
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(f"{key}::2\n")
+    fresh, stale = apply_baseline(two, load_baseline(str(bl)))
+    assert not fresh and not stale
+
+
+# ------------------------------------------------------- generated artifacts
+
+
+def test_env_docs_in_sync():
+    """docs/env_vars.md must match the registry (regenerate with
+    `python -m tools.dynalint --write-env-docs docs/env_vars.md`)."""
+    from dynamo_tpu.runtime.config import render_env_docs
+
+    path = os.path.join(REPO, "docs", "env_vars.md")
+    with open(path, encoding="utf-8") as f:
+        on_disk = f.read()
+    assert on_disk == render_env_docs(), (
+        "docs/env_vars.md is out of date — regenerate it with "
+        "`python -m tools.dynalint --write-env-docs docs/env_vars.md`")
+
+
+def test_env_registry_rejects_unregistered():
+    from dynamo_tpu.runtime.config import UnregisteredEnvVar, env_str
+
+    with pytest.raises(UnregisteredEnvVar):
+        env_str("DYN_NO_SUCH_KNOB_EVER")
+
+
+def test_ruff_gate():
+    """Second gate: ruff (pyflakes + async + bugbear subset from
+    pyproject.toml) when available; skip gracefully when not baked in."""
+    try:
+        import ruff  # noqa: F401
+    except ImportError:
+        pytest.skip("ruff not installed in this image")
+    proc = subprocess.run([sys.executable, "-m", "ruff", "check", "."],
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,27 @@
+"""dynalint: project-native async/JAX static analysis for dynamo-tpu.
+
+The Rust reference gets its concurrency safety from the borrow checker;
+this Python/JAX port gets it from here. Six AST rules catch the hazard
+classes that bite async serving stacks at 3am: blocking calls on the
+event loop, background tasks whose exceptions vanish, silently-spinning
+error loops, blocking work under locks, host syncs in engine hot paths,
+and undocumented env knobs.
+
+Usage:
+    python -m tools.dynalint [--baseline FILE] [--json] paths...
+
+Suppression: append ``# dynalint: disable=<rule-name>[,<rule-name>...]``
+to the offending line (or the line directly above it). Grandfathered
+violations live in ``tools/dynalint/baseline.txt`` — the gate is
+ratchet-only: new violations fail, baselined ones pass, stale baseline
+entries warn.
+"""
+
+from .analyzer import (RULES, Violation, analyze_paths, analyze_source,
+                       iter_py_files)
+from .baseline import apply_baseline, format_entry, load_baseline
+
+__all__ = [
+    "RULES", "Violation", "analyze_paths", "analyze_source",
+    "apply_baseline", "format_entry", "iter_py_files", "load_baseline",
+]

@@ -1,0 +1,101 @@
+"""dynalint CLI.
+
+    python -m tools.dynalint [--baseline FILE] [--json] paths...
+
+Exit status: 0 when every violation is baselined (stale baseline
+entries still warn on stderr), 1 when new violations exist.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .analyzer import RULES, analyze_paths
+from .baseline import apply_baseline, load_baseline
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.txt")
+DEFAULT_PATHS = ["dynamo_tpu", "bench.py", "tools"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dynalint",
+        description="project-native async/JAX static analysis")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to scan (default: {DEFAULT_PATHS})")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="grandfathered-violations file "
+                         "(default: tools/dynalint/baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, ignoring the baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline file to grandfather every "
+                         "current violation (ratchet reset — review the "
+                         "diff before committing)")
+    ap.add_argument("--write-env-docs", metavar="PATH", default=None,
+                    help="regenerate the env-var reference (docs/"
+                         "env_vars.md) from the runtime/config.py registry")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code, (name, summary) in sorted(RULES.items()):
+            print(f"{code}  {name:28s} {summary}")
+        return 0
+
+    if args.write_env_docs:
+        sys.path.insert(0, REPO_ROOT)
+        from dynamo_tpu.runtime.config import render_env_docs
+
+        with open(args.write_env_docs, "w", encoding="utf-8") as f:
+            f.write(render_env_docs())
+        print(f"wrote {args.write_env_docs}")
+        return 0
+
+    paths = args.paths or [os.path.join(REPO_ROOT, p)
+                           for p in DEFAULT_PATHS]
+    violations = analyze_paths(paths, root=REPO_ROOT)
+
+    if args.write_baseline:
+        lines = ["# dynalint baseline — grandfathered violations "
+                 "(ratchet-only: fix, don't add)",
+                 "# format: path::rule-name::scope  "
+                 "(one line per allowed instance)"]
+        lines += sorted(v.baseline_key for v in violations)
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"wrote {len(violations)} entries to {args.baseline}")
+        return 0
+
+    stale: list = []
+    if not args.no_baseline and os.path.exists(args.baseline):
+        allowed = load_baseline(args.baseline)
+        violations, stale = apply_baseline(violations, allowed)
+
+    if args.as_json:
+        print(json.dumps({"violations": [v.to_dict() for v in violations],
+                          "stale_baseline": stale}, indent=2))
+    else:
+        for v in violations:
+            print(v.render())
+        for key in stale:
+            print(f"warning: stale baseline entry (violation fixed — "
+                  f"delete the line): {key}", file=sys.stderr)
+        if violations:
+            print(f"\n{len(violations)} new violation(s). Fix them, "
+                  f"suppress with `# dynalint: disable=<rule>`, or (last "
+                  f"resort, justified) add to {args.baseline}",
+                  file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

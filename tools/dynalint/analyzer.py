@@ -1,0 +1,518 @@
+"""The dynalint AST analyzer: six project-specific rules, stdlib-only.
+
+Each rule has a stable code, a kebab-case name (used in suppression
+comments and baseline entries, so line-number churn never invalidates
+them), and a one-line message. See ``docs/static_analysis.md`` for the
+rationale behind each rule and the cleanup it drove.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# code -> (kebab-name, summary)
+RULES: Dict[str, Tuple[str, str]] = {
+    "DL001": ("blocking-call-in-async",
+              "blocking call inside an async def body stalls the event loop"),
+    "DL002": ("fire-and-forget-task",
+              "background task result dropped: exceptions vanish and there "
+              "is no cancel-join path"),
+    "DL003": ("swallowed-loop-error",
+              "broad except inside a loop with neither a log call nor a "
+              "backoff sleep can spin silently forever"),
+    "DL004": ("lock-across-blocking",
+              "blocking call or long await while holding a lock serializes "
+              "everything behind it"),
+    "DL005": ("jax-host-sync-in-hot-path",
+              "host sync (block_until_ready / np.asarray / .item / float) "
+              "inside an engine step/decode function"),
+    "DL006": ("untracked-env-read",
+              "os.environ read outside runtime/config.py: route it through "
+              "the env registry so the knob is documented"),
+}
+
+NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}
+
+# ---------------------------------------------------------------- rule config
+
+# DL001/DL004: sync calls that block the calling thread.
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.getoutput",
+    "subprocess.getstatusoutput",
+    "os.system", "os.waitpid", "os.wait",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen",
+})
+BLOCKING_PREFIXES = ("requests.",)
+# builtins that do blocking file IO
+BLOCKING_BUILTINS = frozenset({"open"})
+
+# DL002: task-spawning calls whose result must be tracked.
+SPAWN_CALLS = frozenset({"asyncio.create_task", "asyncio.ensure_future"})
+# calls that take ownership of a task passed to them
+TRACKING_SINKS = frozenset({
+    "asyncio.gather", "asyncio.wait", "asyncio.wait_for", "asyncio.shield",
+    "asyncio.as_completed", "cancel_join", "tasks.cancel_join",
+})
+TRACKING_ATTRS = frozenset({"cancel", "add_done_callback", "result",
+                            "exception"})
+
+# DL003: logging-ish method names that count as "the error is surfaced".
+LOG_METHODS = frozenset({"debug", "info", "warning", "error", "exception",
+                         "critical", "log", "print"})
+
+# DL004: a with-item whose context expression's last segment matches this
+# is treated as a lock. Semaphores are deliberately excluded: a
+# concurrency cap is SUPPOSED to be held across the long await it gates.
+LOCK_NAME_RE = re.compile(r"(?i)(lock|mutex)$")
+LONG_AWAIT_CALLS = frozenset({"asyncio.sleep", "asyncio.wait",
+                              "asyncio.wait_for", "asyncio.gather"})
+LONG_AWAIT_ATTRS = frozenset({"wait", "acquire", "join"})
+
+# DL005: applies to functions matching HOT_RE in modules under engine/.
+HOT_RE = re.compile(r"(^|_)step($|_)")
+HOT_PATH_MARKERS = ("engine/",)
+HOST_SYNC_CALLS = frozenset({"jax.block_until_ready", "np.asarray",
+                             "np.array", "numpy.asarray", "numpy.array"})
+# Deliberately-synchronous scheduler arms: the sync is the design (the
+# spec-decode arm verifies on-host; the single-step fallback is the
+# pre-async engine). New step functions do NOT belong here — overlap
+# device work instead, or carry an inline disable with a justification.
+HOT_SYNC_ALLOWLIST = frozenset({
+    "JaxEngine._step_spec",
+    "JaxEngine._decode_step_spec",
+    "JaxEngine._decode_step_single",
+})
+
+# DL006: modules allowed to touch os.environ directly (the registry itself).
+ENV_ALLOWED_SUFFIXES = ("runtime/config.py",)
+
+SUPPRESS_RE = re.compile(r"#\s*dynalint:\s*disable=([A-Za-z0-9_,\-]+)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    code: str
+    name: str
+    message: str
+    scope: str  # dotted qualname of the enclosing class/function context
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.path}::{self.name}::{self.scope}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.code} "
+                f"[{self.name}] {self.message} (in {self.scope})")
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "code": self.code, "name": self.name,
+                "message": self.message, "scope": self.scope}
+
+
+# --------------------------------------------------------------- AST helpers
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'time.sleep' for Name/Attribute chains; None when the base is an
+    arbitrary expression (then only the final attribute is matchable)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_attr(call: ast.Call) -> Optional[str]:
+    """Final attribute name of a method-style call, e.g. 'item' for
+    ``x[0].item()``."""
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _is_blocking_call(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    if d is None:
+        return False
+    if d in BLOCKING_CALLS or d in BLOCKING_BUILTINS:
+        return True
+    return any(d.startswith(p) for p in BLOCKING_PREFIXES)
+
+
+def _task_ref_key(node: ast.AST, class_scope: str,
+                  func_id: int) -> Optional[Tuple]:
+    """Key identifying a task-holding variable: self-attributes key on the
+    enclosing class (stop() cancels what start() spawned); bare names key
+    on the enclosing function."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return ("attr", class_scope, node.attr)
+    if isinstance(node, ast.Name):
+        return ("local", func_id, node.id)
+    return None
+
+
+class _Analyzer(ast.NodeVisitor):
+    def __init__(self, path: str, suppressed: Dict[int, Set[str]]):
+        self.path = path
+        self.suppressed = suppressed
+        self.violations: List[Violation] = []
+        # context stacks
+        self._classes: List[str] = []
+        self._funcs: List[Tuple[str, bool]] = []  # (name, is_async)
+        self._func_ids: List[int] = []
+        self._loop_depth: List[int] = [0]   # per-function frame
+        self._lock_depth: List[int] = [0]   # per-function frame
+        # DL002 two-phase state
+        self._spawn_candidates: List[Tuple[Tuple, Violation]] = []
+        self._tracked_keys: Set[Tuple] = set()
+        norm = path.replace(os.sep, "/")
+        self._is_engine = any(m in norm for m in HOT_PATH_MARKERS)
+        self._env_allowed = norm.endswith(ENV_ALLOWED_SUFFIXES)
+
+    # ------------------------------------------------------------- reporting
+
+    def _scope(self) -> str:
+        parts = self._classes + [n for n, _ in self._funcs]
+        return ".".join(parts) if parts else "<module>"
+
+    def report(self, node: ast.AST, code: str,
+               detail: str = "") -> Optional[Violation]:
+        name, summary = RULES[code]
+        line = getattr(node, "lineno", 0)
+        for probe in (line, line - 1):
+            tags = self.suppressed.get(probe)
+            if tags and (name in tags or code in tags or "all" in tags):
+                return None
+        msg = f"{summary}: {detail}" if detail else summary
+        v = Violation(self.path, line, getattr(node, "col_offset", 0),
+                      code, name, msg, self._scope())
+        return v
+
+    def emit(self, node: ast.AST, code: str, detail: str = "") -> None:
+        v = self.report(node, code, detail)
+        if v is not None:
+            self.violations.append(v)
+
+    # --------------------------------------------------------------- scoping
+
+    def _enter_func(self, node, is_async: bool) -> None:
+        name = getattr(node, "name", "<lambda>")
+        self._funcs.append((name, is_async))
+        self._func_ids.append(id(node))
+        self._loop_depth.append(0)
+        self._lock_depth.append(0)
+
+    def _exit_func(self) -> None:
+        self._funcs.pop()
+        self._func_ids.pop()
+        self._loop_depth.pop()
+        self._lock_depth.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_func(node, False)
+        self.generic_visit(node)
+        self._exit_func()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_func(node, True)
+        self.generic_visit(node)
+        self._exit_func()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_func(node, False)
+        self.generic_visit(node)
+        self._exit_func()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._classes.append(node.name)
+        self.generic_visit(node)
+        self._classes.pop()
+
+    @property
+    def _in_async(self) -> bool:
+        return bool(self._funcs) and self._funcs[-1][1]
+
+    @property
+    def _class_scope(self) -> str:
+        return ".".join(self._classes) if self._classes else "<module>"
+
+    @property
+    def _func_id(self) -> int:
+        return self._func_ids[-1] if self._func_ids else 0
+
+    # ----------------------------------------------------------------- loops
+
+    def _visit_loop(self, node) -> None:
+        self._loop_depth[-1] += 1
+        self.generic_visit(node)
+        self._loop_depth[-1] -= 1
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    # ------------------------------------------------------ DL003 broad except
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self._loop_depth[-1] > 0 and _is_broad_except(node.type) \
+                and not _handler_surfaces_error(node):
+            self.emit(node, "DL003")
+        self.generic_visit(node)
+
+    # ----------------------------------------------------------- DL004 locks
+
+    def _visit_with(self, node) -> None:
+        locky = any(_is_lock_expr(item.context_expr) for item in node.items)
+        if locky:
+            self._lock_depth[-1] += 1
+        self.generic_visit(node)
+        if locky:
+            self._lock_depth[-1] -= 1
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # ----------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = dotted(node.func)
+        attr = call_attr(node)
+
+        if _is_blocking_call(node):
+            what = d or attr or "call"
+            if self._in_async:
+                self.emit(node, "DL001", f"`{what}`")
+            if self._lock_depth[-1] > 0:
+                self.emit(node, "DL004", f"blocking `{what}` under lock")
+
+        if d in SPAWN_CALLS:
+            self._record_spawn(node, d)
+        if d in TRACKING_SINKS or attr in ("gather", "wait", "wait_for"):
+            for arg in node.args:
+                self._note_tracked(arg)
+
+        if self._is_engine and self._in_hot_func():
+            self._check_host_sync(node, d, attr)
+
+        if not self._env_allowed:
+            self._check_env_read(node, d)
+
+        self.generic_visit(node)
+
+    # ----------------------------------------------------------- DL002 spawn
+
+    def _record_spawn(self, node: ast.Call, d: str) -> None:
+        parent = getattr(node, "_dl_parent", None)
+        # tracked forms: the task object escapes to something that owns it
+        if isinstance(parent, (ast.Return, ast.Await)):
+            return
+        if isinstance(parent, ast.Call):
+            # passed straight into gather()/wait()/... or any wrapper
+            return
+        if isinstance(parent, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                               ast.DictComp)):
+            # `[create_task(...) for ...]`: the list escapes to whatever
+            # consumes the comprehension — assume it is awaited/cancelled
+            return
+        if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.NamedExpr)):
+            targets = (parent.targets if isinstance(parent, ast.Assign)
+                       else [parent.target])
+            for t in targets:
+                key = _task_ref_key(t, self._class_scope, self._func_id)
+                if key is None:
+                    return  # e.g. tuple unpack / subscript: assume tracked
+                v = self.report(node, "DL002",
+                                f"`{d}` result assigned to "
+                                f"`{ast.unparse(t)}` but never cancelled, "
+                                f"awaited, or given a done-callback")
+                if v is not None:
+                    self._spawn_candidates.append((key, v))
+            return
+        # bare expression statement (or anything else): result dropped
+        self.emit(node, "DL002", f"`{d}` result is dropped")
+
+    def _note_tracked(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Starred):
+            node = node.value
+        key = _task_ref_key(node, self._class_scope, self._func_id)
+        if key is not None:
+            self._tracked_keys.add(key)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in TRACKING_ATTRS:
+            self._note_tracked(node.value)
+        self.generic_visit(node)
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self._note_tracked(node.value)
+        # DL004: long awaits under a held lock
+        if self._lock_depth[-1] > 0 and isinstance(node.value, ast.Call):
+            d = dotted(node.value.func)
+            attr = call_attr(node.value)
+            if d in LONG_AWAIT_CALLS or attr in LONG_AWAIT_ATTRS:
+                what = d or f".{attr}()"
+                self.emit(node, "DL004", f"long `await {what}` under lock")
+        self.generic_visit(node)
+
+    # -------------------------------------------------------- DL005 host sync
+
+    def _in_hot_func(self) -> bool:
+        for name, _ in reversed(self._funcs):
+            if name == "<lambda>":
+                continue
+            if not HOT_RE.search(name):
+                return False
+            qual = ".".join(self._classes + [name])
+            return qual not in HOT_SYNC_ALLOWLIST
+        return False
+
+    def _check_host_sync(self, node: ast.Call, d: Optional[str],
+                         attr: Optional[str]) -> None:
+        if d in HOST_SYNC_CALLS or attr == "block_until_ready":
+            self.emit(node, "DL005", f"`{d or attr}`")
+        elif attr == "item" and not node.args:
+            self.emit(node, "DL005", "`.item()`")
+        elif isinstance(node.func, ast.Name) and node.func.id == "float" \
+                and node.args and isinstance(
+                    node.args[0], (ast.Call, ast.Subscript)):
+            self.emit(node, "DL005", "`float()` on a computed value")
+
+    # --------------------------------------------------------- DL006 env read
+
+    def _check_env_read(self, node: ast.Call, d: Optional[str]) -> None:
+        if d in ("os.getenv", "os.environ.get", "os.environ.setdefault"):
+            arg = node.args[0] if node.args else None
+            name = (repr(arg.value) if isinstance(arg, ast.Constant)
+                    else "<dynamic>")
+            self.emit(node, "DL006", f"`{d}({name})`")
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if not self._env_allowed and isinstance(node.ctx, ast.Load) \
+                and dotted(node.value) == "os.environ":
+            self.emit(node, "DL006", "`os.environ[...]`")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if not self._env_allowed and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops) \
+                and any(dotted(c) == "os.environ" for c in node.comparators):
+            self.emit(node, "DL006", "`... in os.environ`")
+        self.generic_visit(node)
+
+    # -------------------------------------------------------------- finalize
+
+    def finalize(self) -> List[Violation]:
+        for key, violation in self._spawn_candidates:
+            if key not in self._tracked_keys:
+                self.violations.append(violation)
+        self.violations.sort(key=lambda v: (v.path, v.line, v.code))
+        return self.violations
+
+
+def _is_broad_except(type_node: Optional[ast.AST]) -> bool:
+    if type_node is None:  # bare except:
+        return True
+    names = ([type_node] if not isinstance(type_node, ast.Tuple)
+             else list(type_node.elts))
+    return any(isinstance(n, ast.Name) and
+               n.id in ("Exception", "BaseException") for n in names)
+
+
+def _handler_surfaces_error(handler: ast.ExceptHandler) -> bool:
+    """A handler is fine when it logs, backs off, or exits the loop."""
+    for sub in ast.walk(handler):
+        if isinstance(sub, (ast.Raise, ast.Break, ast.Return)):
+            return True
+        if isinstance(sub, ast.Call):
+            d = dotted(sub.func)
+            attr = call_attr(sub)
+            if attr in LOG_METHODS or \
+                    (isinstance(sub.func, ast.Name)
+                     and sub.func.id == "print"):
+                return True
+            if d in ("time.sleep", "asyncio.sleep"):
+                return True
+    return False
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):  # e.g. `with threading.Lock():`
+        expr = expr.func
+    d = dotted(expr)
+    if d is None:
+        return False
+    return bool(LOCK_NAME_RE.search(d.rsplit(".", 1)[-1]))
+
+
+# ------------------------------------------------------------------ frontend
+
+def _collect_suppressions(src: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {t.strip() for t in m.group(1).split(",") if t.strip()}
+    return out
+
+
+def _annotate_parents(tree: ast.AST) -> None:
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child._dl_parent = parent  # type: ignore[attr-defined]
+
+
+def analyze_source(src: str, path: str) -> List[Violation]:
+    """Analyze one module's source. ``path`` drives the path-scoped rules
+    (DL005 engine modules, DL006 config allowlist) and appears in output."""
+    tree = ast.parse(src, filename=path)
+    _annotate_parents(tree)
+    analyzer = _Analyzer(path.replace(os.sep, "/"),
+                         _collect_suppressions(src))
+    analyzer.visit(tree)
+    return analyzer.finalize()
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames) if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    return sorted(set(files))
+
+
+def analyze_paths(paths: Sequence[str],
+                  root: Optional[str] = None) -> List[Violation]:
+    """Analyze every .py under ``paths``; reported paths are relative to
+    ``root`` (default: cwd) so baseline entries are location-independent."""
+    root = os.path.abspath(root or os.getcwd())
+    out: List[Violation] = []
+    for f in iter_py_files(paths):
+        ab = os.path.abspath(f)
+        rel = os.path.relpath(ab, root) if ab.startswith(root + os.sep) else f
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            out.extend(analyze_source(src, rel))
+        except SyntaxError as e:
+            out.append(Violation(rel.replace(os.sep, "/"), e.lineno or 0, 0,
+                                 "DL000", "syntax-error", str(e), "<module>"))
+    return out

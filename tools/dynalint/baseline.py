@@ -1,0 +1,56 @@
+"""Baseline (ratchet) file handling.
+
+Entries key on ``path::rule-name::scope`` — NOT line numbers — so
+unrelated edits never invalidate them. Each line grandfathers ONE
+violation instance; repeat the line (or append ``::N``) to allow N in
+the same scope. The gate only ratchets down: a new violation anywhere
+fails, a baselined one passes, and an entry that no longer matches
+anything prints a stale warning so it gets deleted.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+from .analyzer import Violation
+
+
+def format_entry(v: Violation) -> str:
+    return v.baseline_key
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """key -> allowed count. Lines: ``path::rule::scope[::N]``; ``#``
+    comments and blanks ignored."""
+    allowed: Dict[str, int] = {}
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split("::")
+            count = 1
+            if len(parts) >= 4 and parts[-1].isdigit():
+                count = int(parts[-1])
+                parts = parts[:-1]
+            key = "::".join(parts)
+            allowed[key] = allowed.get(key, 0) + count
+    return allowed
+
+
+def apply_baseline(
+    violations: Sequence[Violation], allowed: Dict[str, int]
+) -> Tuple[List[Violation], List[str]]:
+    """Returns (non-baselined violations, stale baseline keys)."""
+    found = Counter(v.baseline_key for v in violations)
+    budget = dict(allowed)
+    fresh: List[Violation] = []
+    for v in violations:
+        if budget.get(v.baseline_key, 0) > 0:
+            budget[v.baseline_key] -= 1
+        else:
+            fresh.append(v)
+    stale = [key for key, n in allowed.items()
+             if found.get(key, 0) < n]
+    return fresh, stale
