@@ -210,6 +210,12 @@ def parse_args():
                          "chip is available")
     ap.add_argument("--spec-tokens", type=int, default=4,
                     help="max draft tokens verified per step (K)")
+    ap.add_argument("--trace", action="store_true",
+                    help="dyntrace: record a trace per benched request "
+                         "(sampling forced to 1.0) and dump a per-request "
+                         "stage breakdown (route/prefill/kv_transfer/"
+                         "decode span durations) plus a stage rollup "
+                         "after the run")
     ap.add_argument("--sweep", default=None,
                     help="batch-geometry sweep (VERDICT r3 task 3): comma-"
                          "separated conc:max_batch:decode_steps triples, "
@@ -398,17 +404,21 @@ async def run_multiturn(args):
     return report
 
 
-async def measure(engine, reqs, concurrency):
+async def measure(engine, reqs, concurrency, trace=False):
     """Drive `reqs` through any AsyncEngine-shaped object at the given
     concurrency; returns the aggregate report (the reference batch-mode
-    metrics, launch/dynamo-run input/batch.rs:42-105)."""
+    metrics, launch/dynamo-run input/batch.rs:42-105). ``trace=True``
+    wraps every request in a dyntrace root span and appends a per-stage
+    breakdown to the report."""
     from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
                                                  SamplingOptions,
                                                  StopConditions)
+    from dynamo_tpu.runtime import tracing
     from dynamo_tpu.runtime.engine import Context
 
     sem = asyncio.Semaphore(concurrency)
     results = []
+    trace_rids = []
     # hard per-request watchdog: a wedged generator must surface as an
     # error row, never hang the whole bench (the driver runs this
     # unattended at end of round)
@@ -440,6 +450,16 @@ async def measure(engine, reqs, concurrency):
             sampling=SamplingOptions(),  # greedy
             stop=StopConditions(max_tokens=osl, ignore_eos=True),
             eos_token_ids=[])
+        if trace:
+            trace_rids.append(ctx.id)
+            with tracing.get_tracer().start_span(
+                    "bench.request", parent=None, request_id=ctx.id,
+                    attributes={"isl": len(token_ids), "osl": osl}):
+                await _drive(pre, ctx, osl, len(token_ids))
+        else:
+            await _drive(pre, ctx, osl, len(token_ids))
+
+    async def _drive(pre, ctx, osl, isl):
         t_start = time.monotonic()
         t_first = None
         chunk_stamps = []
@@ -464,7 +484,7 @@ async def measure(engine, reqs, concurrency):
         itl = ((chunk_stamps[-1] - chunk_stamps[0]) / (n_out - 1)
                if n_out > 1 else None)
         results.append({
-            "tokens_in": len(token_ids), "tokens_out": n_out,
+            "tokens_in": isl, "tokens_out": n_out,
             "ttft": (t_first - t_start) if t_first else None,
             "elapsed": t_end - t_start, "itl": itl,
             # raw inter-CHUNK arrival gaps: what a streaming client
@@ -492,7 +512,7 @@ async def measure(engine, reqs, concurrency):
     def pct(v, p):
         return v[min(int(len(v) * p / 100), len(v) - 1)] if v else None
 
-    return {
+    report = {
         "requests": len(results), "errors": errors,
         "wall_s": round(wall, 3),
         "req_per_s": round(len(results) / wall, 3),
@@ -507,6 +527,31 @@ async def measure(engine, reqs, concurrency):
         "itl_raw_chunk_p99_ms": (round(pct(gaps, 99) * 1000, 2)
                                  if gaps else None),
     }
+    if trace:
+        report["trace_stages"] = _trace_breakdown(trace_rids)
+    return report
+
+
+def _trace_breakdown(request_ids):
+    """Per-request stage dump (stderr) + a mean/max rollup per stage name
+    over the whole run, read straight from the dyntrace ring."""
+    from dynamo_tpu.runtime import tracing
+
+    tracer = tracing.get_tracer()
+    per_stage = {}
+    for rid in request_ids:
+        tr = tracer.get_request_trace(rid)
+        if tr is None:
+            continue
+        print(f"trace {rid}: " + " ".join(
+            f"{name}={ms:.1f}ms" for name, ms in sorted(tr["stages"].items())),
+            file=sys.stderr)
+        for name, ms in tr["stages"].items():
+            per_stage.setdefault(name, []).append(ms)
+    return {name: {"n": len(v),
+                   "mean_ms": round(sum(v) / len(v), 2),
+                   "max_ms": round(max(v), 2)}
+            for name, v in sorted(per_stage.items())}
 
 
 async def run_bench(args):
@@ -517,7 +562,8 @@ async def run_bench(args):
     print(f"warmup done in {time.monotonic()-t0:.1f}s", file=sys.stderr)
 
     reqs = synth_requests(args, cfg.vocab_size, engine.cap_tokens)
-    report = await measure(engine, reqs, args.concurrency)
+    report = await measure(engine, reqs, args.concurrency,
+                           trace=getattr(args, "trace", False))
     st = engine.stats()
     report["prefix_hit_rate"] = round(st["gpu_prefix_cache_hit_rate"], 4)
     if engine.ecfg.spec_decode:
@@ -551,7 +597,8 @@ async def run_disagg(args):
     print("warming up agg engine...", file=sys.stderr)
     engine.warmup()
     reqs = synth_requests(args, cfg.vocab_size, engine.cap_tokens)
-    agg = await measure(engine, reqs, args.concurrency)
+    agg = await measure(engine, reqs, args.concurrency,
+                        trace=getattr(args, "trace", False))
     await engine.stop()
     base_ecfg = engine.ecfg
     del engine
@@ -597,7 +644,8 @@ async def run_disagg(args):
         before_st = disagg.stats()
         before_send = dict(pw.xfer.__dict__)
         print(f"--- disagg leg kv_chunk_pages={cp} ---", file=sys.stderr)
-        dis = await measure(disagg, leg_reqs, args.concurrency)
+        dis = await measure(disagg, leg_reqs, args.concurrency,
+                            trace=getattr(args, "trace", False))
         st = disagg.stats()
         send = {k: v - before_send[k] for k, v in pw.xfer.__dict__.items()}
         dis["kv_chunk_pages"] = cp
@@ -787,6 +835,13 @@ def main():
 
 
 def _run_scenario(args) -> dict:
+    if getattr(args, "trace", False):
+        # force-sample every benched request and size the ring to hold
+        # the whole run's spans (~a dozen per request on the disagg path)
+        from dynamo_tpu.runtime import tracing
+
+        tracing.configure(sample=1.0,
+                          ring=max(4096, args.requests * 64))
     if args.spec:
         return _run_spec_ab(args)
     if args.sweep:

@@ -44,6 +44,8 @@ from ..llm.protocols.common import (FINISH_CANCELLED, FINISH_EOS,
 from ..models.config import ModelConfig
 from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
+from ..runtime import tracing
+from ..runtime.config import env_int
 from ..runtime.engine import Context
 from .kv_manager import PageManager
 from .sampling import (SamplingBatch, logprob_aux, sample_tokens,
@@ -395,6 +397,14 @@ class JaxEngine:
                                         thread_name_prefix="jax-step")
         # observability (ForwardPassMetrics analog, kv_router/protocols.rs)
         self.steps = 0
+        # step timeline: bounded ring of scheduler events (queue-wait,
+        # batch occupancy, tokens/step, spec accepts) surfaced through
+        # /v1/traces on the HTTP frontend (dyntrace)
+        self.step_timeline = tracing.StepTimeline(
+            env_int("DYN_STEP_TIMELINE") or 0)
+        tracing.register_timeline(f"jax-engine-{id(self):x}",
+                                  self.step_timeline)
+        self.queue_wait_seconds_total = 0.0
         self.prefill_tokens_total = 0
         # iterations where a decode window dispatched WHILE prompts were
         # still prefilling — the observable for budgeted mixing
@@ -580,6 +590,8 @@ class JaxEngine:
             "kv_active_blocks": self.pm.active,
             "kv_total_blocks": self.ecfg.num_pages - 1,
             "num_requests_waiting": len(self.waiting),
+            "queue_wait_seconds_total": round(self.queue_wait_seconds_total,
+                                              4),
             "gpu_cache_usage_perc": self.pm.usage(),
             "gpu_prefix_cache_hit_rate":
                 (self.prefix_hit_tokens_total /
@@ -778,6 +790,13 @@ class JaxEngine:
             seq.pages = pages
             seq.computed = min(cached_tokens, seq.prefill_extent)
             if seq.generated == 0:  # don't double-count resumed sequences
+                wait = time.monotonic() - seq.arrival
+                self.queue_wait_seconds_total += wait
+                self.step_timeline.add(
+                    "admit", queue_wait_ms=round(wait * 1000.0, 3),
+                    request_id=seq.context.id,
+                    occupancy=len(self.running) + len(self.prefilling) + 1,
+                    waiting=len(self.waiting))
                 self.prefix_hit_tokens_total += seq.computed
                 self.prompt_tokens_total += seq.num_prompt
             self.prefilling.append(seq)
@@ -999,6 +1018,10 @@ class JaxEngine:
             jnp.asarray(last_idx),
             jnp.asarray(pslots) if use_paged else None)
         self.steps += 1
+        self.step_timeline.add(
+            "prefill", batch=len(batch), tokens=int(sum(chunks)),
+            occupancy=len(self.running) + len(self.prefilling),
+            waiting=len(self.waiting))
 
         finishing: List[Tuple[int, Sequence]] = []
         for i, (seq, chunk) in enumerate(zip(batch, chunks)):
@@ -1188,6 +1211,10 @@ class JaxEngine:
         for i, (seq, tok) in enumerate(zip(batch, sampled)):
             self._append_token(seq, int(tok),
                                lp=self._lp_entry(seq, aux, i))
+        self.step_timeline.add(
+            "decode", batch=len(batch), tokens=len(batch),
+            occupancy=len(self.running) + len(self.prefilling),
+            waiting=len(self.waiting))
 
     # -------------------------------------------------- speculative decode
 
@@ -1318,15 +1345,23 @@ class JaxEngine:
         acc = np.asarray(acc_d)
         self.steps += 1
         self.spec_steps += 1
+        step_accepted = step_drafted = 0
         for i, seq in enumerate(batch):
             accepted = int(acc[i])
             self.spec_draft_tokens_total += int(draft_len[i])
             self.spec_accepted_tokens_total += accepted
+            step_drafted += int(draft_len[i])
+            step_accepted += accepted
             for j in range(accepted + 1):
                 if seq.finished is not None or seq.context.stopped:
                     break  # tokens past an accepted stop are discarded
                 self._append_token(seq, int(out[i, j]))
                 self.decode_tokens_total += 1
+        self.step_timeline.add(
+            "spec_verify", batch=len(batch), drafted=step_drafted,
+            accepted=step_accepted,
+            occupancy=len(self.running) + len(self.prefilling),
+            waiting=len(self.waiting))
 
     def _dispatch_decode_window(self, batch: Optional[List[Sequence]] = None
                                 ) -> Optional[_PendingWindow]:
@@ -1451,6 +1486,7 @@ class JaxEngine:
         if self._pending is pend:
             self._pending = None
         K = toks.shape[1]
+        emitted = 0
         for i, seq in enumerate(pend.batch):
             if seq.finished is not None:
                 continue
@@ -1460,6 +1496,11 @@ class JaxEngine:
                 self._append_token(seq, int(toks[i, j]),
                                    lp=self._lp_entry(seq, aux, i, j))
                 self.decode_tokens_total += 1
+                emitted += 1
+        self.step_timeline.add(
+            "decode_window", batch=len(pend.batch), tokens=emitted,
+            occupancy=len(self.running) + len(self.prefilling),
+            waiting=len(self.waiting))
 
     # -------------------------------------------- deferred page reclamation
 

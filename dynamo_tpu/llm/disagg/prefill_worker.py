@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
+from ...runtime import tracing
 from ...runtime.engine import Context
 from ..protocols.common import (PreprocessedRequest, SamplingOptions,
                                 StopConditions)
@@ -106,6 +107,8 @@ class PrefillWorker:
     async def _handle(self, req: RemotePrefillRequest) -> None:
         """One remote prefill: compute, extract the non-cached pages, ship."""
         pages = None
+        tracing.bind_request_id(req.request_id)
+        tracer = tracing.get_tracer()
         try:
             pre = PreprocessedRequest(
                 token_ids=list(req.token_ids),
@@ -114,7 +117,14 @@ class PrefillWorker:
                 eos_token_ids=list(req.eos_token_ids),
             )
             ctx = Context(req.request_id)
-            first, pages = await self.engine.prefill_only(pre, ctx)
+            # parent = the decode-side request's trace (trace_ctx rides the
+            # queue); None roots a worker-local trace instead
+            with tracer.start_span(
+                    "prefill.forward", parent=req.trace_ctx,
+                    attributes={"tokens": len(req.token_ids)},
+                    request_id=req.request_id) as fsp:
+                first, pages = await self.engine.prefill_only(pre, ctx)
+                fsp.set_attribute("pages", len(pages))
 
             ps = self.engine.ecfg.page_size
             n_prompt_pages = math.ceil(len(req.token_ids) / ps)
@@ -135,44 +145,72 @@ class PrefillWorker:
         """Ship the pages, surviving a decode-worker restart: the cached
         client may point at a dead host:port, so on failure evict it,
         re-resolve the endpoint from DCP, and retry once with a fresh
-        connection before giving up on the job."""
-        client = await self._client(req.engine_id)
+        connection before giving up on the job. Stage times accumulate
+        into a per-send TransferStats (exact per-request trace spans) and
+        fold into the shared ``self.xfer`` totals afterwards."""
+        tracer = tracing.get_tracer()
+        per = TransferStats()
+        span = tracer.start_span(
+            "kv_transfer.send", parent=req.trace_ctx,
+            attributes={"engine_id": f"{req.engine_id:x}",
+                        "pages": len(local_send),
+                        "chunk_pages": self.chunk_pages})
         try:
-            await self._send_once(client, req, local_send, remote_dst, first)
-        except asyncio.CancelledError:
-            raise
-        except Exception as exc:  # noqa: BLE001 — retry via fresh endpoint
-            self._evict(req.engine_id, client)
-            self.client_evictions += 1
-            log.warning("KV send for %s to engine %x failed (%s); "
-                        "re-resolving endpoint and retrying",
-                        req.request_id, req.engine_id, exc)
-            client = await self._client(req.engine_id)
-            await self._send_once(client, req, local_send, remote_dst, first)
+            with span:
+                client = await self._client(req.engine_id)
+                try:
+                    await self._send_once(client, req, local_send,
+                                          remote_dst, first, per)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — retry fresh
+                    self._evict(req.engine_id, client)
+                    self.client_evictions += 1
+                    log.warning("KV send for %s to engine %x failed (%s); "
+                                "re-resolving endpoint and retrying",
+                                req.request_id, req.engine_id, exc)
+                    client = await self._client(req.engine_id)
+                    await self._send_once(client, req, local_send,
+                                          remote_dst, first, per)
+                span.set_attribute("bytes", per.bytes_sent)
+                span.set_attribute("chunks", per.chunks_sent)
+                # adopt the measured stage accumulators as child spans
+                # (stages overlap, so siblings legitimately sum past the
+                # parent's wall — that inequality IS the pipelining)
+                for stage, secs in (("extract", per.extract_seconds),
+                                    ("compress", per.compress_seconds),
+                                    ("wire", per.wire_seconds),
+                                    ("ack_wait", per.ack_wait_seconds)):
+                    if secs > 0:
+                        tracer.record_span(f"kv_transfer.{stage}", secs,
+                                           parent=span)
+        finally:
+            self.xfer.merge(per)
 
     async def _send_once(self, client: KvTransferClient,
                          req: RemotePrefillRequest, local_send: List[int],
-                         remote_dst: List[int], first: int) -> None:
+                         remote_dst: List[int], first: int,
+                         stats: TransferStats) -> None:
         cp = self.chunk_pages
         if cp and local_send:
             n_chunks = math.ceil(len(local_send) / cp)
-            frames = self._frames(local_send, remote_dst, cp)
+            frames = self._frames(local_send, remote_dst, cp, stats)
             await client.send_kv_chunked(req.request_id, n_chunks, frames,
-                                         first)
+                                         first, stats=stats)
         else:
             t0 = time.monotonic()
             k, v = await self.engine.extract_pages(local_send)
             dt = time.monotonic() - t0
-            self.xfer.extract_seconds += dt
+            stats.extract_seconds += dt
             # bulk runs extract BEFORE the send; count it into the wall so
             # the stage-sum-vs-wall overlap comparison is apples-to-apples
             # with the chunked pipeline (whose wall covers extraction)
-            self.xfer.wall_seconds += dt
+            stats.wall_seconds += dt
             await client.send_kv(req.request_id, remote_dst, k, v, first,
-                                 compress=self.compress_kv)
+                                 compress=self.compress_kv, stats=stats)
 
     async def _frames(self, local_send: List[int], remote_dst: List[int],
-                      cp: int):
+                      cp: int, stats: TransferStats):
         """Chunk producer for the streaming protocol: ranged device→host
         extract (pipelined inside the engine) + optional int8 compression
         off the event loop. The client consumes this one chunk ahead, so
@@ -180,7 +218,7 @@ class PrefillWorker:
         loop = asyncio.get_running_loop()
         async for off, k, v, dt in self.engine.extract_pages_chunked(
                 local_send, cp):
-            self.xfer.extract_seconds += dt
+            stats.extract_seconds += dt
             dst = remote_dst[off:off + cp]
             k = np.ascontiguousarray(k)
             v = np.ascontiguousarray(v)
@@ -194,7 +232,7 @@ class PrefillWorker:
                                                     k)
                 vq, vs = await loop.run_in_executor(None, quantize_pages_np,
                                                     v)
-                self.xfer.compress_seconds += time.monotonic() - t0
+                stats.compress_seconds += time.monotonic() - t0
                 extra.update(quant="int8", k_len=kq.nbytes)
                 yield dst, extra, [kq, vq, ks, vs], (kq.nbytes + vq.nbytes
                                                      + ks.nbytes + vs.nbytes)

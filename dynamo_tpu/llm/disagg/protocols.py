@@ -26,9 +26,13 @@ class RemotePrefillRequest:
     page_ids: List[int] = field(default_factory=list)
     skip_pages: int = 0
     engine_id: int = 0          # decode engine instance (transfer lookup key)
+    # dyntrace context of the decode-side request, so the prefill worker's
+    # spans join the same trace. Absent on the wire = no parent (old
+    # peers interoperate unchanged).
+    trace_ctx: Optional[dict] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "request_id": self.request_id,
             "token_ids": list(self.token_ids),
             "sampling": self.sampling,
@@ -37,6 +41,9 @@ class RemotePrefillRequest:
             "skip_pages": self.skip_pages,
             "engine_id": self.engine_id,
         }
+        if self.trace_ctx is not None:
+            d["trace_ctx"] = self.trace_ctx
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "RemotePrefillRequest":
@@ -46,4 +53,5 @@ class RemotePrefillRequest:
                    eos_token_ids=list(d.get("eos_token_ids", [])),
                    page_ids=list(d.get("page_ids", [])),
                    skip_pages=int(d.get("skip_pages", 0)),
-                   engine_id=int(d.get("engine_id", 0)))
+                   engine_id=int(d.get("engine_id", 0)),
+                   trace_ctx=d.get("trace_ctx"))

@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ...runtime import codec
+from ...runtime import codec, tracing
 from ...runtime.codec import TwoPartMessage
 from ...runtime.dcp_client import DcpClient
 
@@ -82,6 +82,13 @@ class TransferStats:
         return {k: (round(v, 4) if isinstance(v, float) else v)
                 for k, v in self.__dict__.items()}
 
+    def merge(self, other: "TransferStats") -> None:
+        """Fold a per-send accumulator into this (shared) one — how the
+        worker keeps exact per-request stage figures for trace spans while
+        the fleet totals still aggregate."""
+        for k, v in other.__dict__.items():
+            setattr(self, k, getattr(self, k) + v)
+
 
 def _decode_body(h: dict, body: bytes) -> Tuple[np.ndarray, np.ndarray]:
     """Frame body → (k, v) host arrays in the header's declared layout.
@@ -116,7 +123,7 @@ class _IngestState:
     head-of-line-blocks other requests sharing the connection."""
 
     __slots__ = ("queue", "task", "received", "injected", "failed", "error",
-                 "committed")
+                 "committed", "inject_seconds", "bytes")
 
     def __init__(self):
         self.queue: asyncio.Queue = asyncio.Queue()
@@ -126,6 +133,8 @@ class _IngestState:
         self.failed = False
         self.error: Optional[str] = None
         self.committed = False
+        self.inject_seconds = 0.0   # per-stream inject time (trace span)
+        self.bytes = 0
 
 
 class KvTransferServer:
@@ -312,6 +321,16 @@ class KvTransferServer:
                             fut.set_result(int(h["first_token"]))
                         st.committed = True
                         ack["committed"] = True
+                        if h.get("trace"):
+                            # receiver-side stage span, joined to the
+                            # sender's trace via the frame-header ctx
+                            tracing.get_tracer().record_span(
+                                "kv_transfer.inject", st.inject_seconds,
+                                parent=h["trace"],
+                                attributes={"request_id": request_id,
+                                            "pages": len(st.injected),
+                                            "bytes": st.bytes,
+                                            "chunks": st.received})
                     else:
                         st.failed = True
                         st.error = (f"incomplete stream: {st.received}"
@@ -346,9 +365,12 @@ class KvTransferServer:
             t0 = time.monotonic()
             k, v = _decode_body(h, body)
             await self.engine.inject_pages(page_ids, k, v)
+            dt = time.monotonic() - t0
             self.bytes_ingested += len(body)
             self.pages_ingested += len(page_ids)
-            self.ingest_seconds += time.monotonic() - t0
+            self.ingest_seconds += dt
+            st.inject_seconds += dt
+            st.bytes += len(body)
             st.injected.extend(page_ids)
         self.chunks_ingested += 1
         st.received += 1
@@ -458,16 +480,22 @@ class KvTransferClient:
     async def send_kv(self, request_id: str, page_ids, k: np.ndarray,
                       v: np.ndarray, first_token: int,
                       timeout: float = 60.0,
-                      compress: bool = False) -> None:
+                      compress: bool = False,
+                      stats: Optional[TransferStats] = None) -> None:
         """Bulk mode (``chunk_pages=0``): ship all pages
         [L, n, KV, ps, hd] + the first token in one frame; returns once
         the decode side has injected them (raises on remote failure).
         ``compress=True`` quantizes each (token, head) row to int8 +
         f32 scale before framing — ~half the DCN bytes, lossy (see
         engine/kv_compress.py); the header's dtype stays the ORIGINAL
-        so the receiver restores into its pool dtype."""
+        so the receiver restores into its pool dtype. ``stats`` overrides
+        the accumulator (per-send accounting for trace spans)."""
+        st = stats if stats is not None else self.stats
         header, parts = _bulk_frame(request_id, page_ids, k, v,
                                     first_token, compress)
+        tc = tracing.get_tracer().current_trace_ctx()
+        if tc is not None:
+            header["trace"] = tc
         q = self._register(request_id)
         t_wall = time.monotonic()
         try:
@@ -476,19 +504,20 @@ class KvTransferClient:
             self._writer.writelines(codec.encode_parts(header, parts))
             await self._writer.drain()
             now = time.monotonic()
-            self.stats.wire_seconds += now - t0
-            self.stats.bytes_sent += sum(p.nbytes for p in parts)
+            st.wire_seconds += now - t0
+            st.bytes_sent += sum(p.nbytes for p in parts)
             ack = await asyncio.wait_for(q.get(), timeout)
-            self.stats.ack_wait_seconds += time.monotonic() - now
+            st.ack_wait_seconds += time.monotonic() - now
         finally:
             self._pending.pop(request_id, None)
-            self.stats.wall_seconds += time.monotonic() - t_wall
-            self.stats.sends += 1
+            st.wall_seconds += time.monotonic() - t_wall
+            st.sends += 1
         self._check_ack(ack)
 
     async def send_kv_chunked(self, request_id: str, n_chunks: int, frames,
                               first_token: int,
-                              timeout: float = 60.0) -> None:
+                              timeout: float = 60.0,
+                              stats: Optional[TransferStats] = None) -> None:
         """Streamed mode: consume ``frames`` — an async iterator yielding
         ``(dst_page_ids, header_extra, body_parts, nbytes)`` per chunk —
         one chunk ahead, so producing chunk i+1 (device→host extract +
@@ -496,7 +525,10 @@ class KvTransferClient:
         final chunk carries the first token and acts as the commit; the
         call returns once the decode side acks that commit. On any
         failure an abort frame tears down the receiver's partial state
-        (which fails the decode-side waiter → immediate local fallback)."""
+        (which fails the decode-side waiter → immediate local fallback).
+        ``stats`` overrides the accumulator (per-send accounting)."""
+        st = stats if stats is not None else self.stats
+        tc = tracing.get_tracer().current_trace_ctx()
         q = self._register(request_id)
         t_wall = time.monotonic()
         nxt: Optional[asyncio.Future] = None
@@ -520,12 +552,14 @@ class KvTransferClient:
                           "page_ids": [int(p) for p in dst], **extra}
                 if idx == n_chunks - 1:
                     header["first_token"] = int(first_token)
+                    if tc is not None:  # commit chunk carries the trace ctx
+                        header["trace"] = tc
                 t0 = time.monotonic()
                 self._writer.writelines(codec.encode_parts(header, parts))
                 await self._writer.drain()
-                self.stats.wire_seconds += time.monotonic() - t0
-                self.stats.bytes_sent += nbytes
-                self.stats.chunks_sent += 1
+                st.wire_seconds += time.monotonic() - t0
+                st.bytes_sent += nbytes
+                st.chunks_sent += 1
                 idx += 1
                 # early-failure check: abort the remaining extract/send
                 # work the moment the receiver reports a chunk failure
@@ -543,7 +577,7 @@ class KvTransferClient:
                 ack = await asyncio.wait_for(q.get(), timeout)
                 self._check_ack(ack)
                 committed = bool(ack.get("committed"))
-            self.stats.ack_wait_seconds += time.monotonic() - t1
+            st.ack_wait_seconds += time.monotonic() - t1
         except BaseException:
             if nxt is not None:
                 nxt.cancel()
@@ -556,8 +590,8 @@ class KvTransferClient:
                 except Exception:  # noqa: BLE001 — teardown best-effort
                     pass
             self._pending.pop(request_id, None)
-            self.stats.wall_seconds += time.monotonic() - t_wall
-            self.stats.sends += 1
+            st.wall_seconds += time.monotonic() - t_wall
+            st.sends += 1
 
     async def _abort(self, request_id: str) -> None:
         """Best-effort abort frame: lets the receiver drop partial state

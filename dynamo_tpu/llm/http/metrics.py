@@ -17,6 +17,50 @@ PREFIX = "dyn_llm_http_service"
 # histogram buckets in seconds (reference uses prometheus defaults + LLM tail)
 BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
            30.0, 60.0, 120.0, 300.0]
+# inter-token-latency buckets: tuned for token cadence (ms-scale steady
+# state, sub-second tail when a decode window or preemption stalls a
+# stream) — the request-scale BUCKETS would collapse all ITLs into the
+# first two buckets
+ITL_BUCKETS = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+               1.0, 2.5]
+# per-stage (trace span) durations: sub-ms transfer stages up to
+# multi-second prefills
+STAGE_BUCKETS = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0]
+
+
+class _Histogram:
+    """One labeled histogram family (cumulative buckets + sum + count)."""
+
+    def __init__(self, buckets: List[float]):
+        self.ubs = buckets
+        self.buckets: Dict[str, List[int]] = defaultdict(
+            lambda: [0] * (len(buckets) + 1))
+        self.sum: Dict[str, float] = defaultdict(float)
+        self.count: Dict[str, int] = defaultdict(int)
+
+    def observe(self, label: str, value: float) -> None:
+        self.sum[label] += value
+        self.count[label] += 1
+        b = self.buckets[label]
+        for i, ub in enumerate(self.ubs):
+            if value <= ub:
+                b[i] += 1
+        b[-1] += 1  # +Inf
+
+    def render(self, lines: List[str], metric: str, label_key: str) -> None:
+        for label in sorted(self.count):
+            for i, ub in enumerate(self.ubs):
+                lines.append(
+                    f'{metric}_bucket{{{label_key}="{label}",le="{ub}"}} '
+                    f'{self.buckets[label][i]}')
+            lines.append(
+                f'{metric}_bucket{{{label_key}="{label}",le="+Inf"}} '
+                f'{self.buckets[label][-1]}')
+            lines.append(f'{metric}_sum{{{label_key}="{label}"}} '
+                         f'{self.sum[label]}')
+            lines.append(f'{metric}_count{{{label_key}="{label}"}} '
+                         f'{self.count[label]}')
 
 
 class Metrics:
@@ -31,6 +75,11 @@ class Metrics:
         self.ttft_sum: Dict[str, float] = defaultdict(float)
         self.ttft_count: Dict[str, int] = defaultdict(int)
         self.output_tokens_total: Dict[str, int] = defaultdict(int)
+        # inter-token latency (streamed requests, gap between successive
+        # token-bearing chunks) — the pair metric TTFT alone can't show
+        self.itl = _Histogram(ITL_BUCKETS)
+        # per-stage durations fed from finished dyntrace spans
+        self.stage = _Histogram(STAGE_BUCKETS)
 
     def guard(self, model: str, endpoint: str, request_type: str) -> "InflightGuard":
         return InflightGuard(self, model, endpoint, request_type)
@@ -47,6 +96,12 @@ class Metrics:
     def observe_ttft(self, model: str, seconds: float) -> None:
         self.ttft_sum[model] += seconds
         self.ttft_count[model] += 1
+
+    def observe_itl(self, model: str, seconds: float) -> None:
+        self.itl.observe(model, seconds)
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        self.stage.observe(stage, seconds)
 
     def count_output_tokens(self, model: str, n: int) -> None:
         self.output_tokens_total[model] += n
@@ -94,6 +149,12 @@ class Metrics:
         _h("output_tokens_total", "counter", "Total generated tokens")
         for model, n in sorted(self.output_tokens_total.items()):
             lines.append(f'{PREFIX}_output_tokens_total{{model="{model}"}} {n}')
+        _h("itl_seconds", "histogram",
+           "Inter-token latency for streamed requests")
+        self.itl.render(lines, f"{PREFIX}_itl_seconds", "model")
+        _h("stage_duration_seconds", "histogram",
+           "Per-stage request durations from dyntrace spans")
+        self.stage.render(lines, f"{PREFIX}_stage_duration_seconds", "stage")
         return "\n".join(lines) + "\n"
 
 

@@ -14,10 +14,12 @@ import asyncio
 import json
 import logging
 import time
+import uuid
 from typing import AsyncIterator, Callable, Dict, Optional
 
 from aiohttp import web
 
+from ...runtime import tracing
 from ...runtime.engine import Annotated, Context
 from ...runtime.tasks import spawn_tracked
 from ..protocols.openai import (ChatAggregator, ChatCompletionRequest,
@@ -69,12 +71,21 @@ class HttpService:
             web.post("/v1/chat/completions", self._chat),
             web.post("/v1/completions", self._completions),
             web.get("/v1/models", self._models),
+            web.get("/v1/traces", self._traces),
+            web.get("/v1/traces/{request_id}", self._trace_one),
             web.get("/metrics", self._metrics),
             web.get("/health", self._health),
             web.get("/live", self._health),
         ])
         self._runner: Optional[web.AppRunner] = None
         self.port = 0
+        # summarize finished dyntrace spans into the per-stage duration
+        # histograms (dyn_llm_http_service_stage_duration_seconds)
+        tracing.get_tracer().add_listener(self._on_span_end)
+
+    def _on_span_end(self, span) -> None:
+        if span.duration_s is not None:
+            self.metrics.observe_stage(span.name, span.duration_s)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -104,6 +115,23 @@ class HttpService:
         return web.Response(text=self.metrics.render(),
                             content_type="text/plain", charset="utf-8")
 
+    async def _traces(self, request: web.Request) -> web.Response:
+        """Debug listing: recent traces (newest first) + the registered
+        engine step timelines."""
+        tracer = tracing.get_tracer()
+        return web.json_response({
+            "traces": tracer.traces_summary(),
+            "engine_steps": tracing.timelines_snapshot(),
+        })
+
+    async def _trace_one(self, request: web.Request) -> web.Response:
+        rid = request.match_info["request_id"]
+        data = tracing.get_tracer().get_request_trace(rid)
+        if data is None:
+            return _error_response(404, f"no trace for request {rid!r}",
+                                   {"X-Request-Id": rid})
+        return web.json_response(data, headers={"X-Request-Id": rid})
+
     async def _chat(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, ChatCompletionRequest,
                                  self.manager.chat_engines, "chat_completions")
@@ -114,59 +142,85 @@ class HttpService:
 
     async def _serve(self, request: web.Request, model_cls, engines: dict,
                      endpoint: str) -> web.StreamResponse:
-        try:
-            body = await request.json()
-            req = model_cls(**body)
-        except Exception as e:  # noqa: BLE001
-            return _error_response(400, f"invalid request: {e}")
-        engine = engines.get(req.model)
-        if engine is None:
-            return _error_response(
-                404, f"model {req.model!r} not found; available: "
-                     f"{sorted(engines)}")
-        guard = self.metrics.guard(
-            req.model, endpoint, "stream" if req.stream else "unary")
-        ctx = Context()
-        try:
-            t0 = time.monotonic()
-            n = getattr(req, "n", 1) or 1
-            if n > 1:
-                aiter = _fanout_choices(engine, req, ctx, n).__aiter__()
-            else:
-                aiter = engine(req, ctx).__aiter__()
-            # pull the first item BEFORE committing response headers so
-            # early failures (validation, routing) map to clean HTTP errors
+        # request identity: echo the client's X-Request-Id (or mint one) on
+        # EVERY response — SSE streams and error paths included — so logs,
+        # traces and client records join on one id
+        rid = (request.headers.get("X-Request-Id") or "").strip()[:128] \
+            or uuid.uuid4().hex
+        tracing.bind_request_id(rid)
+        tracer = tracing.get_tracer()
+        span = tracer.start_span(
+            "http.request",
+            parent=tracing.parse_traceparent(
+                request.headers.get("traceparent")),
+            attributes={"endpoint": endpoint, "method": request.method,
+                        "path": request.path},
+            request_id=rid)
+        hdrs = {"X-Request-Id": rid}
+        tp = tracing.format_traceparent(span)
+        if tp:
+            hdrs["traceparent"] = tp
+        with span:
             try:
-                first = await aiter.__anext__()
-            except StopAsyncIteration:
-                first = None
-            if req.stream:
-                return await self._sse(request, req, first, aiter, ctx, guard, t0)
-            return await self._unary(req, first, aiter, endpoint, guard)
-        except ValueError as e:
-            return _error_response(400, str(e))
-        except (ConnectionResetError, asyncio.CancelledError):
-            raise  # client went away; never answer with a second response
-        except Exception as e:  # noqa: BLE001
-            log.exception("request %s failed", ctx.id)
-            return _error_response(500, repr(e))
-        finally:
-            guard.done()
+                body = await request.json()
+                req = model_cls(**body)
+            except Exception as e:  # noqa: BLE001
+                return _error_response(400, f"invalid request: {e}", hdrs)
+            engine = engines.get(req.model)
+            if engine is None:
+                return _error_response(
+                    404, f"model {req.model!r} not found; available: "
+                         f"{sorted(engines)}", hdrs)
+            span.set_attribute("model", req.model)
+            span.set_attribute("stream", bool(req.stream))
+            guard = self.metrics.guard(
+                req.model, endpoint, "stream" if req.stream else "unary")
+            ctx = Context(rid)
+            try:
+                t0 = time.monotonic()
+                n = getattr(req, "n", 1) or 1
+                if n > 1:
+                    aiter = _fanout_choices(engine, req, ctx, n).__aiter__()
+                else:
+                    aiter = engine(req, ctx).__aiter__()
+                # pull the first item BEFORE committing response headers so
+                # early failures (validation, routing) map to clean errors
+                try:
+                    first = await aiter.__anext__()
+                except StopAsyncIteration:
+                    first = None
+                if req.stream:
+                    return await self._sse(request, req, first, aiter, ctx,
+                                           guard, t0, hdrs)
+                return await self._unary(req, first, aiter, endpoint, guard,
+                                         hdrs)
+            except ValueError as e:
+                return _error_response(400, str(e), hdrs)
+            except (ConnectionResetError, asyncio.CancelledError):
+                raise  # client went away; never answer a second time
+            except Exception as e:  # noqa: BLE001
+                log.exception("request %s failed", ctx.id)
+                return _error_response(500, repr(e), hdrs)
+            finally:
+                guard.done()
 
     async def _sse(self, http_request: web.Request, req, first, aiter,
-                   ctx: Context, guard, t0: float) -> web.StreamResponse:
+                   ctx: Context, guard, t0: float,
+                   hdrs: Optional[dict] = None) -> web.StreamResponse:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
             "Connection": "keep-alive",
+            **(hdrs or {}),
         })
         await resp.prepare(http_request)
         errored = False
         saw_first_token = False
+        last_token_t: Optional[float] = None
 
         async def _write_chunk(chunk) -> bool:
             """Writes one stream item; returns False to stop the stream."""
-            nonlocal errored, saw_first_token
+            nonlocal errored, saw_first_token, last_token_t
             if chunk is None:
                 return True
             if isinstance(chunk, Annotated) and chunk.event and chunk.data is None:
@@ -184,9 +238,14 @@ class HttpService:
             data = _chunk_dict(chunk)
             if data is None:
                 return True
+            now = time.monotonic()
             if not saw_first_token:
-                self.metrics.observe_ttft(req.model, time.monotonic() - t0)
+                self.metrics.observe_ttft(req.model, now - t0)
                 saw_first_token = True
+            elif last_token_t is not None:
+                # inter-token latency: gap between successive data chunks
+                self.metrics.observe_itl(req.model, now - last_token_t)
+            last_token_t = now
             await resp.write(b"data: " + json.dumps(data).encode() + b"\n\n")
             return True
 
@@ -214,7 +273,7 @@ class HttpService:
         return resp
 
     async def _unary(self, req, first, aiter, endpoint: str,
-                     guard) -> web.Response:
+                     guard, hdrs: Optional[dict] = None) -> web.Response:
         async def _items():
             if first is not None:
                 yield first
@@ -225,7 +284,7 @@ class HttpService:
             agg = ChatAggregator(req.model)
             async for chunk in _items():
                 if isinstance(chunk, Annotated) and chunk.is_error:
-                    return _error_response(500, chunk.error_message())
+                    return _error_response(500, chunk.error_message(), hdrs)
                 data = _chunk_dict(chunk)
                 if data is None:
                     continue
@@ -233,11 +292,12 @@ class HttpService:
 
                 agg.add_chunk(ChatCompletionChunk(**data))
             guard.mark_ok()
-            return web.json_response(agg.response().model_dump(exclude_none=True))
+            return web.json_response(agg.response().model_dump(exclude_none=True),
+                                     headers=hdrs)
         agg = CompletionAggregator(req.model)
         async for chunk in _items():
             if isinstance(chunk, Annotated) and chunk.is_error:
-                return _error_response(500, chunk.error_message())
+                return _error_response(500, chunk.error_message(), hdrs)
             data = _chunk_dict(chunk)
             if data is None:
                 continue
@@ -251,7 +311,8 @@ class HttpService:
 
                 agg.usage = Usage(**data["usage"])
         guard.mark_ok()
-        return web.json_response(agg.response().model_dump(exclude_none=True))
+        return web.json_response(agg.response().model_dump(exclude_none=True),
+                                 headers=hdrs)
 
 
 async def _fanout_choices(engine, req, ctx: Context, n: int):
@@ -431,8 +492,9 @@ def _chunk_dict(chunk) -> Optional[dict]:
     return chunk
 
 
-def _error_response(status: int, message: str) -> web.Response:
+def _error_response(status: int, message: str,
+                    headers: Optional[dict] = None) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": "invalid_request_error"
                    if status < 500 else "internal_error", "code": status}},
-        status=status)
+        status=status, headers=headers)

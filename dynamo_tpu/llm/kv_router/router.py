@@ -14,6 +14,7 @@ import asyncio
 import logging
 from typing import Dict, Optional, Sequence
 
+from ...runtime import tracing
 from ...runtime.component import Client
 from ...runtime.dcp_client import DcpClient, pack, unpack
 from ...runtime.runtime import DistributedRuntime
@@ -112,16 +113,22 @@ class KvRouter:
 
     async def schedule(self, token_ids: Sequence[int]) -> int:
         """token_ids → worker instance id."""
-        if not self.scheduler.workers:
-            await self.scrape_once()
-        if not self.scheduler.workers:
-            # no stats yet: fall back to any live instance
-            ids = await self.client.wait_for_instances(timeout=10)
-            self.scheduler.update_metrics(
-                {wid: ForwardPassMetrics() for wid in ids})
-        overlaps = self.indexer.find_matches_for_request(token_ids)
-        # only consider overlaps from live workers
-        return self.scheduler.schedule(len(token_ids), overlaps)
+        with tracing.get_tracer().start_span("route", attributes={
+                "tokens": len(token_ids)}) as span:
+            if not self.scheduler.workers:
+                await self.scrape_once()
+            if not self.scheduler.workers:
+                # no stats yet: fall back to any live instance
+                ids = await self.client.wait_for_instances(timeout=10)
+                self.scheduler.update_metrics(
+                    {wid: ForwardPassMetrics() for wid in ids})
+            overlaps = self.indexer.find_matches_for_request(token_ids)
+            # only consider overlaps from live workers
+            wid = self.scheduler.schedule(len(token_ids), overlaps)
+            span.set_attribute("worker_id", f"{wid:x}")
+            span.set_attribute("overlap_blocks",
+                               overlaps.scores.get(wid, 0))
+            return wid
 
     def overlap_for(self, token_ids: Sequence[int], worker_id: int) -> int:
         """Matched prefix BLOCKS on the chosen worker (feeds the disagg

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import AsyncIterator, List, Optional, Tuple
 
+from ..runtime import tracing
 from ..runtime.engine import Annotated, Context
 from .model_card import ModelDeploymentCard
 from .protocols.common import (EngineOutput, OutputOptions, PreprocessedRequest,
@@ -36,20 +37,30 @@ class OpenAIPreprocessor:
     def preprocess_chat(
         self, request: ChatCompletionRequest
     ) -> Tuple[PreprocessedRequest, List[Annotated]]:
-        ext = request.extension()
-        if ext.use_raw_prompt and request.messages:
-            prompt = "".join(m.text() for m in request.messages)
-        else:
-            prompt = self.tokenizer.apply_chat_template(
-                [{"role": m.role, "content": m.text()} for m in request.messages],
-                add_generation_prompt=True)
-        token_ids = self.tokenizer.encode(prompt)
-        pre = self._build(request, token_ids, request.max_output_tokens())
-        annotations = self._annotations(ext.annotations or [], prompt, token_ids)
-        return pre, annotations
+        with tracing.get_tracer().start_span("preprocess") as span:
+            ext = request.extension()
+            if ext.use_raw_prompt and request.messages:
+                prompt = "".join(m.text() for m in request.messages)
+            else:
+                prompt = self.tokenizer.apply_chat_template(
+                    [{"role": m.role, "content": m.text()}
+                     for m in request.messages],
+                    add_generation_prompt=True)
+            token_ids = self.tokenizer.encode(prompt)
+            span.set_attribute("tokens", len(token_ids))
+            pre = self._build(request, token_ids, request.max_output_tokens())
+            annotations = self._annotations(ext.annotations or [], prompt,
+                                            token_ids)
+            return pre, annotations
 
     def preprocess_completion(
         self, request: CompletionRequest
+    ) -> Tuple[PreprocessedRequest, List[Annotated]]:
+        with tracing.get_tracer().start_span("preprocess") as span:
+            return self._preprocess_completion(request, span)
+
+    def _preprocess_completion(
+        self, request: CompletionRequest, span
     ) -> Tuple[PreprocessedRequest, List[Annotated]]:
         ext = request.extension()
         prompt = request.prompt
@@ -72,6 +83,7 @@ class OpenAIPreprocessor:
                 "supported; send one request per prompt")
         else:
             raise ValueError("prompt must be a non-empty string or token list")
+        span.set_attribute("tokens", len(token_ids))
         pre = self._build(request, token_ids, request.max_tokens)
         annotations = self._annotations(
             ext.annotations or [], prompt_text or "", token_ids)

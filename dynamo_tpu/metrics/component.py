@@ -40,6 +40,10 @@ class MetricsAggregator:
         self.hit_rate_isl_blocks = 0
         self.hit_rate_overlap_blocks = 0
         self.hit_rate_events = 0
+        # failed scrape attempts (the PR 3 backoff path, now visible in
+        # the exposition instead of only the logs)
+        self.scrape_failures_total = 0
+        self.consecutive_scrape_failures = 0
         self._client: Optional[Client] = None
         self._task: Optional[asyncio.Task] = None
         self._sid: Optional[int] = None
@@ -78,8 +82,10 @@ class MetricsAggregator:
                 # bounded backoff: a persistently-down stats plane gets
                 # polled gently instead of hammered every interval forever
                 failures += 1
+                self.scrape_failures_total += 1
                 log.exception("metrics scrape failed "
                               "(%d consecutive failures)", failures)
+            self.consecutive_scrape_failures = failures
             await asyncio.sleep(backoff_interval(self.interval, failures))
 
     async def scrape_once(self) -> None:
@@ -170,6 +176,17 @@ class MetricsAggregator:
         lines.append("# TYPE dyn_kv_hit_rate_events counter")
         lines.append(f'dyn_kv_hit_rate_events{{namespace="{ns}"}} '
                      f'{self.hit_rate_events}')
+        lines.append("# HELP dyn_metrics_scrape_failures_total failed "
+                     "stats-plane scrape attempts (backoff path)")
+        lines.append("# TYPE dyn_metrics_scrape_failures_total counter")
+        lines.append(f'dyn_metrics_scrape_failures_total{{namespace="{ns}"}} '
+                     f'{self.scrape_failures_total}')
+        lines.append("# HELP dyn_metrics_consecutive_scrape_failures "
+                     "current failure streak driving the scrape backoff")
+        lines.append("# TYPE dyn_metrics_consecutive_scrape_failures gauge")
+        lines.append(
+            f'dyn_metrics_consecutive_scrape_failures{{namespace="{ns}"}} '
+            f'{self.consecutive_scrape_failures}')
         return "\n".join(lines) + "\n"
 
 

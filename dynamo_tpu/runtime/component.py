@@ -17,6 +17,7 @@ import random
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
 
+from . import tracing
 from .codec import TwoPartMessage
 from .dcp_client import DcpClient, Message, NoRespondersError, pack, unpack
 from .engine import Annotated, Context
@@ -255,6 +256,9 @@ class ServeHandle:
             req_id = envelope["req_id"]
             conn_info = TcpConnectionInfo.from_dict(envelope["conn"])
             request = unpack(envelope["payload"])
+            # dyntrace wire propagation: absent field = no parent (old
+            # peers interoperate unchanged)
+            trace_ctx = envelope.get("trace")
         except Exception as e:  # noqa: BLE001
             if msg.needs_reply:
                 await msg.respond_error(f"bad request envelope: {e!r}")
@@ -262,13 +266,21 @@ class ServeHandle:
         if msg.needs_reply:
             await msg.respond(pack({"accepted": True,
                                     "instance_id": self.instance.instance_id}))
-        spawn_tracked(self._run_request(req_id, conn_info, request),
+        spawn_tracked(self._run_request(req_id, conn_info, request, trace_ctx),
                       name=f"serve-{req_id}")
 
     async def _run_request(self, req_id: str, conn_info: TcpConnectionInfo,
-                           request: Any) -> None:
+                           request: Any,
+                           trace_ctx: Optional[dict] = None) -> None:
         ctx = Context(req_id)
         self._inflight[req_id] = ctx
+        tracing.bind_request_id(req_id)
+        tracer = tracing.get_tracer()
+        span = tracer.start_span(
+            f"serve.{self.instance.endpoint}",
+            parent=trace_ctx,  # None → new (sampled) root for this worker
+            attributes={"subject": self.instance.subject},
+            request_id=req_id)
 
         def on_ctrl(kind: str) -> None:
             if kind == "stop":
@@ -278,16 +290,18 @@ class ServeHandle:
 
         callhome: Optional[TcpCallHome] = None
         try:
-            callhome = await TcpCallHome.connect(conn_info, on_ctrl)
-            agen = self.handler(request, ctx)
-            async for item in agen:
-                if ctx.killed:
-                    break
-                env = item if isinstance(item, Annotated) else Annotated(data=item)
-                if env.id is None:
-                    env.id = req_id
-                await callhome.send_data(pack(env.to_dict()))
-            await callhome.complete()
+            with span:
+                callhome = await TcpCallHome.connect(conn_info, on_ctrl)
+                agen = self.handler(request, ctx)
+                async for item in agen:
+                    if ctx.killed:
+                        break
+                    env = item if isinstance(item, Annotated) \
+                        else Annotated(data=item)
+                    if env.id is None:
+                        env.id = req_id
+                    await callhome.send_data(pack(env.to_dict()))
+                await callhome.complete()
         except asyncio.CancelledError:
             if callhome:
                 await callhome.error("worker cancelled")
@@ -431,11 +445,15 @@ class Client:
         ctx = context or Context()
         server: TcpStreamServer = await self.drt.tcp_server()
         pending = server.register()
-        envelope = pack({
+        env_dict = {
             "req_id": ctx.id,
             "conn": TcpConnectionInfo(server.address, pending.subject).to_dict(),
             "payload": pack(request),
-        })
+        }
+        trace_ctx = tracing.get_tracer().current_trace_ctx()
+        if trace_ctx is not None:  # omitted entirely when not sampled
+            env_dict["trace"] = trace_ctx
+        envelope = pack(env_dict)
         try:
             ack = unpack(await self.drt.dcp.request(subject, envelope,
                                                     timeout=timeout))

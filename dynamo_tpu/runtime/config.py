@@ -58,6 +58,19 @@ register_env("DYN_LOGGING_JSONL", "0", "runtime",
              "Emit JSONL structured logs instead of text (1/true).")
 register_env("DYN_REQUEST_TIMEOUT", "60.0", "runtime",
              "Default request-plane timeout in seconds.")
+register_env("DYN_STEP_TIMELINE", "512", "runtime",
+             "Engine step-timeline ring capacity (events kept per engine "
+             "for /v1/traces); 0 disables the timeline.")
+register_env("DYN_TRACE_JSONL", None, "runtime",
+             "Path to append one JSON line per finished trace span "
+             "(dyntrace export; unset = in-memory ring only).")
+register_env("DYN_TRACE_RING", "4096", "runtime",
+             "dyntrace in-memory ring capacity (finished spans kept per "
+             "process for /v1/traces).")
+register_env("DYN_TRACE_SAMPLE", "1.0", "runtime",
+             "dyntrace sampling rate in [0,1], decided per root span "
+             "(children follow their parent). 0 disables all tracing "
+             "instrumentation (no spans, no envelope fields).")
 
 register_env("DYN_ADMIN_TOKENS", None, "admin",
              "Inline JSON token map for the admin API (absent = open API).")

@@ -312,6 +312,68 @@ def test_dl006_allows_config_module():
                                 "dynamo_tpu/runtime/config.py")
 
 
+# ------------------------------------------------------- DL007 span-not-closed
+
+
+DL007_BAD = """
+def handler(tracer):
+    tracer.start_span("http.request")          # dropped outright
+"""
+
+DL007_BAD_ASSIGNED = """
+def handler(tracer):
+    span = tracer.start_span("http.request")
+    span.set_attribute("model", "m")           # used, but never closed
+"""
+
+DL007_BAD_ATTR = """
+class Svc:
+    def begin(self, tracer):
+        self._span = tracer.start_span("op")   # no end() anywhere
+"""
+
+DL007_GOOD = """
+def with_form(tracer):
+    with tracer.start_span("http.request") as span:
+        span.set_attribute("model", "m")
+
+def explicit_end(tracer):
+    span = tracer.start_span("op")
+    span.set_attribute("k", 1)
+    span.end()
+
+def with_variable(tracer):
+    span = tracer.start_span("op")
+    with span:
+        pass
+
+def escapes(tracer):
+    return tracer.start_span("op")             # caller owns closing
+
+class Svc:
+    def begin(self, tracer):
+        self._span = tracer.start_span("op")
+    def finish(self):
+        self._span.end()
+"""
+
+
+def test_dl007_fires_on_dropped_span():
+    assert "DL007" in codes(DL007_BAD)
+
+
+def test_dl007_fires_on_unclosed_assignment():
+    assert "DL007" in codes(DL007_BAD_ASSIGNED)
+
+
+def test_dl007_fires_on_unclosed_attr():
+    assert "DL007" in codes(DL007_BAD_ATTR)
+
+
+def test_dl007_quiet_on_good():
+    assert "DL007" not in codes(DL007_GOOD)
+
+
 # ----------------------------------------------------------------- suppression
 
 

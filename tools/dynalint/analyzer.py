@@ -33,6 +33,10 @@ RULES: Dict[str, Tuple[str, str]] = {
     "DL006": ("untracked-env-read",
               "os.environ read outside runtime/config.py: route it through "
               "the env registry so the knob is documented"),
+    "DL007": ("span-not-closed",
+              "tracer.start_span(...) result used without `with` or an "
+              "explicit end(): the span never finishes and leaks from "
+              "every trace"),
 }
 
 NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}
@@ -93,6 +97,11 @@ HOT_SYNC_ALLOWLIST = frozenset({
 
 # DL006: modules allowed to touch os.environ directly (the registry itself).
 ENV_ALLOWED_SUFFIXES = ("runtime/config.py",)
+
+# DL007: the span-starting call (method or bare name) and the attribute
+# accesses that count as "the span is closed somewhere".
+SPAN_START_ATTRS = frozenset({"start_span"})
+SPAN_CLOSE_ATTRS = frozenset({"end", "__exit__"})
 
 SUPPRESS_RE = re.compile(r"#\s*dynalint:\s*disable=([A-Za-z0-9_,\-]+)")
 
@@ -178,6 +187,9 @@ class _Analyzer(ast.NodeVisitor):
         # DL002 two-phase state
         self._spawn_candidates: List[Tuple[Tuple, Violation]] = []
         self._tracked_keys: Set[Tuple] = set()
+        # DL007 two-phase state (same shape: candidates resolved at EOF)
+        self._span_candidates: List[Tuple[Tuple, Violation]] = []
+        self._span_closed_keys: Set[Tuple] = set()
         norm = path.replace(os.sep, "/")
         self._is_engine = any(m in norm for m in HOT_PATH_MARKERS)
         self._env_allowed = norm.endswith(ENV_ALLOWED_SUFFIXES)
@@ -278,6 +290,9 @@ class _Analyzer(ast.NodeVisitor):
         locky = any(_is_lock_expr(item.context_expr) for item in node.items)
         if locky:
             self._lock_depth[-1] += 1
+        for item in node.items:
+            # DL007: `with span:` closes a previously-started span variable
+            self._note_span_closed(item.context_expr)
         self.generic_visit(node)
         if locky:
             self._lock_depth[-1] -= 1
@@ -303,6 +318,16 @@ class _Analyzer(ast.NodeVisitor):
         if d in TRACKING_SINKS or attr in ("gather", "wait", "wait_for"):
             for arg in node.args:
                 self._note_tracked(arg)
+
+        if attr in SPAN_START_ATTRS or d in SPAN_START_ATTRS:
+            self._record_span_start(node)
+        else:
+            # escape analysis: a span VARIABLE handed to any call transfers
+            # ownership (e.g. a relay helper that ends it) — only plain
+            # name/attribute args count, so literals don't mask candidates
+            for arg in node.args:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    self._note_span_closed(arg)
 
         if self._is_engine and self._in_hot_func():
             self._check_host_sync(node, d, attr)
@@ -351,9 +376,47 @@ class _Analyzer(ast.NodeVisitor):
         if key is not None:
             self._tracked_keys.add(key)
 
+    # ------------------------------------------------------ DL007 open spans
+
+    def _record_span_start(self, node: ast.Call) -> None:
+        parent = getattr(node, "_dl_parent", None)
+        # closed forms: `with tracer.start_span(...)`, returned, awaited,
+        # or passed straight into a call that takes ownership
+        if isinstance(parent, (ast.withitem, ast.Return, ast.Await,
+                               ast.Call)):
+            return
+        if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.NamedExpr)):
+            targets = (parent.targets if isinstance(parent, ast.Assign)
+                       else [parent.target])
+            for t in targets:
+                key = _task_ref_key(t, self._class_scope, self._func_id)
+                if key is None:
+                    return  # exotic target: assume tracked
+                v = self.report(node, "DL007",
+                                f"span assigned to `{ast.unparse(t)}` but "
+                                f"never entered (`with`) or end()ed")
+                if v is not None:
+                    self._span_candidates.append((key, v))
+            return
+        # bare expression statement: the Span object is dropped unclosed
+        self.emit(node, "DL007", "span result is dropped")
+
+    def _note_span_closed(self, node: ast.AST) -> None:
+        key = _task_ref_key(node, self._class_scope, self._func_id)
+        if key is not None:
+            self._span_closed_keys.add(key)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if node.value is not None:
+            # a returned span escapes to the caller (who owns closing it)
+            self._note_span_closed(node.value)
+        self.generic_visit(node)
+
     def visit_Attribute(self, node: ast.Attribute) -> None:
         if node.attr in TRACKING_ATTRS:
             self._note_tracked(node.value)
+        if node.attr in SPAN_CLOSE_ATTRS:
+            self._note_span_closed(node.value)
         self.generic_visit(node)
 
     def visit_Await(self, node: ast.Await) -> None:
@@ -417,6 +480,9 @@ class _Analyzer(ast.NodeVisitor):
     def finalize(self) -> List[Violation]:
         for key, violation in self._spawn_candidates:
             if key not in self._tracked_keys:
+                self.violations.append(violation)
+        for key, violation in self._span_candidates:
+            if key not in self._span_closed_keys:
                 self.violations.append(violation)
         self.violations.sort(key=lambda v: (v.path, v.line, v.code))
         return self.violations
