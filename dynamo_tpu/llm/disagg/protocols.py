@@ -7,6 +7,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ...runtime import wire
+
 
 @dataclass
 class RemotePrefillRequest:
@@ -43,10 +45,11 @@ class RemotePrefillRequest:
         }
         if self.trace_ctx is not None:
             d["trace_ctx"] = self.trace_ctx
-        return d
+        return wire.checked(wire.PREFILL_REMOTE_REQUEST, d)
 
     @classmethod
     def from_dict(cls, d: dict) -> "RemotePrefillRequest":
+        d = wire.decoded(wire.PREFILL_REMOTE_REQUEST, d)
         return cls(request_id=d["request_id"],
                    token_ids=list(d["token_ids"]),
                    sampling=d.get("sampling", {}),

@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ...runtime import codec, tracing
+from ...runtime import codec, tracing, wire
 from ...runtime.codec import TwoPartMessage
 from ...runtime.dcp_client import DcpClient
 
@@ -90,10 +90,14 @@ class TransferStats:
             setattr(self, k, getattr(self, k) + v)
 
 
+_KV_FRAMES = (wire.KV_TRANSFER_BULK, wire.KV_TRANSFER_CHUNK)
+
+
 def _decode_body(h: dict, body: bytes) -> Tuple[np.ndarray, np.ndarray]:
     """Frame body → (k, v) host arrays in the header's declared layout.
     Shared by the bulk and chunk paths so both speak one body format:
     raw ``k‖v`` or int8 ``k_q‖v_q‖k_s‖v_s`` (engine/kv_compress.py)."""
+    h = wire.decoded(_KV_FRAMES, h)
     shape = tuple(h["shape"])  # [L, n, KV, ps, hd]
     dtype = _np_dtype(h["dtype"])
     k_len = h["k_len"]
@@ -240,9 +244,37 @@ class KvTransferServer:
                 except (asyncio.IncompleteReadError, ConnectionError,
                         codec.CodecError):
                     return
-                h = msg.header
+                h = wire.decoded(
+                    _KV_FRAMES + (wire.KV_TRANSFER_ABORT,), msg.header)
                 rid = h.get("request_id")
-                if h.get("kind") == "abort":
+                kind = h.get("kind")
+                if kind not in (None, "chunk", "abort") or \
+                        int(h.get("v", 1)) > wire.frame_version(
+                            wire.KV_TRANSFER_CHUNK):
+                    # schema mismatch from a newer/foreign peer: reject
+                    # with a logged, typed error — never a KeyError three
+                    # frames down the ingest worker. Absent kind/v =
+                    # legacy, still accepted above.
+                    err = wire.WireVersionMismatch(
+                        f"unsupported transfer frame kind={kind!r} "
+                        f"v={h.get('v', 1)} (speak "
+                        f"v<={wire.frame_version(wire.KV_TRANSFER_CHUNK)})")
+                    log.warning("rejecting transfer frame from %s for "
+                                "request %s: %s", peer, rid, err)
+                    self.streams_failed += 1
+                    self._fail_waiter(rid, err)
+                    st = self._ingests.get(rid)
+                    if st is not None and rid in conn_rids:
+                        st.queue.put_nowait(None)  # tear down mid-stream
+                    nack = wire.checked(wire.KV_TRANSFER_ACK, {
+                        "ok": False, "request_id": rid or "",
+                        "error": str(err)})
+                    async with wlock:
+                        writer.write(codec.encode(
+                            TwoPartMessage(header=nack)))
+                        await writer.drain()
+                    continue
+                if kind == "abort":
                     st = self._ingests.get(rid)
                     if st is not None and rid in conn_rids:
                         st.queue.put_nowait(None)  # sentinel → teardown
@@ -285,13 +317,14 @@ class KvTransferServer:
                     self._fail_waiter(request_id, RuntimeError(
                         "sender aborted transfer mid-stream"))
                     return
-                h = msg.header
+                h = wire.decoded(_KV_FRAMES, msg.header)
                 legacy = "kind" not in h
                 chunk_idx = 0 if legacy else int(h["chunk_idx"])
                 n_chunks = 1 if legacy else int(h["n_chunks"])
                 final = chunk_idx >= n_chunks - 1
-                ack = {"ok": True, "request_id": request_id,
-                       "chunk_idx": chunk_idx}
+                ack = wire.checked(wire.KV_TRANSFER_ACK, {
+                    "ok": True, "request_id": request_id,
+                    "chunk_idx": chunk_idx})
                 if st.failed:
                     ack.update(ok=False, error=st.error or "stream failed")
                 elif request_id not in self._waiters:
@@ -360,6 +393,7 @@ class KvTransferServer:
 
     async def _inject_chunk(self, h: dict, body: bytes,
                             st: _IngestState) -> None:
+        h = wire.decoded(_KV_FRAMES, h)
         page_ids = list(h["page_ids"])
         if page_ids:
             t0 = time.monotonic()
@@ -381,14 +415,15 @@ def _bulk_frame(request_id: str, page_ids, k: np.ndarray, v: np.ndarray,
     """Legacy single-frame encoding: header + zero-copy body parts."""
     k = np.ascontiguousarray(k)
     v = np.ascontiguousarray(v)
-    header = {
+    header = wire.checked(wire.KV_TRANSFER_BULK, {
         "request_id": request_id,
         "page_ids": list(int(p) for p in page_ids),
         "shape": list(k.shape),
         "dtype": str(k.dtype),
         "k_len": k.nbytes,
         "first_token": int(first_token),
-    }
+        "v": wire.frame_version(wire.KV_TRANSFER_BULK),
+    })
     if compress:
         from ...engine.kv_compress import quantize_pages_np
 
@@ -450,9 +485,12 @@ class KvTransferClient:
         try:
             while True:
                 msg = await codec.decode(reader)
-                q = self._pending.get(msg.header.get("request_id"))
+                ack = wire.decoded(wire.KV_TRANSFER_ACK, msg.header)
+                q = self._pending.get(ack.get("request_id"))
                 if q is not None:
-                    q.put_nowait(msg.header)
+                    q.put_nowait(ack)
+                else:
+                    log.debug("dropping unroutable transfer ack: %r", ack)
         except asyncio.CancelledError:
             raise
         except Exception as exc:  # noqa: BLE001 — conn loss/desync
@@ -471,6 +509,11 @@ class KvTransferClient:
 
     @staticmethod
     def _check_ack(ack: dict) -> None:
+        ack = wire.decoded(wire.KV_TRANSFER_ACK, ack)
+        if int(ack.get("v", 1)) > wire.frame_version(wire.KV_TRANSFER_ACK):
+            raise wire.WireVersionMismatch(
+                f"decode side acked with unsupported schema "
+                f"v={ack.get('v')}")
         if not ack.get("ok"):
             if ack.get("conn_lost"):
                 raise ConnectionError(ack.get("error"))
@@ -547,9 +590,12 @@ class KvTransferClient:
                 if idx + 1 < n_chunks:
                     # pipeline: start producing chunk i+1 before writing i
                     nxt = asyncio.ensure_future(frames.__anext__())
-                header = {"kind": "chunk", "request_id": request_id,
-                          "chunk_idx": idx, "n_chunks": n_chunks,
-                          "page_ids": [int(p) for p in dst], **extra}
+                header = wire.checked(wire.KV_TRANSFER_CHUNK, {
+                    "kind": "chunk", "request_id": request_id,
+                    "chunk_idx": idx, "n_chunks": n_chunks,
+                    "page_ids": [int(p) for p in dst],
+                    "v": wire.frame_version(wire.KV_TRANSFER_CHUNK),
+                    **extra})
                 if idx == n_chunks - 1:
                     header["first_token"] = int(first_token)
                     if tc is not None:  # commit chunk carries the trace ctx
@@ -600,7 +646,8 @@ class KvTransferClient:
         try:
             if self._writer is not None and not self._writer.is_closing():
                 self._writer.writelines(codec.encode_parts(
-                    {"kind": "abort", "request_id": request_id}))
+                    wire.checked(wire.KV_TRANSFER_ABORT, {
+                        "kind": "abort", "request_id": request_id})))
                 await self._writer.drain()
         except Exception:  # noqa: BLE001 — the conn may be the failure
             pass

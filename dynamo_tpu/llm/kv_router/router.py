@@ -14,7 +14,7 @@ import asyncio
 import logging
 from typing import Dict, Optional, Sequence
 
-from ...runtime import tracing
+from ...runtime import tracing, wire
 from ...runtime.component import Client
 from ...runtime.dcp_client import DcpClient, pack, unpack
 from ...runtime.runtime import DistributedRuntime
@@ -100,6 +100,7 @@ class KvRouter:
         stats = await self.client.collect_stats(timeout=self.scrape_interval)
         metrics: Dict[int, ForwardPassMetrics] = {}
         for wid, payload in stats.items():
+            payload = wire.decoded(wire.DCP_STATS_REPLY, payload)
             metrics[wid] = ForwardPassMetrics.from_dict(payload.get("data", {}))
         self.scheduler.update_metrics(metrics)
         # prune index entries of workers that disappeared from discovery

@@ -19,6 +19,7 @@ from typing import Dict, Optional
 from ..llm.kv_router.protocols import KV_HIT_RATE_SUBJECT, ForwardPassMetrics
 from ..runtime.component import Client, EndpointAddress
 from ..runtime.config import env_str
+from ..runtime import wire
 from ..runtime.dcp_client import unpack
 from ..runtime.runtime import DistributedRuntime
 from ..runtime.tasks import backoff_interval, cancel_join, spawn_tracked
@@ -92,6 +93,7 @@ class MetricsAggregator:
         stats = await self._client.collect_stats()
         live = set()
         for instance_id, payload in stats.items():
+            payload = wire.decoded(wire.DCP_STATS_REPLY, payload)
             data = payload.get("data") or {}
             self.worker_metrics[instance_id] = ForwardPassMetrics.from_dict(
                 data)

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..llm.kv_router.protocols import ForwardPassMetrics
+from ..runtime import wire
 from ..runtime.component import Client
 from ..runtime.config import env_str
 from ..runtime.dcp_client import pack, unpack
@@ -99,6 +100,7 @@ class Planner:
         stats = await self._clients[t.component].collect_stats()
         metrics = {}
         for wid, payload in stats.items():
+            payload = wire.decoded(wire.DCP_STATS_REPLY, payload)
             metrics[wid] = ForwardPassMetrics.from_dict(
                 payload.get("data") or {})
         depth = 0

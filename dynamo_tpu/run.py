@@ -308,7 +308,7 @@ async def run_http(args) -> None:
         watcher = ModelWatcher(drt, manager)
         await watcher.start()
     else:
-        engine, mdc, full = build_engine(args)
+        engine, mdc, full = await asyncio.to_thread(build_engine, args)
         if full:
             manager.add_chat_model(mdc.name, engine)
         else:
@@ -331,7 +331,7 @@ async def run_text(args) -> None:
     from .llm.engines import LocalChatChain
     from .runtime.engine import Context
 
-    engine, mdc, full = build_engine(args)
+    engine, mdc, full = await asyncio.to_thread(build_engine, args)
     chain = engine if full else LocalChatChain(mdc, engine)
     print(f"chat with {mdc.name} — empty line or ^D to exit", flush=True)
     history = []
@@ -374,7 +374,7 @@ async def run_batch(args, path: str) -> None:
     from .llm.protocols.openai import ChatCompletionRequest
     from .runtime.engine import Context
 
-    engine, mdc, full = build_engine(args)
+    engine, mdc, full = await asyncio.to_thread(build_engine, args)
     chain = engine if full else LocalChatChain(mdc, engine)
 
     def _read_jsonl() -> list:
@@ -429,7 +429,7 @@ async def run_worker(args, path: str) -> None:
     from .llm.worker import serve_openai_model
     from .runtime.component import EndpointAddress
 
-    engine, mdc, full = build_engine(args)
+    engine, mdc, full = await asyncio.to_thread(build_engine, args)
     if full:
         raise SystemExit("worker mode needs a token-level engine "
                          "(out=jax or out=echo_core)")
@@ -448,7 +448,7 @@ async def run_worker(args, path: str) -> None:
 
 
 async def run_none(args) -> None:
-    engine, mdc, _ = build_engine(args)
+    engine, mdc, _ = await asyncio.to_thread(build_engine, args)
     log.info("engine %s ready (in=none); ^C to exit", mdc.name)
     await _wait_for_signal()
     if hasattr(engine, "stop"):

@@ -17,6 +17,8 @@ from typing import Optional
 import msgpack
 import xxhash
 
+from . import wire
+
 PRELUDE = struct.Struct("<QQQ")
 PRELUDE_SIZE = PRELUDE.size  # 24
 MAX_MESSAGE = 256 * 1024 * 1024
@@ -33,6 +35,8 @@ class TwoPartMessage:
 
 
 def encode(msg: TwoPartMessage) -> bytes:
+    if wire.validation_enabled():
+        wire.validate_outgoing(msg.header)
     header = msgpack.packb(msg.header, use_bin_type=True)
     body = msg.body or b""
     h = xxhash.xxh3_64()
@@ -48,6 +52,8 @@ def encode_parts(header: dict, body_parts=()) -> list:
     a multi-hundred-MB KV payload. Returns the buffer list to hand to
     ``StreamWriter.writelines``; a ``decode`` on the other end sees one
     body of the concatenated parts."""
+    if wire.validation_enabled():
+        wire.validate_outgoing(header)
     hdr = msgpack.packb(header, use_bin_type=True)
     h = xxhash.xxh3_64()
     h.update(hdr)

@@ -17,7 +17,7 @@ import random
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
 
-from . import tracing
+from . import tracing, wire
 from .codec import TwoPartMessage
 from .dcp_client import DcpClient, Message, NoRespondersError, pack, unpack
 from .engine import Annotated, Context
@@ -240,19 +240,20 @@ class ServeHandle:
 
     async def _on_stats(self, msg: Message) -> None:
         data = self.stats_handler() if self.stats_handler else {}
-        await msg.respond(pack({
+        await msg.respond(pack(wire.checked(wire.DCP_STATS_REPLY, {
             "instance_id": self.instance.instance_id,
             "subject": self.instance.subject,
             "inflight": len(self._inflight),
             "data": data,
-        }))
+        })))
 
     async def _on_request(self, msg: Message) -> None:
         """Request-plane delivery: ack over the request plane, then stream
         responses over the TCP call-home connection (reference
         ingress/push_handler.rs:20-113)."""
         try:
-            envelope = unpack(msg.payload)
+            envelope = wire.decoded(wire.DCP_REQUEST_ENVELOPE,
+                                    unpack(msg.payload))
             req_id = envelope["req_id"]
             conn_info = TcpConnectionInfo.from_dict(envelope["conn"])
             request = unpack(envelope["payload"])
@@ -264,8 +265,9 @@ class ServeHandle:
                 await msg.respond_error(f"bad request envelope: {e!r}")
             return
         if msg.needs_reply:
-            await msg.respond(pack({"accepted": True,
-                                    "instance_id": self.instance.instance_id}))
+            await msg.respond(pack(wire.checked(wire.DCP_REQUEST_ACK, {
+                "accepted": True,
+                "instance_id": self.instance.instance_id})))
         spawn_tracked(self._run_request(req_id, conn_info, request, trace_ctx),
                       name=f"serve-{req_id}")
 
@@ -453,10 +455,11 @@ class Client:
         trace_ctx = tracing.get_tracer().current_trace_ctx()
         if trace_ctx is not None:  # omitted entirely when not sampled
             env_dict["trace"] = trace_ctx
-        envelope = pack(env_dict)
+        envelope = pack(wire.checked(wire.DCP_REQUEST_ENVELOPE, env_dict))
         try:
-            ack = unpack(await self.drt.dcp.request(subject, envelope,
-                                                    timeout=timeout))
+            ack = wire.decoded(wire.DCP_REQUEST_ACK, unpack(
+                await self.drt.dcp.request(subject, envelope,
+                                           timeout=timeout)))
             if not ack.get("accepted"):
                 raise RuntimeError(f"request rejected: {ack}")
         except Exception:
@@ -482,8 +485,9 @@ class Client:
 
         async def _one(inst: EndpointInstance):
             try:
-                resp = unpack(await self.drt.dcp.request(
-                    f"stats.{inst.subject}", b"", timeout=timeout))
+                resp = wire.decoded(wire.DCP_STATS_REPLY, unpack(
+                    await self.drt.dcp.request(
+                        f"stats.{inst.subject}", b"", timeout=timeout)))
                 out[inst.instance_id] = resp
             except Exception:
                 pass

@@ -71,6 +71,11 @@ register_env("DYN_TRACE_SAMPLE", "1.0", "runtime",
              "dyntrace sampling rate in [0,1], decided per root span "
              "(children follow their parent). 0 disables all tracing "
              "instrumentation (no spans, no envelope fields).")
+register_env("DYN_WIRE_VALIDATE", "0", "runtime",
+             "Debug mode: validate every wire frame against the "
+             "runtime/wire.py schema registry at encode/decode time "
+             "(1/true). Default off — the static dynalint pass (DL009/"
+             "DL010) is the production gate.")
 
 register_env("DYN_ADMIN_TOKENS", None, "admin",
              "Inline JSON token map for the admin API (absent = open API).")

@@ -17,6 +17,7 @@ from typing import AsyncIterator, Awaitable, Callable, Dict, List, Optional, Tup
 
 import msgpack
 
+from . import wire
 from .dcp_server import pack_frame, read_frame
 from .tasks import cancel_join, spawn_tracked
 
@@ -125,6 +126,8 @@ class DcpClient:
                 q.put_nowait(None)
 
     async def _on_push(self, msg: dict) -> None:
+        msg = wire.decoded((wire.DCP_PUSH_WATCH, wire.DCP_PUSH_MSG,
+                            wire.DCP_PUSH_REQ), msg)
         kind = msg["push"]
         if kind == "watch":
             q = self._watch_queues.get(msg["watch_id"])
@@ -312,6 +315,7 @@ class Message:
 
     def __init__(self, client: DcpClient, raw: dict):
         self._client = client
+        raw = wire.decoded((wire.DCP_PUSH_MSG, wire.DCP_PUSH_REQ), raw)
         self.subject: str = raw["subject"]
         self.payload: bytes = raw["payload"]
         self._reply: Optional[int] = raw.get("reply")

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import msgpack
 
+from . import wire
 from .tasks import cancel_join, spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.dcp")
@@ -311,10 +312,9 @@ class DcpServer:
         for w in list(self._watches.values()):
             if key.startswith(w.prefix):
                 spawn_tracked(
-                    w.conn.send(
-                        {"push": "watch", "watch_id": w.watch_id, "event": event,
-                         "key": key, "value": value}
-                    ),
+                    w.conn.send(wire.checked(wire.DCP_PUSH_WATCH, {
+                        "push": "watch", "watch_id": w.watch_id,
+                        "event": event, "key": key, "value": value})),
                     name="dcp-watch-notify")
 
     async def _op_kv_put(self, conn, msg):
@@ -501,8 +501,9 @@ class DcpServer:
     async def _op_pub(self, conn, msg):
         subject, payload = msg["subject"], msg["payload"]
         for s in self._route(subject):
-            await s.conn.send(
-                {"push": "msg", "sid": s.sub_id, "subject": subject, "payload": payload})
+            await s.conn.send(wire.checked(wire.DCP_PUSH_MSG, {
+                "push": "msg", "sid": s.sub_id, "subject": subject,
+                "payload": payload}))
         return {}
 
     def _route_request(self, subject: str) -> Optional[_Sub]:
@@ -528,9 +529,9 @@ class DcpServer:
             return {"ok": False, "error": f"no responders for {subject}"}
         rid = next(self._reply_ids)
         self._pending_replies[rid] = (conn, msg["seq"], target.conn.id)
-        await target.conn.send(
-            {"push": "req", "sid": target.sub_id, "subject": subject,
-             "payload": payload, "reply": rid})
+        await target.conn.send(wire.checked(wire.DCP_PUSH_REQ, {
+            "push": "req", "sid": target.sub_id, "subject": subject,
+            "payload": payload, "reply": rid}))
         return None  # response sent when the reply comes back
 
     async def _op_reply(self, conn, msg):

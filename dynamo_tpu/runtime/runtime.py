@@ -81,7 +81,8 @@ class DistributedRuntime:
         lease_ttl: Optional[float] = None,
     ) -> "DistributedRuntime":
         """Connect to the control plane and acquire the primary lease."""
-        runtime = runtime or Runtime()
+        # Runtime() reads the DYN_CONFIG_PATH overlay file — off-loop
+        runtime = runtime or await asyncio.to_thread(Runtime)
         address = dcp_address or runtime.config.dcp_address or DEFAULT_DCP
         lease_ttl = lease_ttl if lease_ttl is not None else runtime.config.lease_ttl
         dcp = await DcpClient.connect(address)
@@ -149,7 +150,9 @@ class Worker:
         asyncio.run(self._run(main))
 
     async def _run(self, main) -> None:
-        runtime = Runtime(self.config)
+        # config is already resolved here, but Runtime's default path can
+        # read the overlay file — keep construction off the fresh loop
+        runtime = await asyncio.to_thread(Runtime, self.config)
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             try:

@@ -24,6 +24,7 @@ import uuid
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from . import wire
 from .codec import TwoPartMessage, decode, encode
 from .tasks import cancel_join, spawn_tracked
 
@@ -87,7 +88,8 @@ class PendingStream:
             return
         async with self._wlock:
             try:
-                self._writer.write(encode(TwoPartMessage({"t": "ctrl", "kind": kind})))
+                self._writer.write(encode(TwoPartMessage(wire.checked(
+                    wire.TCP_CTRL, {"t": "ctrl", "kind": kind}))))
                 await self._writer.drain()
             except (ConnectionError, RuntimeError):
                 pass
@@ -157,27 +159,32 @@ class TcpStreamServer:
         self._writers.add(writer)
         try:
             hello = await asyncio.wait_for(decode(reader), 30.0)
-            if hello.header.get("t") != "hello":
-                raise ValueError(f"bad handshake: {hello.header}")
-            subject = hello.header.get("subject")
+            hh = wire.decoded(wire.TCP_HELLO, hello.header)
+            if hh.get("t") != "hello":
+                raise ValueError(f"bad handshake: {hh}")
+            subject = hh.get("subject")
             ps = self._pending.get(subject)
             if ps is None:
-                writer.write(encode(TwoPartMessage(
-                    {"t": "err", "message": f"unknown stream {subject}"})))
+                writer.write(encode(TwoPartMessage(wire.checked(
+                    wire.TCP_ERR,
+                    {"t": "err", "message": f"unknown stream {subject}"}))))
                 await writer.drain()
                 return
             ps._attach(writer)
             while True:
                 msg = await decode(reader)
-                t = msg.header.get("t")
+                mh = wire.decoded(
+                    (wire.TCP_DATA, wire.TCP_COMPLETE, wire.TCP_ERR),
+                    msg.header)
+                t = mh.get("t")
                 if t == "data":
                     ps.queue.put_nowait(msg.body)
                 elif t == "complete":
                     ps.queue.put_nowait(STREAM_COMPLETE)
                     break
                 elif t == "err":
-                    ps.queue.put_nowait(StreamError(msg.header.get("message", ""),
-                                                    msg.header.get("kind", "")))
+                    ps.queue.put_nowait(StreamError(mh.get("message", ""),
+                                                    mh.get("kind", "")))
                     break
                 else:
                     raise ValueError(f"unexpected frame type {t}")
@@ -221,15 +228,17 @@ class TcpCallHome:
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(host, int(port)), timeout)
         self = cls(reader, writer, on_ctrl)
-        await self._send(TwoPartMessage({"t": "hello", "subject": info.subject}))
+        await self._send(TwoPartMessage(wire.checked(
+            wire.TCP_HELLO, {"t": "hello", "subject": info.subject})))
         return self
 
     async def _ctrl_loop(self) -> None:
         try:
             while True:
                 msg = await decode(self._reader)
-                if msg.header.get("t") == "ctrl" and self._on_ctrl is not None:
-                    self._on_ctrl(msg.header.get("kind"))
+                ch = wire.decoded(wire.TCP_CTRL, msg.header)
+                if ch.get("t") == "ctrl" and self._on_ctrl is not None:
+                    self._on_ctrl(ch.get("kind"))
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
             # peer hung up: treat as kill (caller went away)
@@ -242,14 +251,16 @@ class TcpCallHome:
             await self._writer.drain()
 
     async def send_data(self, body: bytes) -> None:
-        await self._send(TwoPartMessage({"t": "data"}, body))
+        await self._send(TwoPartMessage(
+            wire.checked(wire.TCP_DATA, {"t": "data"}), body))
 
     async def complete(self) -> None:
-        await self._send(TwoPartMessage({"t": "complete"}))
+        await self._send(TwoPartMessage(
+            wire.checked(wire.TCP_COMPLETE, {"t": "complete"})))
 
     async def error(self, message: str, kind: str = "") -> None:
-        await self._send(TwoPartMessage({"t": "err", "message": message,
-                                         "kind": kind}))
+        await self._send(TwoPartMessage(wire.checked(wire.TCP_ERR, {
+            "t": "err", "message": message, "kind": kind})))
 
     async def close(self) -> None:
         await cancel_join(self._ctrl_task)

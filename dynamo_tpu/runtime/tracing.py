@@ -179,6 +179,11 @@ class Tracer:
         self._by_request: "OrderedDict[str, str]" = OrderedDict()
         self._lock = threading.Lock()
         self._listeners: List[Callable[[Span], None]] = []
+        # one-shot, knob-gated export-file open at (lazy) tracer
+        # construction; all later writes are buffered appends. Opening
+        # eagerly at import would charge every process the handle even
+        # with export off.
+        # dynalint: disable=transitive-blocking-in-async
         self._fh = open(jsonl, "a", encoding="utf-8") if jsonl else None
         self.spans_recorded = 0
 

@@ -65,8 +65,8 @@ def _worker_env(svc: DynamoService, dcp: str, cfg: ServiceConfig) -> dict:
 
 async def cmd_serve(args) -> int:
     entry = load_target(args.target)
-    cfg = (ServiceConfig.from_yaml(args.config) if args.config
-           else ServiceConfig.from_env())
+    cfg = (await asyncio.to_thread(ServiceConfig.from_yaml, args.config)
+           if args.config else ServiceConfig.from_env())
     graph = entry.graph()
     log.info("graph: %s", " -> ".join(s.name for s in graph))
 
@@ -156,7 +156,7 @@ async def cmd_serve_worker(args) -> int:
         raise SystemExit(f"service {args.service!r} not in graph of "
                          f"{args.target}")
     cfg = ServiceConfig.from_env()
-    runtime = Runtime()
+    runtime = await asyncio.to_thread(Runtime)
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:

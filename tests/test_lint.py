@@ -24,8 +24,10 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from tools.dynalint import (analyze_paths, analyze_source,  # noqa: E402
-                            apply_baseline, load_baseline)
+from tools.dynalint import (CallGraph, analyze_project,  # noqa: E402
+                            analyze_source, analyze_tree, apply_baseline,
+                            load_baseline, load_source, load_wire_schemas,
+                            parse_module)
 
 BASELINE = os.path.join(REPO, "tools", "dynalint", "baseline.txt")
 GATE_PATHS = [os.path.join(REPO, "dynamo_tpu"),
@@ -45,8 +47,9 @@ def codes(src: str, path: str = "dynamo_tpu/fixture.py"):
 
 
 def test_repo_is_dynalint_clean():
-    """The analyzer is green on its own repo modulo the baseline."""
-    violations = analyze_paths(GATE_PATHS, root=REPO)
+    """Per-file AND whole-program (dynaflow) rules are green on their own
+    repo modulo the baseline — DL008-DL010 active, baseline EMPTY."""
+    violations = analyze_tree(GATE_PATHS, root=REPO)
     allowed = load_baseline(BASELINE) if os.path.exists(BASELINE) else {}
     fresh, _stale = apply_baseline(violations, allowed)
     assert not fresh, (
@@ -58,7 +61,7 @@ def test_repo_is_dynalint_clean():
 
 def test_baseline_is_not_stale():
     """Fixed violations must leave the baseline (ratchet-only gate)."""
-    violations = analyze_paths(GATE_PATHS, root=REPO)
+    violations = analyze_tree(GATE_PATHS, root=REPO)
     allowed = load_baseline(BASELINE) if os.path.exists(BASELINE) else {}
     _fresh, stale = apply_baseline(violations, allowed)
     assert not stale, f"stale baseline entries — delete them: {stale}"
@@ -374,6 +377,336 @@ def test_dl007_quiet_on_good():
     assert "DL007" not in codes(DL007_GOOD)
 
 
+# ------------------------------------------------- dynaflow fixture plumbing
+
+
+def project(*mods, schemas=None, depth=4):
+    """Run the whole-program passes over in-memory fixture modules given
+    as (path, src) pairs."""
+    sources = [parse_module(src, path) for path, src in mods]
+    kwargs = {}
+    if schemas is not None:
+        kwargs["schemas"] = schemas
+    return analyze_project(sources, dl008_depth=depth, **kwargs)
+
+
+FIXTURE_WIRE = '''
+FIX_FRAME = register_frame(
+    "fix.frame", version=2, when={"kind": "fix"},
+    fields=[
+        ("kind", "str", "required", 1, "discriminator"),
+        ("request_id", "str", "required", 1, "id"),
+        ("extra", "int", "optional", 2, "added in v2"),
+    ])
+'''
+
+
+def fixture_schemas():
+    schemas, const_map, bad = load_wire_schemas(
+        parse_module(FIXTURE_WIRE, "pkg/wire.py"))
+    assert not bad and const_map == {"FIX_FRAME": "fix.frame"}
+    return schemas
+
+
+# ----------------------------------------- DL008 transitive-blocking-in-async
+
+
+DL008_BAD = """
+import time
+def helper():
+    time.sleep(1)
+def middle():
+    helper()
+async def endpoint():
+    middle()
+"""
+
+DL008_GOOD = """
+import asyncio, time
+def helper():
+    time.sleep(1)
+async def endpoint():
+    await asyncio.to_thread(helper)       # offloaded: no edge
+async def other():
+    await peer()                           # async callee: its own root
+async def peer():
+    await asyncio.sleep(1)
+"""
+
+DL008_SUPPRESSED_CALLSITE = """
+import time
+def helper():
+    time.sleep(1)
+async def endpoint():
+    helper()  # dynalint: disable=transitive-blocking-in-async
+"""
+
+DL008_SUPPRESSED_SINK = """
+import time
+def helper():
+    # dynalint: disable=DL008
+    time.sleep(1)
+async def endpoint():
+    helper()
+"""
+
+DL008_DEEP = """
+import time
+def f5():
+    time.sleep(1)
+def f4():
+    f5()
+def f3():
+    f4()
+def f2():
+    f3()
+def f1():
+    f2()
+async def endpoint():
+    f1()
+"""
+
+
+def test_dl008_fires_through_sync_chain():
+    vs = [v for v in project(("pkg/m.py", DL008_BAD)) if v.code == "DL008"]
+    assert len(vs) == 1
+    assert vs[0].scope == "endpoint"
+    assert "time.sleep" in vs[0].message
+
+
+def test_dl008_quiet_on_offload_and_async_callees():
+    assert not [v for v in project(("pkg/m.py", DL008_GOOD))
+                if v.code == "DL008"]
+
+
+def test_dl008_suppression_at_callsite_and_sink():
+    for src in (DL008_SUPPRESSED_CALLSITE, DL008_SUPPRESSED_SINK):
+        assert not [v for v in project(("pkg/m.py", src))
+                    if v.code == "DL008"]
+
+
+def test_dl008_depth_limit():
+    """The 5-frame chain is past the default depth of 4 but within 6."""
+    assert not [v for v in project(("pkg/m.py", DL008_DEEP), depth=4)
+                if v.code == "DL008"]
+    assert [v for v in project(("pkg/m.py", DL008_DEEP), depth=6)
+            if v.code == "DL008"]
+
+
+def test_dl008_cross_module_alias():
+    """from pkg.a import helper as h; the async caller lives elsewhere."""
+    mod_a = """
+import time
+def helper():
+    time.sleep(1)
+"""
+    mod_b = """
+from pkg.a import helper as h
+async def endpoint():
+    h()
+"""
+    vs = [v for v in project(("pkg/a.py", mod_a), ("pkg/b.py", mod_b))
+          if v.code == "DL008"]
+    assert len(vs) == 1 and vs[0].path == "pkg/b.py"
+
+
+def test_dl008_method_attribution_and_inheritance():
+    src = """
+import time
+class Base:
+    def _io(self):
+        time.sleep(1)
+class Svc(Base):
+    async def handle(self):
+        self._io()
+"""
+    vs = [v for v in project(("pkg/m.py", src)) if v.code == "DL008"]
+    assert len(vs) == 1 and vs[0].scope == "Svc.handle"
+
+
+# -------------------------------------------------- call-graph unit behavior
+
+
+def test_callgraph_async_and_alias_resolution():
+    mod_a = """
+def plain():
+    pass
+async def aplain():
+    pass
+"""
+    mod_b = """
+import pkg.a as alias
+from pkg.a import plain as renamed
+async def caller():
+    alias.plain()
+    renamed()
+"""
+    g = CallGraph.build([parse_module(mod_a, "pkg/a.py"),
+                         parse_module(mod_b, "pkg/b.py")])
+    assert g.functions["pkg.a:plain"].is_async is False
+    assert g.functions["pkg.a:aplain"].is_async is True
+    caller = g.functions["pkg.b:caller"]
+    assert caller.is_async is True
+    targets = {cs.target for cs in caller.calls}
+    assert targets == {"pkg.a:plain"}  # both routes resolve to one function
+
+
+def test_callgraph_method_resolution():
+    src = """
+class Svc:
+    def start(self):
+        self.step()
+    def step(self):
+        pass
+def outer():
+    Svc()
+    """
+    g = CallGraph.build([parse_module(src, "pkg/m.py")])
+    start = g.functions["pkg.m:Svc.start"]
+    assert [cs.target for cs in start.calls] == ["pkg.m:Svc.step"]
+
+
+# --------------------------------------------------- DL009 wire-field-drift
+
+
+def test_dl009_write_side_drift():
+    """A dict-literal key at an encode anchor that the schema lacks."""
+    src = """
+from dynamo_tpu.runtime import wire
+def send():
+    return wire.checked(wire.FIX_FRAME, {
+        "kind": "fix", "request_id": "r", "zstd_level": 3})
+"""
+    vs = [v for v in project(("pkg/m.py", src), schemas=fixture_schemas())
+          if v.code == "DL009"]
+    assert len(vs) == 1 and "zstd_level" in vs[0].message
+
+
+def test_dl009_write_side_drift_via_late_store():
+    """Keys added with var[...] = ... after the anchor are still checked."""
+    src = """
+from dynamo_tpu.runtime import wire
+def send():
+    h = wire.checked(wire.FIX_FRAME, {"kind": "fix", "request_id": "r"})
+    h["sneaky"] = 1
+    return h
+"""
+    vs = [v for v in project(("pkg/m.py", src), schemas=fixture_schemas())
+          if v.code == "DL009"]
+    assert len(vs) == 1 and "sneaky" in vs[0].message
+
+
+def test_dl009_read_side_drift():
+    """A .get()/[] read through a decode anchor of an undeclared key."""
+    src = """
+from dynamo_tpu.runtime import wire
+def recv(header):
+    h = wire.decoded(wire.FIX_FRAME, header)
+    _ = h["kind"], h["request_id"]
+    return h.get("legacy_field")
+"""
+    vs = [v for v in project(("pkg/m.py", src), schemas=fixture_schemas())
+          if v.code == "DL009"]
+    assert len(vs) == 1 and "legacy_field" in vs[0].message
+
+
+def test_dl009_required_never_read():
+    """A required field no decoder reads is flagged at the registration."""
+    src = """
+from dynamo_tpu.runtime import wire
+def recv(header):
+    h = wire.decoded(wire.FIX_FRAME, header)
+    return h["kind"]
+"""
+    vs = [v for v in project(("pkg/m.py", src), schemas=fixture_schemas())
+          if v.code == "DL009"]
+    assert len(vs) == 1
+    assert "request_id" in vs[0].message and vs[0].scope == "fix.frame"
+
+
+def test_dl009_clean_roundtrip():
+    src = """
+from dynamo_tpu.runtime import wire
+def send():
+    return wire.checked(wire.FIX_FRAME, {
+        "kind": "fix", "request_id": "r", "extra": 2})
+def recv(header):
+    h = wire.decoded(wire.FIX_FRAME, header)
+    return h["kind"], h["request_id"], h.get("extra")
+"""
+    assert not [v for v in project(("pkg/m.py", src),
+                                   schemas=fixture_schemas())
+                if v.code == "DL009"]
+
+
+def test_dl009_drifted_pair_write_and_read():
+    """The deliberately-drifted pair: encoder grew a field by hand, the
+    decoder still reads a long-deleted one — both sides fire."""
+    encoder = """
+from dynamo_tpu.runtime import wire
+def send():
+    return wire.checked(wire.FIX_FRAME, {
+        "kind": "fix", "request_id": "r", "grew_by_hand": 1})
+"""
+    decoder = """
+from dynamo_tpu.runtime import wire
+def recv(header):
+    h = wire.decoded(wire.FIX_FRAME, header)
+    return h["kind"], h["request_id"], h.get("deleted_long_ago")
+"""
+    vs = [v for v in project(("pkg/enc.py", encoder),
+                             ("pkg/dec.py", decoder),
+                             schemas=fixture_schemas())
+          if v.code == "DL009"]
+    assert {v.path for v in vs} == {"pkg/enc.py", "pkg/dec.py"}
+    msgs = " ".join(v.message for v in vs)
+    assert "grew_by_hand" in msgs and "deleted_long_ago" in msgs
+
+
+# ------------------------------------------------ DL010 undeclared-wire-frame
+
+
+def test_dl010_fires_on_unanchored_literal():
+    src = """
+from dynamo_tpu.runtime import codec
+def send(writer):
+    writer.writelines(codec.encode_parts({"mystery": 1, "blob": 2}))
+"""
+    vs = [v for v in project(("pkg/m.py", src), schemas=fixture_schemas())
+          if v.code == "DL010"]
+    assert len(vs) == 1 and "mystery" in vs[0].message
+
+
+def test_dl010_quiet_on_anchored_and_matching():
+    src = """
+from dynamo_tpu.runtime import codec, wire
+def send(writer):
+    writer.writelines(codec.encode_parts(
+        wire.checked(wire.FIX_FRAME, {"kind": "fix", "request_id": "r"})))
+    h = wire.checked(wire.FIX_FRAME, {"kind": "fix", "request_id": "r"})
+    writer.writelines(codec.encode_parts(h))
+    writer.writelines(codec.encode_parts(
+        {"kind": "fix", "request_id": "r"}))   # literal matches the schema
+def opaque(writer, header):
+    writer.writelines(codec.encode_parts(header))  # unknown: never guess
+"""
+    assert not [v for v in project(("pkg/m.py", src),
+                                   schemas=fixture_schemas())
+                if v.code == "DL010"]
+
+
+def test_wire_registry_declarations_are_literal():
+    """Non-literal register_frame args would drop the frame from the
+    static pass — the loader flags them."""
+    bad = """
+V = 2
+F = register_frame("f.f", version=V, fields=[])
+"""
+    _schemas, _cmap, violations = load_wire_schemas(
+        parse_module(bad, "pkg/wire.py"))
+    assert violations and violations[0].code == "DL009"
+
+
 # ----------------------------------------------------------------- suppression
 
 
@@ -449,6 +782,91 @@ def test_env_docs_in_sync():
     assert on_disk == render_env_docs(), (
         "docs/env_vars.md is out of date — regenerate it with "
         "`python -m tools.dynalint --write-env-docs docs/env_vars.md`")
+
+
+def test_wire_docs_in_sync():
+    """docs/wire_schemas.md must match the registry (regenerate with
+    `python -m tools.dynalint --wire-schemas docs/wire_schemas.md`)."""
+    from dynamo_tpu.runtime.wire import render_wire_docs
+
+    path = os.path.join(REPO, "docs", "wire_schemas.md")
+    with open(path, encoding="utf-8") as f:
+        on_disk = f.read()
+    assert on_disk == render_wire_docs(), (
+        "docs/wire_schemas.md is out of date — regenerate it with "
+        "`python -m tools.dynalint --wire-schemas docs/wire_schemas.md`")
+
+
+def test_disagg_frame_tables_in_sync():
+    """The frame tables embedded in docs/disagg_serving.md are generated
+    from the registry and must match it."""
+    from dynamo_tpu.runtime.wire import render_frame_tables
+
+    path = os.path.join(REPO, "docs", "disagg_serving.md")
+    with open(path, encoding="utf-8") as f:
+        doc = f.read()
+    begin = "<!-- BEGIN wire-frames (generated from dynamo_tpu/runtime/wire.py) -->\n"
+    end = "<!-- END wire-frames -->"
+    assert begin in doc and end in doc
+    embedded = doc.split(begin, 1)[1].split(end, 1)[0]
+    assert embedded == render_frame_tables(("kv_transfer.", "prefill.")), (
+        "docs/disagg_serving.md wire-frame tables are out of date — "
+        "re-embed render_frame_tables(('kv_transfer.', 'prefill.'))")
+
+
+def test_wire_schema_matches_static_parse():
+    """The statically-parsed schemas (what the lint pass enforces) agree
+    with the imported runtime registry (what DYN_WIRE_VALIDATE enforces)
+    — one source of truth, two consumers."""
+    from dynamo_tpu.runtime import wire as rt
+
+    schemas, const_map, bad = load_wire_schemas(load_source(
+        os.path.join(REPO, "dynamo_tpu", "runtime", "wire.py"),
+        "dynamo_tpu/runtime/wire.py"))
+    assert not bad
+    assert set(schemas) == set(rt.FRAMES)
+    for name, schema in schemas.items():
+        frame = rt.FRAMES[name]
+        assert schema.required == frame.required_names
+        assert schema.fields == frame.field_names
+        assert schema.version == frame.version
+        assert dict(schema.when) == frame.when
+        assert getattr(rt, schema.const) == name
+
+
+def test_source_cache_parses_once():
+    """The per-run AST cache: two loads of one unchanged file return the
+    identical ModuleSource (the per-pass re-parse bug)."""
+    path = os.path.join(REPO, "dynamo_tpu", "runtime", "wire.py")
+    a = load_source(path, "dynamo_tpu/runtime/wire.py")
+    b = load_source(path, "dynamo_tpu/runtime/wire.py")
+    assert a is b
+
+
+def test_cli_json_reports_wall_time():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dynalint", "--json",
+         os.path.join(REPO, "tools", "dynalint", "baseline.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    out = json.loads(proc.stdout)
+    assert "wall_seconds" in out and out["wall_seconds"] >= 0
+
+
+def test_cli_callgraph_dot(tmp_path):
+    dot = tmp_path / "graph.dot"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dynalint",
+         "--callgraph-dot", str(dot),
+         os.path.join(REPO, "dynamo_tpu", "llm", "disagg")],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    text = dot.read_text()
+    assert text.startswith("digraph dynaflow")
+    # async transfer-plane entrypoints are annotated
+    assert "KvTransferServer._ingest_worker" in text
 
 
 def test_env_registry_rejects_unregistered():

@@ -24,10 +24,13 @@ def test_subject_matching():
 
 
 def test_two_part_codec_roundtrip():
-    msg = TwoPartMessage({"t": "data", "n": 42}, b"\x00\x01payload\xff")
+    # a registered frame header, so this also passes under
+    # DYN_WIRE_VALIDATE=1 (the codec hook rejects ad-hoc headers there)
+    msg = TwoPartMessage({"t": "err", "message": "x", "kind": "E"},
+                         b"\x00\x01payload\xff")
     buf = encode(msg)
     decoded, rest = decode_buffer(buf + b"extra")
-    assert decoded.header == {"t": "data", "n": 42}
+    assert decoded.header == {"t": "err", "message": "x", "kind": "E"}
     assert decoded.body == b"\x00\x01payload\xff"
     assert rest == b"extra"
     # corruption detected

@@ -152,10 +152,12 @@ def test_codec_roundtrip_with_and_without_trace_ctx():
     """The two-part frame and msgpack envelope carry the trace field
     transparently; peers without it still interoperate (absent = None)."""
     ctx = {"trace_id": "a" * 32, "span_id": "b" * 16}
+    chunk = {"kind": "chunk", "request_id": "r", "chunk_idx": 0,
+             "n_chunks": 1, "page_ids": [1], "shape": [1], "dtype": "f",
+             "k_len": 1}  # full registered frame: DYN_WIRE_VALIDATE-safe
     with_trace = codec.encode(codec.TwoPartMessage(
-        {"kind": "chunk", "request_id": "r", "trace": ctx}, b"kv"))
-    without = codec.encode(codec.TwoPartMessage(
-        {"kind": "chunk", "request_id": "r"}, b"kv"))
+        {**chunk, "trace": ctx}, b"kv"))
+    without = codec.encode(codec.TwoPartMessage(dict(chunk), b"kv"))
     msg1, rest1 = codec.decode_buffer(with_trace)
     msg2, rest2 = codec.decode_buffer(without)
     assert rest1 == b"" and rest2 == b""

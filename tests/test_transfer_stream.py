@@ -16,7 +16,7 @@ import pytest
 
 from dynamo_tpu.llm.disagg.transfer import (KvTransferClient,
                                             KvTransferServer, TransferStats)
-from dynamo_tpu.runtime import codec
+from dynamo_tpu.runtime import codec, wire
 
 SHAPE = (2, 1, 2, 4, 8)  # [L, n=1 page per unit, KV, ps, hd]
 
@@ -83,7 +83,11 @@ def test_encode_parts_matches_encode():
     concatenating encoder, and decodable by both decoders."""
     k = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
     v = np.ones((2, 3, 4), np.float32)
-    header = {"request_id": "r", "k_len": k.nbytes}
+    # a real registered frame, so this test also passes under
+    # DYN_WIRE_VALIDATE=1 (ad-hoc headers are rejected there by design)
+    header = {"request_id": "r", "page_ids": [1, 2, 3],
+              "shape": list(k.shape), "dtype": str(k.dtype),
+              "k_len": k.nbytes, "first_token": 7}
     whole = codec.encode(codec.TwoPartMessage(
         header=header, body=k.tobytes() + v.tobytes()))
     parts = codec.encode_parts(header, [k, v])
@@ -389,6 +393,176 @@ def test_late_chunk_after_cancel_never_writes(run_async):
         # chunk 1 landed (waiter was live), chunk 2 must have been dropped
         assert 1 in eng.pages and 2 in eng.pages
         assert 3 not in eng.pages and 4 not in eng.pages
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+# ------------------------------------------------- wire-schema conformance
+
+
+# one representative on-the-wire header per registered frame; the test
+# below asserts this map covers EVERY frame, so adding a frame without an
+# example here fails loudly
+FRAME_EXAMPLES = {
+    "dcp.request_envelope": {"req_id": "r1", "conn": {"address": "h:1",
+                                                      "subject": "s"},
+                             "payload": b"x", "trace": {"trace_id": "t",
+                                                        "span_id": "s"}},
+    "dcp.request_ack": {"accepted": True, "instance_id": 7},
+    "dcp.stats_reply": {"instance_id": 7, "subject": "ns.c.e-7",
+                        "inflight": 0, "data": {"kv_active_blocks": 1}},
+    "dcp.push_watch": {"push": "watch", "watch_id": 1, "event": "put",
+                       "key": "k", "value": b"v"},
+    "dcp.push_msg": {"push": "msg", "sid": 1, "subject": "s",
+                     "payload": b"x"},
+    "dcp.push_req": {"push": "req", "sid": 1, "subject": "s",
+                     "payload": b"x", "reply": 9},
+    "prefill.remote_request": {"request_id": "r", "token_ids": [1, 2],
+                               "sampling": {}, "eos_token_ids": [0],
+                               "page_ids": [3], "skip_pages": 0,
+                               "engine_id": 1,
+                               "trace_ctx": {"trace_id": "t",
+                                             "span_id": "s"}},
+    "kv_transfer.bulk": {"request_id": "r", "page_ids": [1], "shape":
+                         [2, 1, 2, 4, 8], "dtype": "float32", "k_len": 512,
+                         "first_token": 5, "quant": "int8", "v": 2},
+    "kv_transfer.chunk": {"kind": "chunk", "request_id": "r",
+                          "chunk_idx": 0, "n_chunks": 1, "page_ids": [1],
+                          "shape": [2, 1, 2, 4, 8], "dtype": "float32",
+                          "k_len": 512, "first_token": 5, "v": 2},
+    "kv_transfer.abort": {"kind": "abort", "request_id": "r", "v": 2},
+    "kv_transfer.ack": {"ok": True, "request_id": "r", "chunk_idx": 0,
+                        "committed": True, "v": 2},
+    "tcp.hello": {"t": "hello", "subject": "abc"},
+    "tcp.data": {"t": "data"},
+    "tcp.complete": {"t": "complete"},
+    "tcp.err": {"t": "err", "message": "boom", "kind": "ValueError"},
+    "tcp.ctrl": {"t": "ctrl", "kind": "stop"},
+}
+
+
+def test_every_registered_frame_roundtrips_validated(monkeypatch):
+    """DYN_WIRE_VALIDATE=1: every frame in the registry encodes through
+    the codec hook (frame inference + schema check) and decodes back
+    byte-identically through both decoders."""
+    monkeypatch.setenv("DYN_WIRE_VALIDATE", "1")
+    assert set(FRAME_EXAMPLES) == set(wire.FRAMES), (
+        "add an example header for every registered frame")
+    for name, header in FRAME_EXAMPLES.items():
+        inferred = wire.infer_frame(header)
+        assert inferred.name == name, (name, inferred.name)
+        blob = codec.encode(codec.TwoPartMessage(header=header, body=b"b"))
+        msg, rest = codec.decode_buffer(blob)
+        assert rest == b"" and msg.header == header
+        # the multi-part encoder runs the same hook
+        parts = codec.encode_parts(header, [b"b"])
+        assert b"".join(bytes(p) for p in parts) == blob
+        # anchors are identity + validation
+        assert wire.checked(name, header) is header
+        assert wire.decoded(name, header) is header
+
+
+def test_validation_rejects_drift_and_unknown(monkeypatch):
+    monkeypatch.setenv("DYN_WIRE_VALIDATE", "1")
+    with pytest.raises(wire.UnknownWireFrame):
+        codec.encode(codec.TwoPartMessage(header={"zzz": 1}))
+    with pytest.raises(wire.WireValidationError, match="sneaky"):
+        wire.checked(wire.KV_TRANSFER_ABORT,
+                     {"kind": "abort", "request_id": "r", "sneaky": 1})
+    with pytest.raises(wire.WireValidationError, match="request_id"):
+        wire.checked(wire.KV_TRANSFER_ABORT, {"kind": "abort"})
+    with pytest.raises(wire.WireValidationError, match="expects int"):
+        wire.checked(wire.KV_TRANSFER_ACK,
+                     {"ok": True, "request_id": "r", "chunk_idx": "zero"})
+    # decode side: absent fields = legacy peer, accepted; unknown = drift
+    assert wire.decoded(wire.KV_TRANSFER_ACK, {"ok": True}) == {"ok": True}
+    with pytest.raises(wire.WireValidationError, match="made_up"):
+        wire.decoded(wire.KV_TRANSFER_ACK, {"ok": True, "made_up": 1})
+
+
+def test_validation_off_is_identity():
+    """Default (DYN_WIRE_VALIDATE unset): anchors never inspect frames."""
+    junk = {"totally": "unregistered"}
+    assert wire.checked(wire.KV_TRANSFER_ABORT, junk) is junk
+    assert wire.decoded(wire.KV_TRANSFER_ABORT, junk) is junk
+
+
+def test_chunked_roundtrip_under_validation(run_async, monkeypatch):
+    """The real streaming pipeline end-to-end with the debug validation
+    hot: every chunk, ack and commit frame passes the registry check."""
+    monkeypatch.setenv("DYN_WIRE_VALIDATE", "1")
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+        k, v = _pages(4, seed=21)
+        client = KvTransferClient("127.0.0.1", server.port)
+        fut = server.expect("rv")
+        await client.send_kv_chunked(
+            "rv", n_chunks(4, 2), _frames([5, 6, 7, 8], k, v, 2),
+            first_token=11)
+        assert await asyncio.wait_for(fut, 5) == 11
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_unknown_frame_kind_rejected_typed(run_async):
+    """Satellite: a frame with an unknown kind is refused with a logged,
+    typed error — the waiter fails fast and the sender gets a nack — not
+    a KeyError three frames deep in the ingest worker."""
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+        client = KvTransferClient("127.0.0.1", server.port)
+        fut = server.expect("rx")
+        await client._ensure()
+        q = client._register("rx")
+        client._writer.writelines(codec.encode_parts(
+            {"kind": "zstd-delta", "request_id": "rx", "page_ids": [1]}))
+        await client._writer.drain()
+        ack = await asyncio.wait_for(q.get(), 5)
+        assert ack["ok"] is False and "unsupported" in ack["error"]
+        with pytest.raises(wire.WireVersionMismatch):
+            await asyncio.wait_for(fut, 1)
+        assert server.streams_failed >= 1
+        assert not eng.pages  # nothing was injected
+        # the connection survives: a well-formed stream still lands
+        k, v = _pages(2, seed=22)
+        fut2 = server.expect("ry")
+        await client.send_kv_chunked(
+            "ry", 1, _frames([7, 8], k, v, 2), first_token=3)
+        assert await asyncio.wait_for(fut2, 5) == 3
+        client.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_newer_schema_version_rejected_typed(run_async):
+    """A chunk frame stamped v=99 (a future schema) is rejected up front;
+    absent v = legacy and keeps working (covered by every other test)."""
+
+    async def main():
+        eng = FakeEngine()
+        server = await _server(eng)
+        client = KvTransferClient("127.0.0.1", server.port)
+        fut = server.expect("rz")
+        await client._ensure()
+        q = client._register("rz")
+        client._writer.writelines(codec.encode_parts(
+            {"kind": "chunk", "request_id": "rz", "chunk_idx": 0,
+             "n_chunks": 1, "page_ids": [], "shape": [], "dtype": "float32",
+             "k_len": 0, "first_token": 0, "v": 99}))
+        await client._writer.drain()
+        ack = await asyncio.wait_for(q.get(), 5)
+        assert ack["ok"] is False and "v=99" in ack["error"]
+        with pytest.raises(wire.WireVersionMismatch):
+            await asyncio.wait_for(fut, 1)
         client.close()
         await server.stop()
 

@@ -1,14 +1,21 @@
 """dynalint: project-native async/JAX static analysis for dynamo-tpu.
 
 The Rust reference gets its concurrency safety from the borrow checker;
-this Python/JAX port gets it from here. Six AST rules catch the hazard
-classes that bite async serving stacks at 3am: blocking calls on the
-event loop, background tasks whose exceptions vanish, silently-spinning
-error loops, blocking work under locks, host syncs in engine hot paths,
-and undocumented env knobs.
+this Python/JAX port gets it from here. The per-file AST rules
+(DL001-DL007) catch the hazard classes that bite async serving stacks at
+3am: blocking calls on the event loop, background tasks whose exceptions
+vanish, silently-spinning error loops, blocking work under locks, host
+syncs in engine hot paths, undocumented env knobs and leaked trace
+spans. The **dynaflow** whole-program layer (callgraph.py + dynaflow.py)
+adds what no single file can show: blocking calls reachable from async
+defs through chains of sync helpers (DL008), and conformance of every
+encoded/decoded wire frame against the declared schema registry in
+``dynamo_tpu/runtime/wire.py`` (DL009/DL010).
 
 Usage:
     python -m tools.dynalint [--baseline FILE] [--json] paths...
+    python -m tools.dynalint --callgraph-dot graph.dot
+    python -m tools.dynalint --wire-schemas docs/wire_schemas.md
 
 Suppression: append ``# dynalint: disable=<rule-name>[,<rule-name>...]``
 to the offending line (or the line directly above it). Grandfathered
@@ -17,11 +24,18 @@ ratchet-only: new violations fail, baselined ones pass, stale baseline
 entries warn.
 """
 
-from .analyzer import (RULES, Violation, analyze_paths, analyze_source,
-                       iter_py_files)
+from .analyzer import (RULES, ModuleSource, Violation, analyze_paths,
+                       analyze_source, iter_py_files, load_source,
+                       load_sources, parse_module)
 from .baseline import apply_baseline, format_entry, load_baseline
+from .callgraph import DEFAULT_DL008_DEPTH, CallGraph, module_name
+from .dynaflow import (FrameSchema, analyze_project, analyze_tree,
+                       load_wire_schemas)
 
 __all__ = [
-    "RULES", "Violation", "analyze_paths", "analyze_source",
-    "apply_baseline", "format_entry", "iter_py_files", "load_baseline",
+    "RULES", "CallGraph", "DEFAULT_DL008_DEPTH", "FrameSchema",
+    "ModuleSource", "Violation", "analyze_paths", "analyze_project",
+    "analyze_source", "analyze_tree", "apply_baseline", "format_entry",
+    "iter_py_files", "load_source", "load_sources", "load_wire_schemas",
+    "load_baseline", "module_name", "parse_module",
 ]

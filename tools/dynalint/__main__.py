@@ -2,8 +2,20 @@
 
     python -m tools.dynalint [--baseline FILE] [--json] paths...
 
+Runs the per-file rules (DL001-DL007) AND the whole-program dynaflow
+passes (DL008 call-graph blocking propagation, DL009/DL010 wire-schema
+conformance) over one shared parse of the tree.
+
 Exit status: 0 when every violation is baselined (stale baseline
 entries still warn on stderr), 1 when new violations exist.
+
+Tooling extras:
+    --callgraph-dot graph.dot   Graphviz export of the project call
+                                graph, async defs and blocking reach
+                                annotated
+    --wire-schemas FILE         regenerate docs/wire_schemas.md from the
+                                runtime/wire.py registry
+    --write-env-docs FILE       regenerate docs/env_vars.md
 """
 
 from __future__ import annotations
@@ -12,9 +24,12 @@ import argparse
 import json
 import os
 import sys
+import time
 
-from .analyzer import RULES, analyze_paths
+from .analyzer import RULES, load_sources
 from .baseline import apply_baseline, load_baseline
+from .callgraph import DEFAULT_DL008_DEPTH, CallGraph
+from .dynaflow import analyze_tree
 
 REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -44,6 +59,17 @@ def main(argv=None) -> int:
     ap.add_argument("--write-env-docs", metavar="PATH", default=None,
                     help="regenerate the env-var reference (docs/"
                          "env_vars.md) from the runtime/config.py registry")
+    ap.add_argument("--wire-schemas", metavar="PATH", default=None,
+                    help="regenerate the wire-frame reference (docs/"
+                         "wire_schemas.md) from the runtime/wire.py "
+                         "registry")
+    ap.add_argument("--callgraph-dot", metavar="PATH", default=None,
+                    help="write a Graphviz export of the project call "
+                         "graph (async defs filled, blocking reach in "
+                         "red) and exit")
+    ap.add_argument("--dl008-depth", type=int, default=DEFAULT_DL008_DEPTH,
+                    help="max sync call frames between an async def and a "
+                         "blocking primitive for DL008 (default %(default)s)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -60,9 +86,30 @@ def main(argv=None) -> int:
         print(f"wrote {args.write_env_docs}")
         return 0
 
+    if args.wire_schemas:
+        sys.path.insert(0, REPO_ROOT)
+        from dynamo_tpu.runtime.wire import render_wire_docs
+
+        with open(args.wire_schemas, "w", encoding="utf-8") as f:
+            f.write(render_wire_docs())
+        print(f"wrote {args.wire_schemas}")
+        return 0
+
     paths = args.paths or [os.path.join(REPO_ROOT, p)
                            for p in DEFAULT_PATHS]
-    violations = analyze_paths(paths, root=REPO_ROOT)
+
+    if args.callgraph_dot:
+        graph = CallGraph.build(load_sources(paths, root=REPO_ROOT))
+        with open(args.callgraph_dot, "w", encoding="utf-8") as f:
+            f.write(graph.to_dot())
+        print(f"wrote {args.callgraph_dot} "
+              f"({len(graph.functions)} functions)")
+        return 0
+
+    t0 = time.perf_counter()
+    violations = analyze_tree(paths, root=REPO_ROOT,
+                              dl008_depth=args.dl008_depth)
+    wall = time.perf_counter() - t0
 
     if args.write_baseline:
         lines = ["# dynalint baseline — grandfathered violations "
@@ -82,7 +129,8 @@ def main(argv=None) -> int:
 
     if args.as_json:
         print(json.dumps({"violations": [v.to_dict() for v in violations],
-                          "stale_baseline": stale}, indent=2))
+                          "stale_baseline": stale,
+                          "wall_seconds": round(wall, 3)}, indent=2))
     else:
         for v in violations:
             print(v.render())

@@ -37,6 +37,21 @@ RULES: Dict[str, Tuple[str, str]] = {
               "tracer.start_span(...) result used without `with` or an "
               "explicit end(): the span never finishes and leaks from "
               "every trace"),
+    # DL008-DL010 are the interprocedural dynaflow rules (callgraph.py /
+    # dynaflow.py): they need the whole-program view, so analyze_source
+    # never emits them — analyze_tree / the CLI does.
+    "DL008": ("transitive-blocking-in-async",
+              "blocking call reachable from an async def through sync "
+              "helpers stalls the event loop just as surely as a direct "
+              "one"),
+    "DL009": ("wire-field-drift",
+              "wire-frame field used at an encode/decode site but absent "
+              "from its declared schema in runtime/wire.py (or declared "
+              "required yet never read by any decoder)"),
+    "DL010": ("undeclared-wire-frame",
+              "codec encode/encode_parts call site whose header matches "
+              "no registered wire frame: declare it in runtime/wire.py "
+              "and anchor the site with wire.checked(...)"),
 }
 
 NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}
@@ -525,6 +540,68 @@ def _is_lock_expr(expr: ast.AST) -> bool:
 
 # ------------------------------------------------------------------ frontend
 
+@dataclass
+class ModuleSource:
+    """One parsed module, shared by every rule pass in a run. The parse
+    cache below exists because the per-file pass, the dynaflow
+    call-graph pass and the wire-conformance pass all want the same
+    trees — before it, each whole-program rule re-read and re-parsed
+    every file (the analyzer did the whole tree once per pass)."""
+
+    path: str                       # root-relative display path ('/'-sep)
+    abspath: str
+    src: str
+    tree: ast.AST
+    suppressed: Dict[int, Set[str]]
+
+
+# abspath -> ((mtime_ns, size), ModuleSource); keyed on stat so edits
+# between runs in one process (tests, watch modes) are picked up.
+_SOURCE_CACHE: Dict[str, Tuple[Tuple[int, int], ModuleSource]] = {}
+
+
+def parse_module(src: str, path: str) -> ModuleSource:
+    """In-memory ModuleSource (fixtures, tests) — bypasses the disk cache."""
+    rel = path.replace(os.sep, "/")
+    tree = ast.parse(src, filename=rel)
+    _annotate_parents(tree)
+    return ModuleSource(rel, rel, src, tree, _collect_suppressions(src))
+
+
+def load_source(abspath: str, rel: str) -> ModuleSource:
+    """Parse (or fetch from the per-process cache) one module."""
+    st = os.stat(abspath)
+    key = (st.st_mtime_ns, st.st_size)
+    hit = _SOURCE_CACHE.get(abspath)
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    with open(abspath, encoding="utf-8") as fh:
+        src = fh.read()
+    rel = rel.replace(os.sep, "/")
+    tree = ast.parse(src, filename=rel)
+    _annotate_parents(tree)
+    ms = ModuleSource(rel, abspath, src, tree, _collect_suppressions(src))
+    _SOURCE_CACHE[abspath] = (key, ms)
+    return ms
+
+
+def load_sources(paths: Sequence[str],
+                 root: Optional[str] = None) -> List[ModuleSource]:
+    """Load every .py under ``paths`` through the parse cache; display
+    paths are root-relative. Unparseable files are skipped here — the
+    per-file pass reports them as DL000."""
+    root = os.path.abspath(root or os.getcwd())
+    out: List[ModuleSource] = []
+    for f in iter_py_files(paths):
+        ab = os.path.abspath(f)
+        rel = os.path.relpath(ab, root) if ab.startswith(root + os.sep) else f
+        try:
+            out.append(load_source(ab, rel))
+        except SyntaxError:
+            continue
+    return out
+
+
 def _collect_suppressions(src: str) -> Dict[int, Set[str]]:
     out: Dict[int, Set[str]] = {}
     for i, line in enumerate(src.splitlines(), start=1):
@@ -565,19 +642,26 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
     return sorted(set(files))
 
 
+def analyze_module(ms: ModuleSource) -> List[Violation]:
+    """Per-file rule pass over an already-parsed module (cache-friendly
+    twin of :func:`analyze_source`)."""
+    analyzer = _Analyzer(ms.path, ms.suppressed)
+    analyzer.visit(ms.tree)
+    return analyzer.finalize()
+
+
 def analyze_paths(paths: Sequence[str],
                   root: Optional[str] = None) -> List[Violation]:
-    """Analyze every .py under ``paths``; reported paths are relative to
-    ``root`` (default: cwd) so baseline entries are location-independent."""
+    """Run the per-file rules on every .py under ``paths``; reported paths
+    are relative to ``root`` (default: cwd) so baseline entries are
+    location-independent. Parses go through the shared source cache."""
     root = os.path.abspath(root or os.getcwd())
     out: List[Violation] = []
     for f in iter_py_files(paths):
         ab = os.path.abspath(f)
         rel = os.path.relpath(ab, root) if ab.startswith(root + os.sep) else f
-        with open(f, encoding="utf-8") as fh:
-            src = fh.read()
         try:
-            out.extend(analyze_source(src, rel))
+            out.extend(analyze_module(load_source(ab, rel)))
         except SyntaxError as e:
             out.append(Violation(rel.replace(os.sep, "/"), e.lineno or 0, 0,
                                  "DL000", "syntax-error", str(e), "<module>"))

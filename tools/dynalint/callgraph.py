@@ -1,0 +1,435 @@
+"""dynaflow: whole-program module/import/call-graph builder.
+
+The per-file rules (DL001-DL007) are intra-procedural by construction —
+they cannot see an async endpoint calling a sync helper that blocks three
+frames down the call stack. This module builds the project-wide view the
+interprocedural rules need:
+
+- a **module map** (root-relative path → dotted module name),
+- per-module **import alias resolution** (``import x.y as z``,
+  ``from ..pkg import name``, re-export chains through ``__init__``),
+- a **function table** with async-ness and dotted qualnames (methods are
+  attributed to their class; nested defs to their enclosing function),
+- **call edges** resolved through aliases, ``self``/``cls`` attribution
+  (including single-inheritance base-class lookup), and plain/dotted
+  module references, and
+- **blocking-call propagation**: which functions transitively reach a
+  blocking primitive (``time.sleep``, ``open``, ``requests.*``, ...)
+  within a bounded call depth.
+
+Resolution is deliberately conservative: an edge is only recorded when
+the callee resolves to a project function. Attribute calls on unknown
+objects (``self.engine.foo()``) produce no edge — a whole-program lint
+must never guess, or its violations stop being actionable. Calls passed
+*as arguments* (``asyncio.to_thread(helper)``) create no edge either:
+the helper runs off-loop, which is exactly the sanctioned fix for DL008.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .analyzer import (BLOCKING_BUILTINS, BLOCKING_CALLS, BLOCKING_PREFIXES,
+                       ModuleSource, dotted)
+
+# suppression tags that quiet DL008 at a call site or at the blocking sink
+_DL008_TAGS = frozenset({"DL008", "transitive-blocking-in-async", "all"})
+
+DEFAULT_DL008_DEPTH = 4  # max sync frames between the async def and the sink
+
+
+def module_name(rel_path: str) -> str:
+    """'dynamo_tpu/llm/tokenizer.py' -> 'dynamo_tpu.llm.tokenizer';
+    package __init__ files map to the package itself."""
+    p = rel_path.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+@dataclass
+class CallSite:
+    line: int
+    col: int
+    raw: str                      # callee as written ('self.foo', 'mod.fn')
+    target: Optional[str] = None  # resolved function key, if any
+
+
+@dataclass
+class FuncInfo:
+    key: str          # '<module>:<qualname>'
+    module: str
+    qualname: str     # 'Class.method' / 'func' / 'func.inner'
+    name: str
+    is_async: bool
+    lineno: int
+    path: str
+    calls: List[CallSite] = field(default_factory=list)
+    # direct blocking primitives: (line, what) — suppressed ones excluded
+    blocking: List[Tuple[int, str]] = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str                      # top-level class name
+    bases: List[str] = field(default_factory=list)  # raw dotted base exprs
+    methods: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ModuleGraph:
+    name: str
+    path: str
+    is_package: bool = False      # __init__.py (relative imports anchor here)
+    imports: Dict[str, str] = field(default_factory=dict)  # alias -> dotted
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)  # qualname
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    suppressed: Dict[int, Set[str]] = field(default_factory=dict)
+
+
+@dataclass
+class BlockPath:
+    """Nearest blocking primitive reachable from a (sync) function."""
+
+    depth: int              # 0 = the function itself blocks
+    chain: List[str]        # function keys, this function -> ... -> sink fn
+    sink_path: str
+    sink_line: int
+    what: str
+
+
+def _is_offload_call(call: ast.Call) -> bool:
+    """Calls whose function-object arguments run OFF the event loop."""
+    d = dotted(call.func)
+    if d in ("asyncio.to_thread",):
+        return True
+    return isinstance(call.func, ast.Attribute) and \
+        call.func.attr in ("run_in_executor", "to_thread")
+
+
+class _Collector(ast.NodeVisitor):
+    """One pass over a module: imports, classes, functions, call sites,
+    direct blocking primitives. Calls are attributed to the *innermost*
+    enclosing function; module-level calls run at import time and are
+    not an event-loop hazard, so they are dropped."""
+
+    def __init__(self, mod: ModuleGraph):
+        self.mod = mod
+        self._classes: List[str] = []
+        self._funcs: List[FuncInfo] = []
+
+    # ------------------------------------------------------------- imports
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.asname:
+                self.mod.imports[alias.asname] = alias.name
+            else:
+                root = alias.name.split(".")[0]
+                self.mod.imports[root] = root
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = node.module or ""
+        if node.level:
+            # relative import: level 1 anchors at this module's package
+            # (the module itself when it IS a package __init__)
+            pkg = self.mod.name.split(".")
+            up = len(pkg) - node.level + (1 if self.mod.is_package else 0)
+            if up < 0:
+                up = 0
+            base_parts = pkg[:up] + ([node.module] if node.module else [])
+            base = ".".join(p for p in base_parts if p)
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            target = f"{base}.{alias.name}" if base else alias.name
+            self.mod.imports[alias.asname or alias.name] = target
+
+    # ------------------------------------------------------------- scoping
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if not self._classes and not self._funcs:  # top-level classes only
+            ci = ClassInfo(self.mod.name, node.name,
+                           bases=[dotted(b) for b in node.bases
+                                  if dotted(b)])
+            self.mod.classes[node.name] = ci
+        self._classes.append(node.name)
+        self.generic_visit(node)
+        self._classes.pop()
+
+    def _visit_func(self, node, is_async: bool) -> None:
+        qual = ".".join(self._classes
+                        + [f.name for f in self._funcs] + [node.name])
+        fi = FuncInfo(key=f"{self.mod.name}:{qual}", module=self.mod.name,
+                      qualname=qual, name=node.name, is_async=is_async,
+                      lineno=node.lineno, path=self.mod.path)
+        self.mod.functions[qual] = fi
+        if len(self._classes) == 1 and not self._funcs and \
+                self._classes[0] in self.mod.classes:
+            self.mod.classes[self._classes[0]].methods.add(node.name)
+        self._funcs.append(fi)
+        self.generic_visit(node)
+        self._funcs.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node, False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node, True)
+
+    # --------------------------------------------------------------- calls
+
+    def _suppressed(self, line: int) -> bool:
+        for probe in (line, line - 1):
+            tags = self.mod.suppressed.get(probe)
+            if tags and tags & _DL008_TAGS:
+                return True
+        return False
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._funcs:
+            fn = self._funcs[-1]
+            d = dotted(node.func)
+            if d is not None:
+                fn.calls.append(CallSite(node.lineno, node.col_offset, d))
+            what = None
+            if d is not None and (d in BLOCKING_CALLS
+                                  or d in BLOCKING_BUILTINS
+                                  or any(d.startswith(p)
+                                         for p in BLOCKING_PREFIXES)):
+                what = d
+            if what is not None and not self._suppressed(node.lineno):
+                fn.blocking.append((node.lineno, what))
+        if _is_offload_call(node):
+            # visit only the callee expr: function-object args escape to a
+            # thread, so neither their edges nor their blocking count here
+            self.visit(node.func)
+            return
+        self.generic_visit(node)
+
+
+class CallGraph:
+    """The resolved whole-program graph."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleGraph] = {}
+        self.functions: Dict[str, FuncInfo] = {}  # key -> FuncInfo
+
+    # ------------------------------------------------------------ building
+
+    @classmethod
+    def build(cls, sources: Sequence[ModuleSource]) -> "CallGraph":
+        g = cls()
+        for ms in sources:
+            is_pkg = ms.path.replace(os.sep, "/").endswith("/__init__.py")
+            mod = ModuleGraph(name=module_name(ms.path), path=ms.path,
+                              is_package=is_pkg, suppressed=ms.suppressed)
+            g.modules[mod.name] = mod
+            _Collector(mod).visit(ms.tree)
+        for mod in g.modules.values():
+            for fi in mod.functions.values():
+                g.functions[fi.key] = fi
+        for mod in g.modules.values():
+            for fi in mod.functions.values():
+                first = fi.qualname.split(".")[0]
+                cls_name = first if first in mod.classes else None
+                for cs in fi.calls:
+                    cs.target = g._resolve(mod, cs.raw, cls_name, fi)
+        return g
+
+    # ---------------------------------------------------------- resolution
+
+    def _resolve(self, mod: ModuleGraph, raw: str,
+                 cls_name: Optional[str], fi: FuncInfo,
+                 _depth: int = 0) -> Optional[str]:
+        if _depth > 8:
+            return None
+        parts = raw.split(".")
+        if parts[0] in ("self", "cls") and cls_name is not None \
+                and len(parts) == 2:
+            return self._resolve_method(mod, cls_name, parts[1])
+        if len(parts) == 1:
+            name = parts[0]
+            # sibling/child nested def inside the same enclosing FUNCTION
+            # (a bare name never resolves to a method of the own class)
+            parent = fi.qualname.rsplit(".", 1)[0] \
+                if "." in fi.qualname else None
+            for scope in (fi.qualname, parent):
+                if scope and scope in mod.functions \
+                        and f"{scope}.{name}" in mod.functions:
+                    return f"{mod.name}:{scope}.{name}"
+            if name in mod.functions:
+                return f"{mod.name}:{name}"
+            if name in mod.classes:
+                return self._resolve_method(mod, name, "__init__")
+            if name in mod.imports:
+                return self._resolve_dotted(mod.imports[name], _depth + 1)
+            return None
+        head, rest = parts[0], parts[1:]
+        if head in mod.imports:
+            return self._resolve_dotted(
+                mod.imports[head] + "." + ".".join(rest), _depth + 1)
+        if head in mod.classes and len(rest) == 1:
+            return self._resolve_method(mod, head, rest[0])
+        return self._resolve_dotted(raw, _depth + 1)
+
+    def _resolve_method(self, mod: ModuleGraph, cls_name: str,
+                        meth: str, _seen: Optional[Set[str]] = None
+                        ) -> Optional[str]:
+        """Method lookup with base-class walking (project classes only)."""
+        _seen = _seen or set()
+        key = f"{mod.name}.{cls_name}"
+        if key in _seen:
+            return None
+        _seen.add(key)
+        qual = f"{cls_name}.{meth}"
+        if qual in mod.functions:
+            return f"{mod.name}:{qual}"
+        ci = mod.classes.get(cls_name)
+        if ci is None:
+            return None
+        for base_raw in ci.bases:
+            base_mod, base_cls = self._resolve_class(mod, base_raw)
+            if base_mod is not None:
+                hit = self._resolve_method(base_mod, base_cls, meth, _seen)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _resolve_class(self, mod: ModuleGraph, raw: str
+                       ) -> Tuple[Optional[ModuleGraph], Optional[str]]:
+        parts = raw.split(".")
+        if len(parts) == 1:
+            if parts[0] in mod.classes:
+                return mod, parts[0]
+            if parts[0] in mod.imports:
+                return self._find_class(mod.imports[parts[0]])
+            return None, None
+        if parts[0] in mod.imports:
+            return self._find_class(
+                mod.imports[parts[0]] + "." + ".".join(parts[1:]))
+        return self._find_class(raw)
+
+    def _find_class(self, dotted_name: str, _depth: int = 0
+                    ) -> Tuple[Optional[ModuleGraph], Optional[str]]:
+        if _depth > 8:
+            return None, None
+        for cut in range(len(dotted_name.split(".")) - 1, 0, -1):
+            parts = dotted_name.split(".")
+            mname, rest = ".".join(parts[:cut]), parts[cut:]
+            m = self.modules.get(mname)
+            if m is None:
+                continue
+            if len(rest) == 1:
+                if rest[0] in m.classes:
+                    return m, rest[0]
+                if rest[0] in m.imports:  # re-export (__init__ chains)
+                    return self._find_class(m.imports[rest[0]], _depth + 1)
+            return None, None
+        return None, None
+
+    def _resolve_dotted(self, dotted_name: str,
+                        _depth: int = 0) -> Optional[str]:
+        """Longest-module-prefix lookup; follows __init__ re-exports."""
+        if _depth > 8:
+            return None
+        parts = dotted_name.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mname = ".".join(parts[:cut])
+            m = self.modules.get(mname)
+            if m is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                name = rest[0]
+                if name in m.functions:
+                    return f"{mname}:{name}"
+                if name in m.classes:
+                    return self._resolve_method(m, name, "__init__")
+                if name in m.imports:
+                    return self._resolve_dotted(m.imports[name], _depth + 1)
+                return None
+            if len(rest) == 2:
+                qual = ".".join(rest)
+                if qual in m.functions:
+                    return f"{mname}:{qual}"
+                if rest[0] in m.imports:
+                    return self._resolve_dotted(
+                        m.imports[rest[0]] + "." + rest[1], _depth + 1)
+                return None
+            return None
+        return None
+
+    # -------------------------------------------- blocking reachability
+
+    def blocking_reachability(self, max_depth: int = DEFAULT_DL008_DEPTH
+                              ) -> Dict[str, BlockPath]:
+        """For every SYNC project function, the nearest reachable blocking
+        primitive within ``max_depth`` sync frames (0 = blocks directly).
+        Async callees terminate propagation: their bodies are analyzed as
+        their own roots."""
+        info: Dict[str, BlockPath] = {}
+        for fi in self.functions.values():
+            if fi.is_async or not fi.blocking:
+                continue
+            line, what = fi.blocking[0]
+            info[fi.key] = BlockPath(0, [fi.key], fi.path, line, what)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions.values():
+                if fi.is_async:
+                    continue
+                for cs in fi.calls:
+                    sub = info.get(cs.target) if cs.target else None
+                    if sub is None:
+                        continue
+                    callee = self.functions.get(cs.target)
+                    if callee is None or callee.is_async:
+                        continue
+                    depth = sub.depth + 1
+                    cur = info.get(fi.key)
+                    if depth <= max_depth and \
+                            (cur is None or depth < cur.depth):
+                        info[fi.key] = BlockPath(
+                            depth, [fi.key] + sub.chain,
+                            sub.sink_path, sub.sink_line, sub.what)
+                        changed = True
+        return info
+
+    # ------------------------------------------------------------- export
+
+    def to_dot(self, reach: Optional[Dict[str, BlockPath]] = None) -> str:
+        """Graphviz export of the project-resolved graph: async defs are
+        filled blue, functions that (transitively) reach a blocking
+        primitive get a red outline, direct blockers a bold red outline."""
+        reach = reach if reach is not None else self.blocking_reachability()
+        lines = ["digraph dynaflow {",
+                 '  rankdir=LR; node [shape=box, fontsize=10];']
+        for key, fi in sorted(self.functions.items()):
+            attrs = []
+            if fi.is_async:
+                attrs.append('style=filled, fillcolor="#cfe8ff"')
+            bp = reach.get(key)
+            if bp is not None:
+                attrs.append('color=red' + (', penwidth=2'
+                                            if bp.depth == 0 else ''))
+            label = key.replace(":", "\\n")
+            lines.append(f'  "{key}" [label="{label}"'
+                         + (", " + ", ".join(attrs) if attrs else "") + "];")
+        seen = set()
+        for fi in self.functions.values():
+            for cs in fi.calls:
+                if cs.target and cs.target in self.functions:
+                    edge = (fi.key, cs.target)
+                    if edge not in seen:
+                        seen.add(edge)
+                        lines.append(f'  "{fi.key}" -> "{cs.target}";')
+        lines.append("}")
+        return "\n".join(lines) + "\n"

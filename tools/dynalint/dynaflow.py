@@ -1,0 +1,643 @@
+"""dynaflow: the interprocedural rule passes (DL008-DL010).
+
+Built on :mod:`callgraph` (whole-program call graph) and the wire-schema
+registry declared in ``dynamo_tpu/runtime/wire.py``. The registry is read
+**statically** — ``register_frame(...)`` calls are required to be pure
+literals, parsed here with ``ast.literal_eval`` — so the lint pass never
+imports the runtime package (no jax, no msgpack, runs anywhere).
+
+Rules:
+
+- **DL008 transitive-blocking-in-async** — a blocking primitive
+  (``time.sleep``, ``open``, ``requests.*``, ...) reachable from an
+  ``async def`` through a chain of sync project helpers, bounded by
+  ``--dl008-depth`` (default 4) frames. Reported at the async def's call
+  site into the chain; suppressible there or at the blocking sink line.
+- **DL009 wire-field-drift** — a literal key written through a
+  ``wire.checked(FRAME, ...)`` encode anchor or read through a
+  ``wire.decoded(FRAME, ...)`` decode anchor that is absent from the
+  frame's declared schema; plus the whole-program direction: a field
+  declared *required* that no decode anchor anywhere ever reads.
+- **DL010 undeclared-wire-frame** — a ``codec.encode`` /
+  ``codec.encode_parts`` call site whose header is neither routed through
+  ``wire.checked`` nor statically matches any registered frame. Opaque
+  headers (built elsewhere) are skipped: a static pass must not guess.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .analyzer import (RULES, ModuleSource, Violation, call_attr, dotted,
+                       load_sources)
+from .callgraph import DEFAULT_DL008_DEPTH, CallGraph
+
+WIRE_MODULE_REL = "dynamo_tpu/runtime/wire.py"
+CODEC_MODULE_REL = "dynamo_tpu/runtime/codec.py"
+
+_ANCHOR_ENCODE = "checked"
+_ANCHOR_DECODE = "decoded"
+
+
+# --------------------------------------------------------------- wire schemas
+
+@dataclass(frozen=True)
+class FrameSchema:
+    """Statically-extracted twin of runtime ``wire.WireFrame``."""
+
+    name: str
+    version: int
+    required: frozenset
+    optional: frozenset
+    when: Tuple[Tuple[str, object], ...]
+    line: int          # registration line in the wire module
+    const: str         # module-level constant the registration binds
+
+    @property
+    def fields(self) -> frozenset:
+        return self.required | self.optional
+
+    def literal_matches(self, keys: Set[str],
+                        consts: Dict[str, object], exact: bool) -> bool:
+        """Static frame inference over a dict literal: ``keys`` are the
+        literal keys, ``consts`` the literal constant values. ``exact``
+        requires all required fields present (no dynamic elements)."""
+        if not keys <= self.fields:
+            return False
+        if exact and not self.required <= keys:
+            return False
+        for k, want in self.when:
+            if exact and k not in keys:
+                return False
+            if want is not None and k in consts and consts[k] != want:
+                return False
+        return True
+
+
+def load_wire_schemas(ms: ModuleSource
+                      ) -> Tuple[Dict[str, FrameSchema], Dict[str, str],
+                                 List[Violation]]:
+    """Parse ``register_frame`` declarations out of the wire module.
+    Returns (schemas by name, const-name -> frame-name, violations for
+    non-literal declarations — those would silently fall out of the
+    static pass, so they fail loudly)."""
+    schemas: Dict[str, FrameSchema] = {}
+    const_map: Dict[str, str] = {}
+    bad: List[Violation] = []
+    for node in ms.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "register_frame"):
+            continue
+        const = node.targets[0].id
+        call = node.value
+        try:
+            name = ast.literal_eval(call.args[0])
+            kw = {k.arg: ast.literal_eval(k.value) for k in call.keywords}
+        except (ValueError, SyntaxError):
+            bad.append(Violation(
+                ms.path, node.lineno, node.col_offset, "DL009",
+                RULES["DL009"][0],
+                f"register_frame({const}) uses non-literal arguments: the "
+                f"static conformance pass cannot see this frame",
+                "<module>"))
+            continue
+        req, opt = set(), set()
+        for fname, _ftype, mode, _since, _doc in kw.get("fields", ()):
+            (req if mode == "required" else opt).add(fname)
+        schemas[name] = FrameSchema(
+            name=name, version=int(kw.get("version", 1)),
+            required=frozenset(req), optional=frozenset(opt),
+            when=tuple(sorted((kw.get("when") or {}).items())),
+            line=node.lineno, const=const)
+        const_map[const] = name
+    return schemas, const_map, bad
+
+
+# ---------------------------------------------------------- per-module scan
+
+class _WireScan(ast.NodeVisitor):
+    """Collect wire anchors, dict-literal key flows and codec encode call
+    sites for one module. Flow-insensitive within a function scope: keys
+    from the dict literal, later ``var[k] = ...`` stores and
+    ``var.update(k=...)`` calls all merge into the variable's key set."""
+
+    def __init__(self, ms: ModuleSource, schemas: Dict[str, FrameSchema],
+                 const_map: Dict[str, str]):
+        self.ms = ms
+        self.schemas = schemas
+        self.const_map = const_map
+        self.violations: List[Violation] = []
+        # (frame, key) reads observed through decode anchors (module-wide)
+        self.decode_reads: Set[Tuple[str, str]] = set()
+        self.decode_anchored_frames: Set[str] = set()
+        self._classes: List[str] = []
+        self._funcs: List[str] = []
+        # per-function state, reset at function entry
+        self._var_keys: Dict[str, Set[str]] = {}
+        self._var_consts: Dict[str, Dict[str, object]] = {}
+        self._var_dynamic: Dict[str, bool] = {}
+        self._encode_anchored: Dict[str, Tuple[str, ...]] = {}
+        self._encode_lines: Dict[str, int] = {}
+        self._decode_vars: Dict[str, Tuple[str, ...]] = {}
+        self._imports: Dict[str, str] = {}
+        # module-level frame-tuple aliases: _KV_FRAMES = (wire.A, wire.B)
+        self._frame_aliases: Dict[str, Tuple[str, ...]] = {}
+        from .callgraph import module_name
+
+        self._modname = module_name(ms.path)
+        self._is_pkg = ms.path.endswith("/__init__.py")
+
+    # ------------------------------------------------------------ plumbing
+
+    def _scope(self) -> str:
+        parts = self._classes + self._funcs
+        return ".".join(parts) if parts else "<module>"
+
+    def _suppressed(self, line: int, code: str) -> bool:
+        name = RULES[code][0]
+        for probe in (line, line - 1):
+            tags = self.ms.suppressed.get(probe)
+            if tags and (code in tags or name in tags or "all" in tags):
+                return True
+        return False
+
+    def _emit(self, node: ast.AST, code: str, detail: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if self._suppressed(line, code):
+            return
+        name, summary = RULES[code]
+        self.violations.append(Violation(
+            self.ms.path, line, getattr(node, "col_offset", 0), code, name,
+            f"{summary}: {detail}", self._scope()))
+
+    # ------------------------------------------------------------- imports
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.asname:
+                self._imports[alias.asname] = alias.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = node.module or ""
+        if node.level:
+            pkg = self._modname.split(".")
+            up = len(pkg) - node.level + (1 if self._is_pkg else 0)
+            base_parts = pkg[:max(up, 0)] + \
+                ([node.module] if node.module else [])
+            base = ".".join(p for p in base_parts if p)
+        for alias in node.names:
+            if alias.name != "*":
+                self._imports[alias.asname or alias.name] = \
+                    f"{base}.{alias.name}" if base else alias.name
+
+    # ------------------------------------------------------------- scoping
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._classes.append(node.name)
+        self.generic_visit(node)
+        self._classes.pop()
+
+    def _visit_func(self, node) -> None:
+        saved = (self._var_keys, self._var_consts, self._var_dynamic,
+                 self._encode_anchored, self._encode_lines,
+                 self._decode_vars)
+        self._var_keys, self._var_consts = {}, {}
+        self._var_dynamic, self._encode_anchored = {}, {}
+        self._encode_lines, self._decode_vars = {}, {}
+        self._funcs.append(node.name)
+        self.generic_visit(node)
+        self._check_encode_vars()
+        self._funcs.pop()
+        (self._var_keys, self._var_consts, self._var_dynamic,
+         self._encode_anchored, self._encode_lines,
+         self._decode_vars) = saved
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # --------------------------------------------------------- anchor utils
+
+    def _frame_names(self, node: ast.AST) -> Optional[Tuple[str, ...]]:
+        """Resolve a frame-reference expression: ``wire.CONST``, a bare
+        imported CONST, a string literal, a tuple of those, a
+        module-level tuple alias (``_KV_FRAMES``) or a ``+`` of tuples.
+        ``None`` when it is not a wire-frame reference at all."""
+        if isinstance(node, ast.Tuple):
+            out: List[str] = []
+            for el in node.elts:
+                got = self._frame_names(el)
+                if got is None:
+                    return None
+                out.extend(got)
+            return tuple(out)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left = self._frame_names(node.left)
+            right = self._frame_names(node.right)
+            return left + right if left and right else None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return (node.value,) if node.value in self.schemas else None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+            if name in self._frame_aliases:
+                return self._frame_aliases[name]
+        else:
+            return None
+        frame = self.const_map.get(name)
+        return (frame,) if frame else None
+
+    def _anchor_kind(self, call: ast.Call) -> Optional[str]:
+        """'checked' / 'decoded' when the call is a wire anchor."""
+        fn = call.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name not in (_ANCHOR_ENCODE, _ANCHOR_DECODE) or not call.args:
+            return None
+        if self._frame_names(call.args[0]) is None:
+            return None
+        return name
+
+    @staticmethod
+    def _dict_literal_keys(node: ast.Dict
+                           ) -> Tuple[Set[str], Dict[str, object], bool]:
+        keys: Set[str] = set()
+        consts: Dict[str, object] = {}
+        dynamic = False
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+                if isinstance(v, ast.Constant):
+                    consts[k.value] = v.value
+            else:
+                dynamic = True  # **splat or computed key
+        return keys, consts, dynamic
+
+    def _check_keys(self, frames: Tuple[str, ...], keys: Set[str],
+                    node: ast.AST, side: str) -> None:
+        allowed = frozenset().union(
+            *(self.schemas[f].fields for f in frames))
+        for key in sorted(keys - allowed):
+            self._emit(node, "DL009",
+                       f"{side} key `{key}` is not declared on frame "
+                       f"{'/'.join(frames)}")
+
+    # ----------------------------------------------------------- statements
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        value = node.value
+        targets = [t for t in node.targets if isinstance(t, ast.Name)]
+        if not self._funcs and targets and isinstance(
+                value, (ast.Tuple, ast.BinOp, ast.Attribute, ast.Name)):
+            frames = self._frame_names(value)
+            if frames:
+                for t in targets:
+                    self._frame_aliases[t.id] = frames
+        if isinstance(value, ast.Call):
+            kind = self._anchor_kind(value)
+            if kind is not None and len(value.args) >= 2:
+                frames = self._frame_names(value.args[0])
+                if kind == _ANCHOR_DECODE:
+                    self.decode_anchored_frames.update(frames)
+                    for t in targets:
+                        self._decode_vars[t.id] = frames
+                    self.generic_visit(value)
+                    return
+                # encode anchor: keys flow from the literal or the source
+                # var into the result var(s)
+                hdr = value.args[1]
+                keys: Set[str] = set()
+                consts: Dict[str, object] = {}
+                if isinstance(hdr, ast.Dict):
+                    keys, consts, _dyn = self._dict_literal_keys(hdr)
+                elif isinstance(hdr, ast.Name):
+                    keys = set(self._var_keys.get(hdr.id, set()))
+                    consts = dict(self._var_consts.get(hdr.id, {}))
+                for t in targets:
+                    self._encode_anchored[t.id] = frames
+                    self._encode_lines[t.id] = node.lineno
+                    self._var_keys.setdefault(t.id, set()).update(keys)
+                    self._var_consts.setdefault(t.id, {}).update(consts)
+                self.generic_visit(value)
+                return
+        if isinstance(value, ast.Dict) and targets:
+            keys, consts, dyn = self._dict_literal_keys(value)
+            for t in targets:
+                self._var_keys.setdefault(t.id, set()).update(keys)
+                self._var_consts.setdefault(t.id, {}).update(consts)
+                if dyn:
+                    self._var_dynamic[t.id] = True
+        self.generic_visit(node)
+
+    # ---------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        kind = self._anchor_kind(node)
+        if kind is not None and len(node.args) >= 2:
+            frames = self._frame_names(node.args[0])
+            hdr = node.args[1]
+            if kind == _ANCHOR_DECODE:
+                self.decode_anchored_frames.update(frames)
+                if isinstance(hdr, ast.Dict):
+                    keys, _c, _d = self._dict_literal_keys(hdr)
+                    self._check_keys(frames, keys, node, "decoded")
+            else:
+                if isinstance(hdr, ast.Dict):
+                    keys, _c, _d = self._dict_literal_keys(hdr)
+                    self._check_keys(frames, keys, node, "encoded")
+                elif isinstance(hdr, ast.Name):
+                    self._encode_anchored[hdr.id] = frames
+        # var.update(key=...) key flow + decode-read via .get
+        attr = call_attr(node)
+        if attr == "update" and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name):
+            var = node.func.value.id
+            kw_keys = {k.arg for k in node.keywords if k.arg}
+            if var in self._var_keys or var in self._encode_anchored:
+                self._var_keys.setdefault(var, set()).update(kw_keys)
+            if var in self._decode_vars:
+                self._note_reads(var, kw_keys, node)
+        if attr in ("get", "pop", "setdefault") \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            var = node.func.value.id
+            if var in self._decode_vars:
+                self._note_reads(var, {node.args[0].value}, node)
+        self._check_codec_site(node)
+        self.generic_visit(node)
+
+    def _note_reads(self, var: str, keys: Set[str], node: ast.AST) -> None:
+        frames = self._decode_vars[var]
+        self._check_keys(frames, keys, node, "decoded")
+        for key in keys:
+            for f in frames:
+                if key in self.schemas[f].fields:
+                    self.decode_reads.add((f, key))
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.value, ast.Name) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            var, key = node.value.id, node.slice.value
+            if isinstance(node.ctx, ast.Store):
+                if var in self._var_keys or var in self._encode_anchored:
+                    self._var_keys.setdefault(var, set()).add(key)
+            elif var in self._decode_vars:
+                self._note_reads(var, {key}, node)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # `"key" in var` on a decode-anchored var counts as a read
+        if len(node.ops) == 1 and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and isinstance(node.left, ast.Constant) \
+                and isinstance(node.left.value, str) \
+                and isinstance(node.comparators[0], ast.Name) \
+                and node.comparators[0].id in self._decode_vars:
+            self._note_reads(node.comparators[0].id,
+                             {node.left.value}, node)
+        self.generic_visit(node)
+
+    # -------------------------------------------------- encode-var checking
+
+    def _check_encode_vars(self) -> None:
+        """At function exit, validate accumulated keys of every var that
+        passed through a wire.checked encode anchor."""
+        for var, frames in self._encode_anchored.items():
+            keys = self._var_keys.get(var)
+            if keys:
+                # report at... we lack a node; synthesize at function level
+                allowed = frozenset().union(
+                    *(self.schemas[f].fields for f in frames))
+                extra = sorted(keys - allowed)
+                if extra:
+                    # anchor-line unknown: attribute to the first line the
+                    # scan saw for this function (best effort, scope-keyed)
+                    v = Violation(
+                        self.ms.path, self._encode_lines.get(var, 0), 0,
+                        "DL009", RULES["DL009"][0],
+                        f"{RULES['DL009'][1]}: encoded key(s) {extra} not "
+                        f"declared on frame {'/'.join(frames)}",
+                        self._scope())
+                    if not self._suppressed(v.line, "DL009"):
+                        self.violations.append(v)
+
+    # ------------------------------------------------------- DL010 (codec)
+
+    def _codec_fn(self, call: ast.Call) -> Optional[str]:
+        """'encode' / 'encode_parts' when the call resolves to the codec
+        module's encoders (via alias or module attribute)."""
+        d = dotted(call.func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        tail = parts[-1]
+        if tail not in ("encode", "encode_parts"):
+            return None
+        if len(parts) == 1:
+            target = self._imports.get(tail, "")
+            return tail if target.endswith(f"codec.{tail}") else None
+        base = self._imports.get(parts[0], parts[0])
+        full = ".".join([base] + parts[1:-1])
+        return tail if full.endswith("codec") else None
+
+    def _header_expr(self, call: ast.Call, which: str) -> Optional[ast.AST]:
+        if which == "encode_parts":
+            return call.args[0] if call.args else None
+        # encode(TwoPartMessage(header=..., ...)) / encode(msg)
+        if not call.args:
+            return None
+        arg = call.args[0]
+        if isinstance(arg, ast.Call) and (
+                (isinstance(arg.func, ast.Name)
+                 and arg.func.id == "TwoPartMessage")
+                or (isinstance(arg.func, ast.Attribute)
+                    and arg.func.attr == "TwoPartMessage")):
+            for kw in arg.keywords:
+                if kw.arg == "header":
+                    return kw.value
+            return arg.args[0] if arg.args else None
+        return arg
+
+    def _check_codec_site(self, node: ast.Call) -> None:
+        which = self._codec_fn(node)
+        if which is None:
+            return
+        norm = self.ms.path
+        if norm.endswith(("runtime/codec.py", "runtime/wire.py")):
+            return  # the codec/registry internals themselves
+        hdr = self._header_expr(node, which)
+        if hdr is None:
+            return
+        if isinstance(hdr, ast.Call) and self._anchor_kind(hdr) is not None:
+            return  # wire.checked(...) inline — anchored
+        keys: Optional[Set[str]] = None
+        consts: Dict[str, object] = {}
+        exact = False
+        if isinstance(hdr, ast.Name):
+            if hdr.id in self._encode_anchored:
+                return  # var passed through wire.checked earlier
+            if hdr.id in self._var_keys:
+                keys = self._var_keys[hdr.id]
+                consts = self._var_consts.get(hdr.id, {})
+                exact = not self._var_dynamic.get(hdr.id, False)
+        elif isinstance(hdr, ast.Dict):
+            keys, consts, dyn = self._dict_literal_keys(hdr)
+            exact = not dyn
+        if keys is None:
+            return  # opaque header (built elsewhere): never guess
+        if not any(s.literal_matches(keys, consts, exact)
+                   for s in self.schemas.values()):
+            self._emit(node, "DL010",
+                       f"header keys {sorted(keys)} match no registered "
+                       f"frame — declare it in runtime/wire.py and anchor "
+                       f"with wire.checked(...)")
+
+
+# ---------------------------------------------------------------- DL008 pass
+
+def check_transitive_blocking(graph: CallGraph,
+                              depth: int = DEFAULT_DL008_DEPTH
+                              ) -> List[Violation]:
+    reach = graph.blocking_reachability(depth)
+    out: List[Violation] = []
+    seen: Set[Tuple[str, str]] = set()
+    name, summary = RULES["DL008"]
+    for fi in graph.functions.values():
+        if not fi.is_async:
+            continue
+        mod = graph.modules[fi.module]
+        for cs in fi.calls:
+            bp = reach.get(cs.target) if cs.target else None
+            if bp is None or bp.depth + 1 > depth:
+                continue
+            if (fi.key, cs.target) in seen:
+                continue
+            seen.add((fi.key, cs.target))
+            suppressed = False
+            for probe in (cs.line, cs.line - 1):
+                tags = mod.suppressed.get(probe)
+                if tags and ({"DL008", name, "all"} & tags):
+                    suppressed = True
+            if suppressed:
+                continue
+            chain = " -> ".join(
+                k.split(":", 1)[1] for k in [cs.target] + bp.chain[1:])
+            out.append(Violation(
+                fi.path, cs.line, cs.col, "DL008", name,
+                f"{summary}: `{cs.raw}` reaches blocking `{bp.what}` via "
+                f"{chain} ({bp.sink_path}:{bp.sink_line})",
+                fi.qualname))
+    return out
+
+
+# -------------------------------------------------------------- DL009 global
+
+def _check_required_never_read(
+        schemas: Dict[str, FrameSchema], wire_path: str,
+        decode_reads: Set[Tuple[str, str]],
+        anchored: Set[str],
+        wire_suppressed: Dict[int, Set[str]]) -> List[Violation]:
+    """A required field no decode anchor anywhere reads is dead weight on
+    every frame (or a decoder forgot it) — flagged at its registration."""
+    out: List[Violation] = []
+    name, summary = RULES["DL009"]
+    for schema in schemas.values():
+        if schema.name not in anchored:
+            continue  # no decoder in the scanned tree: cannot judge
+        unread = sorted(k for k in schema.required
+                        if (schema.name, k) not in decode_reads)
+        for key in unread:
+            suppressed = any(
+                tags and ({"DL009", name, "all"} & tags)
+                for tags in (wire_suppressed.get(schema.line),
+                             wire_suppressed.get(schema.line - 1)))
+            if suppressed:
+                continue
+            out.append(Violation(
+                wire_path, schema.line, 0, "DL009", name,
+                f"{summary}: required field `{key}` of frame "
+                f"`{schema.name}` is never read by any decode anchor — "
+                f"demote it to optional or fix the decoder",
+                schema.name))
+    return out
+
+
+# ------------------------------------------------------------------- driver
+
+def analyze_project(sources: Sequence[ModuleSource],
+                    schemas: Optional[Dict[str, FrameSchema]] = None,
+                    const_map: Optional[Dict[str, str]] = None,
+                    dl008_depth: int = DEFAULT_DL008_DEPTH
+                    ) -> List[Violation]:
+    """Run the whole-program passes over already-loaded modules. The wire
+    registry defaults to the scanned module whose path is
+    ``dynamo_tpu/runtime/wire.py``; pass ``schemas``/``const_map``
+    explicitly for fixture trees."""
+    out: List[Violation] = []
+    wire_ms = next((m for m in sources if m.path == WIRE_MODULE_REL), None)
+    if schemas is None and wire_ms is not None:
+        schemas, const_map, bad = load_wire_schemas(wire_ms)
+        out.extend(bad)
+    graph = CallGraph.build(sources)
+    out.extend(check_transitive_blocking(graph, dl008_depth))
+    if schemas:
+        decode_reads: Set[Tuple[str, str]] = set()
+        anchored: Set[str] = set()
+        for ms in sources:
+            scan = _WireScan(ms, schemas, const_map or {
+                s.const: s.name for s in schemas.values()})
+            scan.visit(ms.tree)
+            out.extend(scan.violations)
+            decode_reads |= scan.decode_reads
+            anchored |= scan.decode_anchored_frames
+        wire_path = wire_ms.path if wire_ms is not None else WIRE_MODULE_REL
+        wire_suppr = wire_ms.suppressed if wire_ms is not None else {}
+        out.extend(_check_required_never_read(
+            schemas, wire_path, decode_reads, anchored, wire_suppr))
+    out.sort(key=lambda v: (v.path, v.line, v.code))
+    return out
+
+
+def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
+                 dl008_depth: int = DEFAULT_DL008_DEPTH) -> List[Violation]:
+    """Per-file rules + whole-program dynaflow rules over one tree; the
+    shared parse cache means each file is read and parsed exactly once
+    per run."""
+    from .analyzer import analyze_module
+
+    sources = load_sources(paths, root=root)
+    out: List[Violation] = []
+    for ms in sources:
+        out.extend(analyze_module(ms))
+    # unparseable files: analyze_paths-style DL000s come from the per-file
+    # entry; load_sources skipped them, so re-walk for syntax errors
+    import ast as _ast
+
+    from .analyzer import iter_py_files
+
+    loaded = {m.abspath for m in sources}
+    root_abs = os.path.abspath(root or os.getcwd())
+    for f in iter_py_files(paths):
+        ab = os.path.abspath(f)
+        if ab in loaded:
+            continue
+        rel = os.path.relpath(ab, root_abs) \
+            if ab.startswith(root_abs + os.sep) else f
+        try:
+            with open(ab, encoding="utf-8") as fh:
+                _ast.parse(fh.read(), filename=rel)
+        except SyntaxError as e:
+            out.append(Violation(rel.replace(os.sep, "/"), e.lineno or 0, 0,
+                                 "DL000", "syntax-error", str(e),
+                                 "<module>"))
+    out.extend(analyze_project(sources, dl008_depth=dl008_depth))
+    out.sort(key=lambda v: (v.path, v.line, v.code))
+    return out
