@@ -1,0 +1,26 @@
+"""dynafleet — deterministic fleet-scale serving simulator.
+
+Runs the real distributed serving stack (HTTP frontend, KV router,
+metrics aggregator, planner) against scripted workers on a virtual
+clock, closes the planner's advisory loop with an in-process fleet
+controller, injects faults, and scores SLOs into a reproducible JSON
+report. See docs/fleet_sim.md.
+"""
+
+from .clock import VirtualClock
+from .controller import FleetController
+from .harness import FleetSim, run_scenario
+from .report import RequestRecord, SloScorer, SloTargets, percentile
+from .scenarios import SCENARIOS, FaultEvent, Scenario, get_scenario
+from .traffic import (PhaseSpec, RequestSpec, TrafficTrace, burst, constant,
+                      diurnal, hot_tenant)
+from .worker import SimEngineModel, SimWorker, WorkerProfile
+
+__all__ = [
+    "VirtualClock", "FleetController", "FleetSim", "run_scenario",
+    "RequestRecord", "SloScorer", "SloTargets", "percentile",
+    "SCENARIOS", "FaultEvent", "Scenario", "get_scenario",
+    "PhaseSpec", "RequestSpec", "TrafficTrace", "burst", "constant",
+    "diurnal", "hot_tenant",
+    "SimEngineModel", "SimWorker", "WorkerProfile",
+]

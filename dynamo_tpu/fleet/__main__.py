@@ -1,0 +1,58 @@
+"""Fleet simulator CLI.
+
+    python -m dynamo_tpu.fleet --scenario burst --seed 0
+
+Prints the run's JSON report (sorted keys) to stdout; identical seeds
+render identical reports. ``DYN_FLEET_REPORT_DIR`` additionally writes
+``<scenario>-seed<seed>.json`` into that directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+
+from ..runtime.config import env_str
+from .harness import run_scenario
+from .scenarios import SCENARIOS, get_scenario
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dynamo-fleet",
+        description="deterministic fleet-scale serving simulator")
+    ap.add_argument("--scenario", default="burst",
+                    choices=sorted(SCENARIOS))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--report", default=None,
+                    help="also write the JSON report to this path")
+    ap.add_argument("--log-level", default="WARNING")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=args.log_level.upper())
+    scenario = get_scenario(args.scenario)
+    report = asyncio.run(run_scenario(scenario, args.seed))
+    text = json.dumps(report, sort_keys=True, indent=2)
+    print(text)
+
+    paths = []
+    if args.report:
+        paths.append(args.report)
+    report_dir = env_str("DYN_FLEET_REPORT_DIR")
+    if report_dir:
+        paths.append(os.path.join(
+            report_dir, f"{args.scenario}-seed{args.seed}.json"))
+    for path in paths:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text + "\n")
+        print(f"report written to {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

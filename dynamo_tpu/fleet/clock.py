@@ -1,0 +1,40 @@
+"""Virtual clock for the fleet simulator.
+
+Every time-dependent component in a simulated fleet — the planner's
+cooldown hysteresis, advisory ``at`` stamps, request latency records —
+reads the same :class:`VirtualClock` instead of wall time. The clock only
+advances when the step loop says so, which is what makes a run
+deterministic: two runs with the same seed perform the same operations at
+the same virtual instants regardless of host speed.
+
+Real async I/O (DCP round trips, HTTP, watch fanout) still happens on the
+wall clock *between* virtual instants; the harness quiesces each step
+before advancing, so wall latency never leaks into a report.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class VirtualClock:
+    """A manually-advanced clock. ``now()`` is a drop-in for both
+    ``time.monotonic`` and ``time.time`` hooks (the simulated epoch starts
+    at 0.0)."""
+
+    def __init__(self, step_seconds: float = 1.0):
+        self.step_seconds = step_seconds
+        self._now = 0.0
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: Optional[float] = None) -> float:
+        """Advance by ``dt`` virtual seconds (default: one step)."""
+        self._now += self.step_seconds if dt is None else dt
+        return self._now
+
+    @property
+    def step(self) -> int:
+        """The current step index (``now / step_seconds``)."""
+        return int(round(self._now / self.step_seconds))

@@ -1,0 +1,164 @@
+"""In-process fleet controller: the actuator that closes the planner loop.
+
+The planner publishes every :class:`ScaleAdvisory` on
+``<ns>.planner.advisory`` and (with ``--apply``) edits the stored
+deployment spec — but nothing in-process ever *acted* on an advisory
+before. This controller subscribes to the advisory subject and actually
+converges the worker pool: scale-up spawns fresh :class:`SimWorker`
+instances (each on its own runtime/lease), scale-down drains the
+newest workers first and retires them once idle.
+
+Safety mirrors of the planner's own rules:
+
+- an advisory with ``current_replicas == 0`` is **ignored** — zero
+  observed is ambiguous between "scaled to zero" and "scrape blackout",
+  and acting on it would tear down a live-but-unobservable pool
+  (planner/policy.py documents the same never-apply rule);
+- the pool is hard-capped by ``DYN_FLEET_MAX_WORKERS`` no matter what
+  the advisory asks for.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from ..planner.policy import PLANNER_ADVISORY_SUBJECT
+from ..runtime.config import env_int
+from ..runtime.dcp_client import unpack
+from ..runtime.runtime import DistributedRuntime
+from .worker import SimWorker
+
+log = logging.getLogger("dynamo_tpu.fleet.controller")
+
+# worker_factory(name) -> started SimWorker
+WorkerFactory = Callable[[str], Awaitable[SimWorker]]
+
+
+class FleetController:
+    """Subscribes to planner advisories and spawns/retires SimWorkers."""
+
+    def __init__(self, drt: DistributedRuntime, namespace: str,
+                 component: str, worker_factory: WorkerFactory,
+                 max_workers: Optional[int] = None):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.worker_factory = worker_factory
+        self.max_workers = max_workers if max_workers is not None \
+            else (env_int("DYN_FLEET_MAX_WORKERS") or 64)
+        self.workers: Dict[str, SimWorker] = {}     # name -> live worker
+        self.retired: List[SimWorker] = []          # kept for teardown
+        self.advisories_seen: List[dict] = []       # raw bus payloads
+        self._acted = 0                             # advisories consumed
+        self._spawned = 0                           # name counter
+        self._sid: Optional[int] = None
+
+    async def start(self) -> None:
+        self._sid = await self.drt.dcp.subscribe(
+            f"{self.namespace}.{PLANNER_ADVISORY_SUBJECT}", self._on_adv)
+
+    async def stop(self) -> None:
+        if self._sid is not None:
+            try:
+                await self.drt.dcp.unsubscribe(self._sid)
+            except Exception:
+                log.debug("unsubscribe failed during stop", exc_info=True)
+            self._sid = None
+
+    async def _on_adv(self, msg) -> None:
+        try:
+            self.advisories_seen.append(unpack(msg.payload))
+        except Exception:
+            log.exception("bad advisory payload")
+
+    # ---------------------------------------------------------- actuation
+
+    @property
+    def live(self) -> List[SimWorker]:
+        """Healthy, non-draining workers, in spawn order."""
+        return [w for w in self.workers.values()
+                if not w.model.crashed and not w.draining]
+
+    async def wait_advisories(self, expected: int,
+                              timeout: float = 5.0) -> None:
+        """Wait (wall-bounded) for the pub/sub fanout to deliver
+        ``expected`` advisories to this subscriber."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while (len(self.advisories_seen) < expected
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.005)
+
+    async def reconcile(self) -> List[dict]:
+        """Act on advisories received since the last call. Returns a list
+        of action dicts (for the scorer's actuation timeline)."""
+        actions: List[dict] = []
+        while self._acted < len(self.advisories_seen):
+            adv = self.advisories_seen[self._acted]
+            self._acted += 1
+            if adv.get("component") != self.component:
+                continue
+            if int(adv.get("current_replicas", 0)) <= 0:
+                # zero-observed: never actuate (scrape blackout vs real
+                # scale-to-zero is indistinguishable here)
+                log.info("ignoring zero-observed advisory for %s",
+                         self.component)
+                actions.append({"action": "ignored-zero-observed",
+                                "desired": int(adv["desired_replicas"]),
+                                "workers": []})
+                continue
+            desired = min(int(adv["desired_replicas"]), self.max_workers)
+            live = self.live
+            if desired > len(live):
+                names = [await self._spawn()
+                         for _ in range(desired - len(live))]
+                actions.append({"action": "scale-up", "desired": desired,
+                                "workers": names})
+            elif desired < len(live):
+                names = []
+                for w in reversed(live):        # newest-first
+                    if len(self.live) <= desired:
+                        break
+                    await self._drain(w)
+                    names.append(w.name)
+                actions.append({"action": "scale-down", "desired": desired,
+                                "workers": names})
+        return actions
+
+    async def _spawn(self) -> str:
+        name = f"w{self._spawned:03d}"
+        self._spawned += 1
+        worker = await self.worker_factory(name)
+        self.workers[name] = worker
+        log.info("fleet controller spawned %s (instance %x)", name,
+                 worker.instance_id)
+        return name
+
+    async def spawn_initial(self, n: int) -> List[str]:
+        return [await self._spawn() for _ in range(n)]
+
+    async def _drain(self, worker: SimWorker) -> None:
+        await worker.drain()
+        log.info("fleet controller draining %s", worker.name)
+
+    async def retire_idle_drained(self) -> List[str]:
+        """Shut down drained workers whose in-flight work has finished."""
+        out = []
+        for name, w in list(self.workers.items()):
+            if w.draining and w.model.idle:
+                await w.stop()
+                self.retired.append(w)
+                del self.workers[name]
+                out.append(name)
+        return out
+
+    async def teardown(self) -> None:
+        await self.stop()
+        for w in list(self.workers.values()):
+            try:
+                await w.stop()
+            except Exception:
+                log.debug("worker %s teardown failed", w.name,
+                          exc_info=True)
+        self.workers.clear()

@@ -1,0 +1,418 @@
+"""The fleet simulator harness: real stack, scripted workers, stepped time.
+
+One :class:`FleetSim` run assembles the **production** serving plane
+in-process —
+
+  ``aiohttp client → HttpService → Processor (byte tokenizer) → KvRouter
+  → SimWorker endpoints`` over an embedded DCP control plane, with the
+  real :class:`MetricsAggregator` scraping stats and the real
+  :class:`Planner` deciding scale — and drives it step by step on a
+  :class:`VirtualClock`:
+
+  1. apply scripted faults due this step (crash / join / blackout),
+  2. inject this step's trace arrivals through the HTTP frontend
+     (sequentially: each request is awaited until it is enqueued at a
+     worker, so router state evolves in a fixed order),
+  3. advance every worker's service model one step (admissions, prefill,
+     token releases — all lifecycle stamps in virtual time),
+  4. scrape: aggregator then router (manual ``scrape_once``),
+  5. tick the planner (virtual clock; advisories stamped in virtual
+     time),
+  6. actuate: wait for the advisory fanout, let the fleet controller
+     spawn/drain workers, sync discovery, optionally reconcile the
+     k8s dry-run cluster,
+  7. sample fleet state for the scorer and advance the clock.
+
+After the last trace step the loop keeps stepping (no new arrivals)
+until every request has drained, then joins the HTTP client tasks and
+renders the report. Wall-clock time never enters the report, so a seeded
+run is byte-identical across hosts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Set
+
+from ..llm.http.service import HttpService
+from ..llm.kv_router.router import KvRouter
+from ..llm.model_card import ModelDeploymentCard
+from ..llm.processor import Processor
+from ..metrics.component import MetricsAggregator
+from ..planner.planner import Planner, WatchTarget
+from ..planner.policy import PLANNER_KV_PREFIX
+from ..runtime.component import Client
+from ..runtime.config import env_float
+from ..runtime.dcp_client import pack, unpack
+from ..runtime.runtime import DistributedRuntime
+from ..runtime.tasks import spawn_tracked
+from .clock import VirtualClock
+from .controller import FleetController
+from .k8s_dryrun import K8sDryRun
+from .report import SloScorer
+from .scenarios import Scenario
+from .worker import SimWorker
+
+log = logging.getLogger("dynamo_tpu.fleet")
+
+NAMESPACE = "fleetsim"
+COMPONENT = "sim"
+MODEL = "sim"
+DEPLOYMENT = "fleet-sim"
+
+
+class FleetSim:
+    """One deterministic scenario run. Use :func:`run_scenario`."""
+
+    def __init__(self, scenario: Scenario, seed: int):
+        self.scenario = scenario
+        self.seed = seed
+        self.clock = VirtualClock(scenario.step_seconds)
+        self.trace = scenario.traffic(seed)
+        self.scorer = SloScorer(self.trace, scenario.slo,
+                                scenario.step_seconds)
+        self._max_tokens = {r.rid: r.max_tokens for r in self.trace.requests}
+        self._enqueued: Dict[str, asyncio.Event] = {
+            r.rid: asyncio.Event() for r in self.trace.requests}
+        self._client_tasks: List[asyncio.Task] = []
+        self._discovery_timeout = env_float(
+            "DYN_FLEET_DISCOVERY_TIMEOUT") or 10.0
+        # wired in setup()
+        self.drt: Optional[DistributedRuntime] = None
+        self.controller: Optional[FleetController] = None
+        self.router: Optional[KvRouter] = None
+        self.agg: Optional[MetricsAggregator] = None
+        self.planner: Optional[Planner] = None
+        self.service: Optional[HttpService] = None
+        self.token_client: Optional[Client] = None
+        self._http = None
+        self._base_url = ""
+        self.k8s: Optional[K8sDryRun] = None
+        self._k8s_replicas: Optional[int] = None
+
+    # ------------------------------------------------------------- setup
+
+    async def setup(self) -> None:
+        sc = self.scenario
+        self.drt = await DistributedRuntime.detached()
+
+        self.controller = FleetController(
+            self.drt, NAMESPACE, COMPONENT, self._worker_factory)
+        await self.controller.start()
+        names = await self.controller.spawn_initial(sc.initial_workers)
+        for name in names:
+            self.scorer.worker_event(self.clock.now(), "spawn", name)
+
+        self.router = KvRouter(self.drt, NAMESPACE, COMPONENT,
+                               block_size=sc.block_size,
+                               scrape_interval=1.0, seed=self.seed)
+        await self.router.start(run_loop=False)
+
+        self.agg = MetricsAggregator(self.drt, NAMESPACE, COMPONENT)
+        await self.agg.start(run_loop=False)
+
+        self.planner = Planner(
+            self.drt, NAMESPACE,
+            [WatchTarget(component=COMPONENT,
+                         endpoint="generate_tokens",
+                         deployment=DEPLOYMENT if sc.k8s_dry_run else None,
+                         service=COMPONENT,
+                         config=sc.planner)],
+            apply=sc.k8s_dry_run,
+            clock=self.clock.now, wall_clock=self.clock.now)
+        await self.planner.start(run_loop=False)
+
+        if sc.k8s_dry_run:
+            self.k8s = K8sDryRun(DEPLOYMENT, COMPONENT)
+            cr = self.k8s.make_cr(sc.initial_workers)
+            await self.drt.dcp.kv_put(f"deployments/{DEPLOYMENT}", pack(cr))
+
+        mdc = ModelDeploymentCard(name=MODEL, tokenizer_kind="byte",
+                                  kv_block_size=sc.block_size,
+                                  model_type="completions")
+        self.token_client = await self.drt.namespace(NAMESPACE) \
+            .component(COMPONENT).endpoint("generate_tokens").client()
+        processor = Processor(mdc, self.token_client, self.router)
+
+        self.service = HttpService()
+        self.service.manager.add_completions_model(MODEL,
+                                                   processor.completion)
+        await self.service.start(host="127.0.0.1", port=0)
+        self._base_url = f"http://127.0.0.1:{self.service.port}"
+
+        import aiohttp
+
+        self._http = aiohttp.ClientSession()
+
+        await self._sync_discovery()
+        # warm the scheduler/aggregator view before the first arrivals
+        await self._scrape()
+
+    async def _worker_factory(self, name: str) -> SimWorker:
+        drt = await DistributedRuntime.attach(self.drt.dcp.address)
+        worker = SimWorker(
+            drt, NAMESPACE, COMPONENT, name, self.scenario.profile,
+            self.scenario.block_size, self.clock.now,
+            lambda rid, ev, vt, n=name: self._lifecycle(n, rid, ev, vt))
+        await worker.start()
+        return worker
+
+    # --------------------------------------------------------- lifecycle
+
+    def _lifecycle(self, worker: str, rid: str, event: str,
+                   vt: float) -> None:
+        rec = self.scorer.record(rid)
+        if rec is None:
+            return
+        if event == "enqueued":
+            rec.worker = worker
+            rec.arrival_vt = vt
+            ev = self._enqueued.get(rid)
+            if ev is not None:
+                ev.set()
+        elif event == "admitted":
+            rec.admitted_vt = vt
+        elif event == "first_token":
+            rec.first_token_vt = vt
+        elif event == "done":
+            rec.done_vt = vt
+            rec.tokens_out = self._max_tokens.get(rid, 0)
+        elif event == "crashed":
+            rec.status = "crashed"
+
+    # ------------------------------------------------------------ inject
+
+    async def _do_request(self, spec) -> None:
+        rec = self.scorer.record(spec.rid)
+        try:
+            body = {"model": MODEL, "prompt": spec.prompt,
+                    "stream": True, "max_tokens": spec.max_tokens}
+            async with self._http.post(
+                    f"{self._base_url}/v1/completions", json=body,
+                    headers={"X-Request-Id": spec.rid}) as resp:
+                rec.http_status = resp.status
+                if resp.status != 200:
+                    rec.status = "failed"
+                    return
+                errored = False
+                async for raw in resp.content:
+                    line = raw.strip()
+                    if line.startswith(b"event: error"):
+                        errored = True
+                    elif line == b"data: [DONE]":
+                        break
+                if rec.status == "pending":
+                    rec.status = "failed" if errored else "ok"
+        except Exception:
+            log.debug("client request %s failed", spec.rid, exc_info=True)
+            if rec.status == "pending":
+                rec.status = "failed"
+
+    async def _inject(self, step: int) -> None:
+        for spec in self.trace.at(step):
+            task = spawn_tracked(self._do_request(spec),
+                                 name=f"fleet-req-{spec.rid}")
+            self._client_tasks.append(task)
+            # sequential admission: wait until the request is enqueued at
+            # a worker (or failed fast) before injecting the next one, so
+            # router decisions replay in a fixed order
+            ev = self._enqueued[spec.rid]
+            waiter = spawn_tracked(ev.wait(),
+                                   name=f"fleet-enq-{spec.rid}")
+            done, _pending = await asyncio.wait(
+                {task, waiter}, timeout=self._discovery_timeout,
+                return_when=asyncio.FIRST_COMPLETED)
+            waiter.cancel()
+            if not done:
+                raise RuntimeError(
+                    f"request {spec.rid} neither enqueued nor failed "
+                    f"within {self._discovery_timeout}s — sim wedged")
+
+    # ----------------------------------------------------------- helpers
+
+    def _workers_in_order(self) -> List[SimWorker]:
+        return list(self.controller.workers.values())
+
+    async def _advance_workers(self) -> None:
+        for worker in self._workers_in_order():
+            events = worker.model.step()
+            if events and not worker.draining:
+                await worker.publish_kv_events(events)
+        retired = await self.controller.retire_idle_drained()
+        for name in retired:
+            self.scorer.worker_event(self.clock.now(), "removed", name)
+        # let woken handlers push their token frames down the wire
+        await asyncio.sleep(0)
+
+    async def _scrape(self) -> None:
+        try:
+            await self.agg.scrape_once()
+        except Exception:
+            log.exception("aggregator scrape failed")
+        try:
+            await self.router.scrape_once()
+        except Exception:
+            log.exception("router scrape failed")
+
+    async def _actuate(self) -> None:
+        await self.controller.wait_advisories(len(self.planner.advisories))
+        actions = await self.controller.reconcile()
+        vt = self.clock.now()
+        for act in actions:
+            self.scorer.actuation(vt, act["action"], act["desired"],
+                                  act["workers"])
+            for name in act["workers"]:
+                if act["action"] == "scale-up":
+                    self.scorer.worker_event(vt, "spawn", name)
+                elif act["action"] == "scale-down":
+                    self.scorer.worker_event(vt, "drain", name)
+        if actions:
+            await self._sync_discovery()
+        if self.k8s is not None:
+            raw = await self.drt.dcp.kv_get(f"deployments/{DEPLOYMENT}")
+            if raw is not None:
+                replicas = self.k8s.reconcile(unpack(raw))
+                if replicas is not None:
+                    self._k8s_replicas = replicas
+
+    def _observers(self) -> List[Client]:
+        obs = [self.token_client, self.router.client, self.agg._client]
+        obs.extend(self.planner._clients.values())
+        return [c for c in obs if c is not None]
+
+    async def _sync_discovery(self) -> None:
+        """Block (wall-bounded) until every client's discovery view shows
+        the live workers and has dropped the drained ones."""
+        present: Set[int] = {w.instance_id for w in self.controller.live}
+        absent: Set[int] = {
+            w.instance_id for w in self.controller.workers.values()
+            if w.draining}
+        absent |= {w.instance_id for w in self.controller.retired}
+        deadline = asyncio.get_running_loop().time() \
+            + self._discovery_timeout
+        while asyncio.get_running_loop().time() < deadline:
+            views = [set(c.instances) for c in self._observers()]
+            if all(present <= v and not (absent & v) for v in views):
+                return
+            await asyncio.sleep(0.005)
+        raise RuntimeError("discovery views did not converge "
+                           f"(want +{present} -{absent})")
+
+    async def _apply_faults(self, step: int) -> None:
+        for fault in [f for f in self.scenario.faults if f.step == step]:
+            vt = self.clock.now()
+            if fault.kind == "crash":
+                live = self.controller.live
+                if live:
+                    worker = live[min(fault.arg, len(live) - 1)]
+                    await worker.crash()
+                    self.scorer.worker_event(vt, "crash", worker.name)
+            elif fault.kind == "join":
+                name = await self.controller._spawn()
+                self.scorer.worker_event(vt, "join", name)
+                await self._sync_discovery()
+            elif fault.kind == "blackout_start":
+                for worker in self.controller.live:
+                    worker.set_blackout(True)
+                self.scorer.worker_event(vt, "blackout_start", "*")
+            elif fault.kind == "blackout_end":
+                for worker in self.controller.live:
+                    worker.set_blackout(False)
+                self.scorer.worker_event(vt, "blackout_end", "*")
+
+    def _fleet_sample(self) -> None:
+        waiting = sum(len(w.model.queue)
+                      for w in self._workers_in_order())
+        active = sum(len(w.model.active)
+                     for w in self._workers_in_order())
+        self.scorer.sample_step(self.clock.now(), waiting, active,
+                                len(self.controller.live))
+
+    # -------------------------------------------------------------- run
+
+    async def _step(self, step: int, *, inject: bool = True) -> None:
+        await self._apply_faults(step)
+        if inject:
+            await self._inject(step)
+        await self._advance_workers()
+        await self._scrape()
+        await self.planner.tick()
+        await self._actuate()
+        self._fleet_sample()
+        self.clock.advance()
+
+    async def run(self) -> dict:
+        sc = self.scenario
+        await self.setup()
+        try:
+            for step in range(sc.steps):
+                await self._step(step)
+            # drain: no arrivals, keep stepping until all requests settle
+            for extra in range(sc.drain_steps):
+                if self._drained():
+                    break
+                await self._step(sc.steps + extra, inject=False)
+            await self._join_clients()
+            return await self._report()
+        finally:
+            await self.teardown()
+
+    def _drained(self) -> bool:
+        return all(r.status != "pending" or r.done_vt is not None
+                   for r in self.scorer.records.values())
+
+    async def _join_clients(self) -> None:
+        if self._client_tasks:
+            await asyncio.wait(self._client_tasks, timeout=30.0)
+
+    async def _report(self) -> dict:
+        advisories = [a.to_dict() for a in self.planner.advisories]
+        stored = await self.drt.dcp.kv_get_prefix(PLANNER_KV_PREFIX)
+        extra = {
+            "router": self.router.stats(),
+            "stats_evictions": {
+                "aggregator": self.agg._client.evicted_ids(),
+                "router": self.router.client.evicted_ids(),
+            },
+            "advisories_in_kv": len(stored),
+        }
+        if self.k8s is not None:
+            extra["k8s_dry_run"] = {
+                "deployment_replicas": self._k8s_replicas,
+                "objects": sorted(f"{k}/{n}" for (k, _ns, n)
+                                  in self.k8s.kube.store),
+            }
+        return self.scorer.report(
+            scenario=self.scenario.name, seed=self.seed,
+            steps=self.scenario.steps, advisories=advisories,
+            disturb_end_step=self.scenario.disturb_end_step, extra=extra)
+
+    async def teardown(self) -> None:
+        if self._http is not None:
+            await self._http.close()
+        for task in self._client_tasks:
+            task.cancel()
+        if self.service is not None:
+            await self.service.stop()
+        if self.planner is not None:
+            await self.planner.stop()
+        if self.agg is not None:
+            await self.agg.stop()
+        if self.router is not None:
+            await self.router.stop()
+        if self.token_client is not None:
+            await self.token_client.close()
+        if self.controller is not None:
+            await self.controller.teardown()
+            for w in self.controller.retired:
+                # runtimes of drained workers were already shut down in
+                # retire_idle_drained; nothing further
+                pass
+        if self.drt is not None:
+            await self.drt.shutdown()
+
+
+async def run_scenario(scenario: Scenario, seed: int) -> dict:
+    """Run one scenario to completion and return its report dict."""
+    return await FleetSim(scenario, seed).run()

@@ -1,0 +1,125 @@
+"""Dry-run Kubernetes actuation for fleet scenarios.
+
+The planner's ``--apply`` path edits the stored deployment spec
+(``deployments/<name>`` in the control-plane KV); in a real cluster the
+operator's reconcile loop (k8s/controller.py) converges Deployments to
+that spec. A fleet scenario with ``k8s_dry_run`` closes that half of the
+loop too, against an in-memory cluster: after each actuation the harness
+reads the stored spec back, presents it as a DynamoDeployment CR, and
+runs the *real* :class:`~dynamo_tpu.k8s.controller.Reconciler` over a
+:class:`DryRunKube`. The report then shows the replica count a real
+cluster would have converged to — decided by the planner, rendered by
+render.py, actuated by the reconcile controller, all without an
+apiserver.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..k8s.controller import Reconciler
+
+
+class DryRunKube:
+    """In-memory KubeClient: (kind, ns, name) → object, with label
+    selectors — enough surface for the reconcile controller."""
+
+    def __init__(self) -> None:
+        self.store: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        self.actions: List[Tuple[str, str]] = []   # (verb, kind/name)
+
+    @staticmethod
+    def _sel_match(obj: Dict[str, Any], sel: Optional[str]) -> bool:
+        if not sel:
+            return True
+        labels = obj.get("metadata", {}).get("labels", {})
+        for part in sel.split(","):
+            k, v = part.split("=", 1)
+            if labels.get(k) != v:
+                return False
+        return True
+
+    def list(self, kind: str, namespace: str,
+             label_selector: Optional[str] = None) -> List[Dict[str, Any]]:
+        return [copy.deepcopy(o) for (k, ns, _), o in self.store.items()
+                if k == kind and ns == namespace
+                and self._sel_match(o, label_selector)]
+
+    def get(self, kind: str, namespace: str,
+            name: str) -> Optional[Dict[str, Any]]:
+        o = self.store.get((kind, namespace, name))
+        return copy.deepcopy(o) if o else None
+
+    def create(self, kind: str, namespace: str,
+               obj: Dict[str, Any]) -> Dict[str, Any]:
+        obj = copy.deepcopy(obj)
+        obj.setdefault("metadata", {})["resourceVersion"] = "1"
+        name = obj["metadata"]["name"]
+        self.store[(kind, namespace, name)] = obj
+        self.actions.append(("create", f"{kind}/{name}"))
+        return obj
+
+    def replace(self, kind: str, namespace: str, name: str,
+                obj: Dict[str, Any]) -> Dict[str, Any]:
+        cur = self.store[(kind, namespace, name)]
+        obj = copy.deepcopy(obj)
+        obj["metadata"]["resourceVersion"] = str(
+            int(cur["metadata"].get("resourceVersion", "0")) + 1)
+        self.store[(kind, namespace, name)] = obj
+        self.actions.append(("replace", f"{kind}/{name}"))
+        return obj
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self.store.pop((kind, namespace, name), None)
+        self.actions.append(("delete", f"{kind}/{name}"))
+
+    def update_status(self, kind: str, namespace: str, name: str,
+                      status: Dict[str, Any]) -> None:
+        if (kind, namespace, name) in self.store:
+            self.store[(kind, namespace, name)]["status"] = status
+
+
+class K8sDryRun:
+    """Reconciles the planner-edited stored spec into the fake cluster."""
+
+    def __init__(self, deployment_name: str, service: str,
+                 k8s_namespace: str = "fleet-sim"):
+        self.deployment_name = deployment_name
+        self.service = service
+        self.k8s_namespace = k8s_namespace
+        self.kube = DryRunKube()
+        self.reconciler = Reconciler(self.kube)
+
+    def make_cr(self, replicas: int) -> dict:
+        """The CR seeded into the control-plane KV at scenario start."""
+        return {
+            "apiVersion": "dynamo-tpu.dev/v1alpha1",
+            "kind": "DynamoDeployment",
+            "metadata": {"name": self.deployment_name,
+                         "namespace": self.k8s_namespace,
+                         "uid": "fleet-sim-uid"},
+            "spec": {"graph": "examples.llm.graphs.agg:Frontend",
+                     "services": {self.service: {"replicas": replicas}}},
+        }
+
+    def reconcile(self, stored_spec: dict) -> Optional[int]:
+        """Run the real reconcile controller over the (planner-edited)
+        stored CR; returns the converged Deployment replica count."""
+        cr = copy.deepcopy(stored_spec)
+        cr.setdefault("kind", "DynamoDeployment")
+        cr.setdefault("metadata", {}).setdefault(
+            "namespace", self.k8s_namespace)
+        key = ("DynamoDeployment", self.k8s_namespace,
+               cr["metadata"]["name"])
+        if key in self.kube.store:
+            self.kube.store[key]["spec"] = copy.deepcopy(cr["spec"])
+        else:
+            self.kube.create("DynamoDeployment", self.k8s_namespace, cr)
+        self.reconciler.reconcile_all(self.k8s_namespace)
+        dep = self.kube.get(
+            "Deployment", self.k8s_namespace,
+            f"{self.deployment_name}-{self.service}")
+        if dep is None:
+            return None
+        return (dep.get("spec") or {}).get("replicas")

@@ -1,0 +1,207 @@
+"""SLO scorer + JSON report for fleet-simulator runs.
+
+All scored quantities are **virtual-time** values (request lifecycle
+stamps written by the worker model at step boundaries) or counters —
+never wall-clock measurements — so a seeded run renders byte-identical
+JSON on any host. The report carries:
+
+- per-phase latency percentiles (TTFT, queue wait) + throughput,
+- the advisory timeline (planner decisions) and the actuation timeline
+  (what the fleet controller actually did about them),
+- the worker timeline (spawn / drain / remove / crash / join),
+- SLO verdicts: post-recovery percentile targets and time-to-recover
+  after the burst/fault window.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .traffic import TrafficTrace
+
+
+@dataclass
+class RequestRecord:
+    """Lifecycle of one simulated request (virtual-time stamps)."""
+
+    rid: str
+    step: int                       # scheduled arrival step
+    tenant: str = "default"
+    worker: Optional[str] = None    # serving worker name
+    arrival_vt: Optional[float] = None   # enqueued at the worker
+    admitted_vt: Optional[float] = None  # entered a service slot
+    first_token_vt: Optional[float] = None
+    done_vt: Optional[float] = None
+    tokens_out: int = 0
+    status: str = "pending"         # pending | ok | failed | crashed
+    http_status: Optional[int] = None
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        if self.arrival_vt is None or self.admitted_vt is None:
+            return None
+        return self.admitted_vt - self.arrival_vt
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.arrival_vt is None or self.first_token_vt is None:
+            return None
+        return self.first_token_vt - self.arrival_vt
+
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Deterministic nearest-rank percentile (q in [0, 100])."""
+    if not values:
+        return None
+    vs = sorted(values)
+    rank = max(int(math.ceil(q / 100.0 * len(vs))), 1)
+    return vs[rank - 1]
+
+
+@dataclass
+class SloTargets:
+    """Per-scenario service-level objectives, in virtual seconds."""
+
+    ttft_p95: float = 3.0
+    queue_wait_p95: float = 2.0
+    # queue must stay drained this many consecutive steps to count as
+    # recovered after the disturbance window
+    recovery_settle_steps: int = 2
+
+    def to_dict(self) -> dict:
+        return {"ttft_p95_s": self.ttft_p95,
+                "queue_wait_p95_s": self.queue_wait_p95,
+                "recovery_settle_steps": self.recovery_settle_steps}
+
+
+class SloScorer:
+    """Accumulates per-step fleet samples + request records and renders
+    the final report dict."""
+
+    def __init__(self, trace: TrafficTrace, slo: SloTargets,
+                 step_seconds: float):
+        self.trace = trace
+        self.slo = slo
+        self.step_seconds = step_seconds
+        self.records: Dict[str, RequestRecord] = {
+            r.rid: RequestRecord(rid=r.rid, step=r.step, tenant=r.tenant)
+            for r in trace.requests}
+        # per-step samples: (vt, waiting_total, active_total, workers_live)
+        self.step_samples: List[dict] = []
+        self.worker_events: List[dict] = []     # spawn/drain/remove/crash
+        self.actuations: List[dict] = []        # controller actions
+
+    # ------------------------------------------------------------ intake
+
+    def record(self, rid: str) -> Optional[RequestRecord]:
+        return self.records.get(rid)
+
+    def sample_step(self, vt: float, waiting: int, active: int,
+                    live_workers: int) -> None:
+        self.step_samples.append({"vt": vt, "waiting": waiting,
+                                  "active": active,
+                                  "workers": live_workers})
+
+    def worker_event(self, vt: float, event: str, worker: str) -> None:
+        self.worker_events.append({"vt": vt, "event": event,
+                                   "worker": worker})
+
+    def actuation(self, vt: float, action: str, desired: int,
+                  workers: List[str]) -> None:
+        self.actuations.append({"vt": vt, "action": action,
+                                "desired": desired, "workers": workers})
+
+    # ----------------------------------------------------------- scoring
+
+    def _phase_rows(self) -> Dict[str, dict]:
+        rows: Dict[str, dict] = {}
+        for phase in self.trace.phases:
+            recs = [r for r in self.records.values()
+                    if phase.contains(r.step)]
+            ttfts = [r.ttft for r in recs if r.ttft is not None]
+            waits = [r.queue_wait for r in recs
+                     if r.queue_wait is not None]
+            done = [r for r in recs if r.status == "ok"]
+            toks = sum(r.tokens_out for r in recs)
+            span_s = max((phase.end - phase.start) * self.step_seconds,
+                         self.step_seconds)
+            rows[phase.name] = {
+                "requests": len(recs),
+                "completed": len(done),
+                "failed": len([r for r in recs
+                               if r.status in ("failed", "crashed")]),
+                "ttft_p50_s": percentile(ttfts, 50),
+                "ttft_p95_s": percentile(ttfts, 95),
+                "queue_wait_p50_s": percentile(waits, 50),
+                "queue_wait_p95_s": percentile(waits, 95),
+                "tokens_out": toks,
+                "throughput_tok_per_s": round(toks / span_s, 4),
+            }
+        return rows
+
+    def _recovery(self, disturb_end_step: Optional[int]) -> dict:
+        """Time from the end of the disturbance window (burst end / crash)
+        to the first sustained drained-queue sample."""
+        if disturb_end_step is None:
+            return {"time_to_recover_s": None, "recovered_at_s": None}
+        settle = self.slo.recovery_settle_steps
+        end_vt = disturb_end_step * self.step_seconds
+        streak = 0
+        for s in self.step_samples:
+            if s["vt"] < end_vt:
+                continue
+            streak = streak + 1 if s["waiting"] == 0 else 0
+            if streak >= settle:
+                recovered = s["vt"] - (settle - 1) * self.step_seconds
+                return {"time_to_recover_s": round(recovered - end_vt, 6),
+                        "recovered_at_s": recovered}
+        return {"time_to_recover_s": None, "recovered_at_s": None}
+
+    def report(self, *, scenario: str, seed: int, steps: int,
+               advisories: List[dict],
+               disturb_end_step: Optional[int] = None,
+               extra: Optional[dict] = None) -> dict:
+        phases = self._phase_rows()
+        recovery = self._recovery(disturb_end_step)
+        # SLO verdict on the phase AFTER the disturbance (or the last
+        # phase for steady scenarios)
+        final_phase = self.trace.phases[-1].name
+        fin = phases.get(final_phase, {})
+        slo_met = (
+            fin.get("ttft_p95_s") is not None
+            and fin["ttft_p95_s"] <= self.slo.ttft_p95
+            and (fin.get("queue_wait_p95_s") or 0.0)
+            <= self.slo.queue_wait_p95)
+        recs = self.records.values()
+        report = {
+            "scenario": scenario,
+            "seed": seed,
+            "steps": steps,
+            "step_seconds": self.step_seconds,
+            "requests": {
+                "total": len(self.records),
+                "completed": len([r for r in recs if r.status == "ok"]),
+                "failed": len([r for r in recs
+                               if r.status in ("failed", "crashed")]),
+                "tokens_out": sum(r.tokens_out for r in recs),
+            },
+            "phases": phases,
+            "advisories": advisories,
+            "actuations": self.actuations,
+            "workers": {
+                "timeline": self.worker_events,
+                "peak_live": max((s["workers"] for s in self.step_samples),
+                                 default=0),
+            },
+            "slo": {
+                "targets": self.slo.to_dict(),
+                "final_phase": final_phase,
+                "met": bool(slo_met),
+                **recovery,
+            },
+        }
+        if extra:
+            report.update(extra)
+        return report

@@ -1,0 +1,197 @@
+"""Scenario registry for the fleet simulator.
+
+A :class:`Scenario` is the complete, seed-independent *shape* of a run:
+fleet sizing, worker service profile, traffic curve factory, planner
+thresholds, scripted faults, and SLO targets. ``seed`` is supplied at run
+time (`python -m dynamo_tpu.fleet --scenario burst --seed 0`) and only
+affects the materialized trace + router tie-breaking — same seed, same
+report, byte for byte.
+
+Adding a scenario: build a :class:`Scenario` and register it in
+:data:`SCENARIOS` (docs/fleet_sim.md walks through an example).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..planner.policy import PlannerConfig
+from .report import SloTargets
+from .traffic import TrafficTrace, burst, constant, diurnal, hot_tenant
+from .worker import WorkerProfile
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scripted fault, applied at the start of ``step``.
+
+    kinds: ``crash`` (crash the ``arg``-th live worker, mid-stream),
+    ``join`` (spawn one extra worker outside the planner loop — delayed
+    join), ``blackout_start`` / ``blackout_end`` (all live workers stop /
+    resume answering stats scrapes)."""
+
+    step: int
+    kind: str
+    arg: int = 0
+
+
+@dataclass
+class Scenario:
+    name: str
+    steps: int
+    traffic: Callable[[int], TrafficTrace]   # seed -> trace
+    initial_workers: int = 2
+    step_seconds: float = 1.0
+    profile: WorkerProfile = field(default_factory=WorkerProfile)
+    planner: PlannerConfig = field(default_factory=lambda: PlannerConfig(
+        min_replicas=1, max_replicas=6,
+        waiting_per_worker_high=2.0,
+        scale_up_cooldown_s=8.0, scale_down_cooldown_s=30.0))
+    slo: SloTargets = field(default_factory=SloTargets)
+    faults: List[FaultEvent] = field(default_factory=list)
+    block_size: int = 16
+    # step index the "disturbance" (burst / crash window) ends at, for
+    # time-to-recover scoring; None = no disturbance
+    disturb_end_step: Optional[int] = None
+    # close the loop through the k8s reconcile controller in dry-run too
+    k8s_dry_run: bool = False
+    # extra virtual steps granted after the last arrival to drain queues
+    drain_steps: int = 40
+
+
+def _smoke() -> Scenario:
+    """Tier-1 smoke: a small burst that must trigger a scale-up and
+    recover — the closed-loop regression gate."""
+    steps = 26
+    return Scenario(
+        name="smoke", steps=steps,
+        traffic=lambda seed: burst(seed, steps=steps, base_rate=1.0,
+                                   burst_rate=6.0, burst_start=6,
+                                   burst_end=12, max_tokens=12),
+        initial_workers=2,
+        profile=WorkerProfile(slots=3, tokens_per_step=6),
+        planner=PlannerConfig(min_replicas=2, max_replicas=4,
+                              waiting_per_worker_high=2.0,
+                              scale_up_cooldown_s=6.0,
+                              scale_down_cooldown_s=60.0),
+        slo=SloTargets(ttft_p95=4.0, queue_wait_p95=3.0),
+        disturb_end_step=12,
+        k8s_dry_run=True,
+    )
+
+
+def _burst() -> Scenario:
+    steps = 48
+    return Scenario(
+        name="burst", steps=steps,
+        traffic=lambda seed: burst(seed, steps=steps, base_rate=2.0,
+                                   burst_rate=8.0, burst_start=10,
+                                   burst_end=22, max_tokens=16),
+        initial_workers=2,
+        planner=PlannerConfig(min_replicas=2, max_replicas=6,
+                              waiting_per_worker_high=2.0,
+                              scale_up_cooldown_s=8.0,
+                              scale_down_cooldown_s=20.0,
+                              cache_low_water=0.95),
+        slo=SloTargets(ttft_p95=4.0, queue_wait_p95=3.0),
+        disturb_end_step=22,
+        k8s_dry_run=True,
+    )
+
+
+def _diurnal() -> Scenario:
+    steps = 72
+    return Scenario(
+        name="diurnal", steps=steps,
+        traffic=lambda seed: diurnal(seed, steps=steps, low_rate=1.0,
+                                     peak_rate=7.0, max_tokens=16),
+        initial_workers=2,
+        planner=PlannerConfig(min_replicas=2, max_replicas=8,
+                              waiting_per_worker_high=2.0,
+                              scale_up_cooldown_s=8.0,
+                              scale_down_cooldown_s=16.0,
+                              cache_low_water=0.95),
+        slo=SloTargets(ttft_p95=5.0, queue_wait_p95=4.0),
+    )
+
+
+def _hot_tenant() -> Scenario:
+    steps = 40
+    return Scenario(
+        name="hot-tenant", steps=steps,
+        traffic=lambda seed: hot_tenant(seed, steps=steps, rate=3.0,
+                                        hot_share=0.75, prefix_words=64,
+                                        max_tokens=12),
+        initial_workers=3,
+        slo=SloTargets(ttft_p95=4.0, queue_wait_p95=3.0),
+    )
+
+
+def _crash() -> Scenario:
+    """Worker crash mid-stream under steady load: streams fail fast, the
+    stale endpoint is evicted, the planner re-scales, SLO recovers."""
+    steps = 36
+    return Scenario(
+        name="crash", steps=steps,
+        traffic=lambda seed: constant(seed, steps=steps, rate=5.0,
+                                      max_tokens=12),
+        initial_workers=3,
+        planner=PlannerConfig(min_replicas=2, max_replicas=6,
+                              waiting_per_worker_high=2.0,
+                              scale_up_cooldown_s=6.0,
+                              scale_down_cooldown_s=60.0),
+        faults=[FaultEvent(step=10, kind="crash", arg=0)],
+        slo=SloTargets(ttft_p95=4.0, queue_wait_p95=3.0),
+        disturb_end_step=10,
+    )
+
+
+def _blackout() -> Scenario:
+    """Scrape blackout: every worker stops answering stats for a window.
+    The planner's zero-observed guard must hold the fleet steady (no
+    scale-down applied, no controller action) and advisories must resume
+    after the blackout."""
+    steps = 30
+    return Scenario(
+        name="blackout", steps=steps,
+        traffic=lambda seed: constant(seed, steps=steps, rate=2.0,
+                                      max_tokens=12),
+        initial_workers=3,
+        faults=[FaultEvent(step=8, kind="blackout_start"),
+                FaultEvent(step=14, kind="blackout_end")],
+        slo=SloTargets(ttft_p95=4.0, queue_wait_p95=3.0),
+    )
+
+
+def _join() -> Scenario:
+    """Delayed join: an out-of-band worker joins mid-run and must start
+    taking routed traffic."""
+    steps = 30
+    return Scenario(
+        name="join", steps=steps,
+        traffic=lambda seed: constant(seed, steps=steps, rate=3.0,
+                                      max_tokens=12),
+        initial_workers=2,
+        faults=[FaultEvent(step=8, kind="join")],
+        slo=SloTargets(ttft_p95=4.0, queue_wait_p95=3.0),
+    )
+
+
+SCENARIOS: Dict[str, Callable[[], Scenario]] = {
+    "smoke": _smoke,
+    "burst": _burst,
+    "diurnal": _diurnal,
+    "hot-tenant": _hot_tenant,
+    "crash": _crash,
+    "blackout": _blackout,
+    "join": _join,
+}
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; available: {sorted(SCENARIOS)}")

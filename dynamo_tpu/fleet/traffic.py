@@ -1,0 +1,161 @@
+"""Traffic-trace layer: replayable request schedules.
+
+A :class:`TrafficTrace` is the full, pre-materialized request schedule of
+a scenario — every request with its arrival step, prompt, token budget and
+tenant — generated from a seed so the same seed always replays the same
+trace. The harness drives each request through the *real* HTTP frontend →
+processor → KV router path; nothing here knows how requests are served.
+
+Shapes (SURVEY §3.5 load patterns the planner control loop must absorb):
+
+- ``constant``   — steady arrivals, the warmup/steady-state baseline.
+- ``burst``      — constant base rate with a rectangular burst window;
+                   the canonical scale-up-then-recover scenario.
+- ``diurnal``    — a half-sine ramp up and back down across the run
+                   (compressed day/night cycle).
+- ``hot_tenant`` — a skewed tenant mix where one tenant's requests all
+                   share a long common prompt prefix (system prompt /
+                   RAG context), exercising KV-overlap routing.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+_WORDS = ("alpha bravo charlie delta echo foxtrot golf hotel india juliet "
+          "kilo lima mike november oscar papa quebec romeo sierra tango "
+          "uniform victor whiskey xray yankee zulu").split()
+
+
+@dataclass(frozen=True)
+class RequestSpec:
+    """One scheduled request."""
+
+    rid: str
+    step: int                 # arrival step index
+    prompt: str
+    max_tokens: int
+    tenant: str = "default"
+
+
+@dataclass(frozen=True)
+class PhaseSpec:
+    """A named half-open step window ``[start, end)`` for reporting."""
+
+    name: str
+    start: int
+    end: int
+
+    def contains(self, step: int) -> bool:
+        return self.start <= step < self.end
+
+
+@dataclass
+class TrafficTrace:
+    """The materialized schedule: requests sorted by (step, rid)."""
+
+    requests: List[RequestSpec]
+    phases: List[PhaseSpec]
+    seed: int
+
+    def at(self, step: int) -> List[RequestSpec]:
+        return [r for r in self.requests if r.step == step]
+
+    def phase_of(self, step: int) -> str:
+        for p in self.phases:
+            if p.contains(step):
+                return p.name
+        return "other"
+
+    @property
+    def total(self) -> int:
+        return len(self.requests)
+
+
+def _arrivals(rng: random.Random, rate: float) -> int:
+    """Integer arrivals for one step at fractional ``rate``: the integer
+    part always arrives, the remainder arrives Bernoulli(frac)."""
+    base = int(rate)
+    frac = rate - base
+    return base + (1 if frac > 0 and rng.random() < frac else 0)
+
+
+def _prompt(rng: random.Random, words: int, prefix: str = "") -> str:
+    body = " ".join(rng.choice(_WORDS) for _ in range(max(words, 1)))
+    return (prefix + " " + body) if prefix else body
+
+
+def _materialize(seed: int, rates: Sequence[float], phases: List[PhaseSpec],
+                 *, prompt_words: int = 12, max_tokens: int = 16,
+                 tenants: Optional[Dict[str, float]] = None,
+                 tenant_prefixes: Optional[Dict[str, str]] = None
+                 ) -> TrafficTrace:
+    """Turn a per-step rate curve into a concrete trace."""
+    rng = random.Random(seed)
+    tenants = tenants or {"default": 1.0}
+    names = sorted(tenants)
+    weights = [tenants[n] for n in names]
+    reqs: List[RequestSpec] = []
+    n = 0
+    for step, rate in enumerate(rates):
+        for _ in range(_arrivals(rng, rate)):
+            tenant = rng.choices(names, weights=weights)[0]
+            prefix = (tenant_prefixes or {}).get(tenant, "")
+            reqs.append(RequestSpec(
+                rid=f"r{n:05d}", step=step,
+                prompt=_prompt(rng, prompt_words, prefix),
+                max_tokens=max_tokens, tenant=tenant))
+            n += 1
+    return TrafficTrace(requests=reqs, phases=phases, seed=seed)
+
+
+# ------------------------------------------------------------ trace shapes
+
+
+def constant(seed: int, *, steps: int, rate: float,
+             max_tokens: int = 16) -> TrafficTrace:
+    return _materialize(seed, [rate] * steps,
+                        [PhaseSpec("steady", 0, steps)],
+                        max_tokens=max_tokens)
+
+
+def burst(seed: int, *, steps: int, base_rate: float, burst_rate: float,
+          burst_start: int, burst_end: int,
+          max_tokens: int = 16) -> TrafficTrace:
+    rates = [burst_rate if burst_start <= s < burst_end else base_rate
+             for s in range(steps)]
+    phases = [PhaseSpec("warmup", 0, burst_start),
+              PhaseSpec("burst", burst_start, burst_end),
+              PhaseSpec("recovery", burst_end, steps)]
+    return _materialize(seed, rates, phases, max_tokens=max_tokens)
+
+
+def diurnal(seed: int, *, steps: int, low_rate: float, peak_rate: float,
+            max_tokens: int = 16) -> TrafficTrace:
+    """Half-sine ramp: low → peak → low across the run."""
+    rates = [low_rate + (peak_rate - low_rate) *
+             math.sin(math.pi * s / max(steps - 1, 1))
+             for s in range(steps)]
+    third = steps // 3
+    phases = [PhaseSpec("ramp-up", 0, third),
+              PhaseSpec("peak", third, 2 * third),
+              PhaseSpec("ramp-down", 2 * third, steps)]
+    return _materialize(seed, rates, phases, max_tokens=max_tokens)
+
+
+def hot_tenant(seed: int, *, steps: int, rate: float,
+               hot_share: float = 0.7, prefix_words: int = 48,
+               max_tokens: int = 16) -> TrafficTrace:
+    """One hot tenant dominates arrivals and all its requests share a long
+    deterministic prompt prefix — the KV-overlap routing workload."""
+    prefix_rng = random.Random(seed ^ 0x5EED)
+    shared = " ".join(prefix_rng.choice(_WORDS)
+                      for _ in range(prefix_words))
+    return _materialize(
+        seed, [rate] * steps, [PhaseSpec("steady", 0, steps)],
+        max_tokens=max_tokens,
+        tenants={"hot": hot_share, "cold": 1.0 - hot_share},
+        tenant_prefixes={"hot": shared})

@@ -136,6 +136,11 @@ class KvIndexer:
 
     def __init__(self, block_size: int, backend: str = "auto"):
         self.block_size = block_size
+        # backend-agnostic record of workers with indexed blocks: the C++
+        # tree has no worker-enumeration API, and the router's dead-worker
+        # prune needs one (reading the Python tree's ``lookup`` dict broke
+        # every scrape pass under the native backend)
+        self._workers: set = set()
         if backend == "python":
             self.tree = RadixTree()
         else:
@@ -149,7 +154,13 @@ class KvIndexer:
         return self.tree.find_matches(hashes)
 
     def apply_event(self, ev: KvCacheEventWire) -> None:
+        self._workers.add(ev.worker_id)
         self.tree.apply_event(ev)
 
     def remove_worker(self, worker_id: int) -> None:
+        self._workers.discard(worker_id)
         self.tree.remove_worker(worker_id)
+
+    def workers(self) -> List[int]:
+        """Workers that have contributed indexed blocks (sorted)."""
+        return sorted(self._workers)

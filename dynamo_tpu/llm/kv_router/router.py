@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import Dict, Optional, Sequence
 
 from ...runtime import tracing, wire
@@ -34,14 +35,17 @@ class KvRouter:
     def __init__(self, drt: DistributedRuntime, namespace: str,
                  component: str, *, block_size: int = 64,
                  load_balance_weight: float = 0.3,
-                 scrape_interval: float = 1.0):
+                 scrape_interval: float = 1.0,
+                 seed: Optional[int] = None):
         self.drt = drt
         self.namespace = namespace
         self.component = component
         self.indexer = KvIndexer(block_size)
+        # seed: deterministic tie-breaking for simulated / replayed runs
         self.scheduler = KvScheduler(
             block_size=block_size, load_balance_weight=load_balance_weight,
-            on_hit_rate_event=self._on_hit_rate)
+            on_hit_rate_event=self._on_hit_rate,
+            rng=random.Random(seed) if seed is not None else random.Random())
         self.scrape_interval = scrape_interval
         self.client: Optional[Client] = None
         self._sid: Optional[int] = None
@@ -50,15 +54,20 @@ class KvRouter:
         self._overlap_blocks_total = 0
         self._isl_blocks_total = 0
 
-    async def start(self, endpoint: str = "generate_tokens") -> None:
+    async def start(self, endpoint: str = "generate_tokens",
+                    *, run_loop: bool = True) -> None:
+        """``run_loop=False`` skips the periodic scrape task; drivers that
+        step time themselves (the fleet simulator) call ``scrape_once``
+        directly."""
         drt = self.drt
         self.client = await drt.namespace(self.namespace) \
             .component(self.component).endpoint(endpoint).client()
         self._sid = await drt.dcp.subscribe(
             f"{self.namespace}.{self.component}.{KV_EVENT_SUBJECT}",
             self._on_events)
-        self._scrape_task = spawn_tracked(self._scrape_loop(),
-                                          name="kv-router-scrape")
+        if run_loop:
+            self._scrape_task = spawn_tracked(self._scrape_loop(),
+                                              name="kv-router-scrape")
 
     async def stop(self) -> None:
         if self._sid is not None:
@@ -105,7 +114,7 @@ class KvRouter:
         self.scheduler.update_metrics(metrics)
         # prune index entries of workers that disappeared from discovery
         live = set(self.client.instance_ids())
-        for wid in list(self.indexer.tree.lookup):
+        for wid in self.indexer.workers():
             if wid not in live:
                 log.info("pruning dead worker %x from KV index", wid)
                 self.indexer.remove_worker(wid)

@@ -64,6 +64,9 @@ class KvScheduler:
     slot_weight: float = 0.25          # gamma
     on_hit_rate_event: Optional[Callable[[KVHitRateEvent], None]] = None
     workers: Dict[int, WorkerState] = field(default_factory=dict)
+    # tie-breaking entropy: injectable so deterministic drivers (the fleet
+    # simulator) can seed routing; default keeps process-level randomness
+    rng: random.Random = field(default_factory=random.Random)
 
     def update_metrics(self, metrics: Dict[int, ForwardPassMetrics]) -> None:
         """Replace worker snapshots (periodic scrape) and reset the
@@ -97,7 +100,7 @@ class KvScheduler:
                 best.append(wid)
         if not best:
             raise RuntimeError("all workers saturated")
-        chosen = random.choice(best)
+        chosen = self.rng.choice(best)
         # optimistic accounting until the next scrape
         w = self.workers[chosen]
         w.extra_requests += 1

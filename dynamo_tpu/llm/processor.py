@@ -15,6 +15,7 @@ import uuid
 from typing import AsyncIterator, Optional
 
 from ..runtime.component import Client
+from ..runtime.dcp_client import NoRespondersError
 from ..runtime.engine import Context
 from .backend import Backend
 from .kv_router.router import KvRouter
@@ -37,9 +38,19 @@ class _RemoteTokenEngine:
 
     async def generate(self, request: PreprocessedRequest, context: Context):
         if self.worker_id is not None:
-            stream = await self.client.direct(request.to_dict(),
-                                              self.worker_id,
-                                              context=context)
+            try:
+                stream = await self.client.direct(request.to_dict(),
+                                                  self.worker_id,
+                                                  context=context)
+            except (RuntimeError, NoRespondersError) as e:
+                # the routed worker vanished between the router's scrape
+                # and the direct call (drain/crash churn): any live
+                # worker beats a 500 — the prefix-overlap win is gone,
+                # correctness is not
+                log.warning("direct route to %x failed (%s); falling "
+                            "back to round-robin", self.worker_id, e)
+                stream = await self.client.round_robin(request.to_dict(),
+                                                       context=context)
         else:
             stream = await self.client.round_robin(request.to_dict(),
                                                    context=context)

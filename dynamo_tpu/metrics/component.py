@@ -49,13 +49,17 @@ class MetricsAggregator:
         self._task: Optional[asyncio.Task] = None
         self._sid: Optional[int] = None
 
-    async def start(self) -> None:
+    async def start(self, *, run_loop: bool = True) -> None:
+        """``run_loop=False`` skips the periodic scrape task; drivers that
+        step time themselves (the fleet simulator) call ``scrape_once``
+        directly."""
         self._client = await self.drt.namespace(
             self.address.namespace).component(
             self.address.component).endpoint(self.address.endpoint).client()
         self._sid = await self.drt.dcp.subscribe(
             f"{self.namespace}.{KV_HIT_RATE_SUBJECT}", self._on_hit_rate)
-        self._task = spawn_tracked(self._loop(), name="metrics-scrape")
+        if run_loop:
+            self._task = spawn_tracked(self._loop(), name="metrics-scrape")
 
     async def stop(self) -> None:
         await cancel_join(self._task)
@@ -98,9 +102,13 @@ class MetricsAggregator:
             self.worker_metrics[instance_id] = ForwardPassMetrics.from_dict(
                 data)
             live.add(instance_id)
-        # drop metrics of departed workers (lease expiry)
+        # drop metrics of departed workers (lease expiry) and of workers
+        # quarantined off the stats plane (a crashed-but-leased worker
+        # must not keep contributing its last-known load forever)
+        evicted = set(self._client.evicted_ids())
         for wid in list(self.worker_metrics):
-            if wid not in live and wid not in self._client.instances:
+            if wid not in live and (wid not in self._client.instances
+                                    or wid in evicted):
                 del self.worker_metrics[wid]
 
     # ------------------------------------------------------------- render
@@ -189,6 +197,13 @@ class MetricsAggregator:
         lines.append(
             f'dyn_metrics_consecutive_scrape_failures{{namespace="{ns}"}} '
             f'{self.consecutive_scrape_failures}')
+        evicted = len(self._client.evicted_ids()) if self._client else 0
+        lines.append("# HELP dyn_metrics_evicted_instances instances "
+                     "quarantined off the stats plane after consecutive "
+                     "probe failures (stale-endpoint hygiene)")
+        lines.append("# TYPE dyn_metrics_evicted_instances gauge")
+        lines.append(f'dyn_metrics_evicted_instances{{namespace="{ns}"}} '
+                     f'{evicted}')
         return "\n".join(lines) + "\n"
 
 

@@ -8,7 +8,10 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
-from typing import Optional
+from typing import Callable, Optional, Sequence, Union
+
+LoadProfile = Union[Callable[[int], Union["ForwardPassMetrics", dict]],
+                    Sequence[Union["ForwardPassMetrics", dict]]]
 
 from ..llm.kv_router.protocols import (KV_HIT_RATE_SUBJECT,
                                        ForwardPassMetrics)
@@ -22,21 +25,37 @@ log = logging.getLogger("dynamo_tpu.metrics.mock")
 
 class MockWorker:
     """Serves a stats-only endpoint with synthetic ForwardPassMetrics and
-    emits synthetic hit-rate events."""
+    emits synthetic hit-rate events.
+
+    ``profile`` scripts the load shape instead of random draws: either a
+    callable ``tick -> ForwardPassMetrics | dict`` (tick counts stats
+    scrapes served, starting at 0) or a sequence of snapshots cycled per
+    scrape. Fleet scenarios use this for reproducible per-worker load;
+    the default (``profile=None``) keeps the original seeded-random
+    behavior."""
 
     def __init__(self, drt: DistributedRuntime, namespace: str = "dynamo",
                  component: str = "mock", endpoint: str = "generate_tokens",
-                 seed: int = 0, hit_rate_interval: float = 0.5):
+                 seed: int = 0, hit_rate_interval: float = 0.5,
+                 profile: Optional[LoadProfile] = None):
         self.drt = drt
         self.namespace = namespace
         self.component = component
         self.endpoint = endpoint
         self.rng = random.Random(seed)
         self.hit_rate_interval = hit_rate_interval
+        self.profile = profile
+        self._tick = 0
         self._handle = None
         self._task: Optional[asyncio.Task] = None
 
     def _stats(self) -> dict:
+        tick, self._tick = self._tick, self._tick + 1
+        if self.profile is not None:
+            snap = (self.profile(tick) if callable(self.profile)
+                    else self.profile[tick % len(self.profile)])
+            return snap.to_dict() if isinstance(snap, ForwardPassMetrics) \
+                else dict(snap)
         return ForwardPassMetrics(
             request_active_slots=self.rng.randint(0, 16),
             request_total_slots=16,

@@ -55,13 +55,16 @@ class Planner:
     def __init__(self, drt: DistributedRuntime, namespace: str = "dynamo",
                  targets: Optional[List[WatchTarget]] = None,
                  interval: float = 5.0, apply: bool = False,
-                 clock=time.monotonic):
+                 clock=time.monotonic, wall_clock=time.time):
         self.drt = drt
         self.namespace = namespace
         self.targets = targets or []
         self.interval = interval
         self.apply = apply
         self.clock = clock
+        # ``at`` on the wire: injectable so simulated runs (fleet sim) get
+        # advisory timestamps on the same virtual clock as everything else
+        self.wall_clock = wall_clock
         self._clients: Dict[str, Client] = {}
         self._last_up: Dict[str, float] = {}
         self._last_down: Dict[str, float] = {}
@@ -70,12 +73,22 @@ class Planner:
 
     # ------------------------------------------------------------ lifecycle
 
-    async def start(self) -> None:
+    async def start(self, *, run_loop: bool = True) -> None:
+        """Create the stats clients and (unless ``run_loop=False``) spawn
+        the periodic tick task. Drivers that tick manually — tests and the
+        fleet simulator's step loop — pass ``run_loop=False``."""
         for t in self.targets:
             self._clients[t.component] = await self.drt.namespace(
                 self.namespace).component(t.component).endpoint(
                 t.endpoint).client()
-        self._task = spawn_tracked(self._loop(), name="planner-tick")
+            # startup hysteresis, down-direction only: a fresh planner has
+            # no load history, and its first tick of a momentarily-idle
+            # pool must not shed a replica — wait out a full down-cooldown
+            # from start. Scale-UP stays immediate (cold start / outage
+            # response beats conservatism).
+            self._last_down.setdefault(t.component, self.clock())
+        if run_loop:
+            self._task = spawn_tracked(self._loop(), name="planner-tick")
 
     async def stop(self) -> None:
         # wait the cancellation out before closing the clients the
@@ -125,7 +138,7 @@ class Planner:
                     t.component, float("-inf")))
             if adv is None:
                 continue
-            adv.at = time.time()   # wall time on the wire
+            adv.at = self.wall_clock()   # wall time on the wire
             if adv.direction == "up":
                 self._last_up[t.component] = now
             elif adv.direction == "down":

@@ -239,7 +239,15 @@ class ServeHandle:
         return len(self._inflight)
 
     async def _on_stats(self, msg: Message) -> None:
-        data = self.stats_handler() if self.stats_handler else {}
+        try:
+            data = self.stats_handler() if self.stats_handler else {}
+        except Exception as e:  # noqa: BLE001 — a crashing stats handler
+            # must answer (error), not leave the scraper waiting out its
+            # full request timeout every round
+            log.debug("stats handler failed for %s", self.instance.subject,
+                      exc_info=True)
+            await msg.respond_error(f"stats handler failed: {e!r}")
+            return
         await msg.respond(pack(wire.checked(wire.DCP_STATS_REPLY, {
             "instance_id": self.instance.instance_id,
             "subject": self.instance.subject,
@@ -363,6 +371,11 @@ class Client:
     live instance list, and routes ``random`` / ``round_robin`` / ``direct``.
     """
 
+    # consecutive stats-plane failures before an instance is quarantined
+    STATS_EVICTION_THRESHOLD = 3
+    # evicted instances are re-probed every Nth collect_stats round
+    STATS_RETRY_EVERY = 5
+
     def __init__(self, drt, address: EndpointAddress):
         self.drt = drt
         self.address = address
@@ -371,6 +384,16 @@ class Client:
         self._watch_task: Optional[asyncio.Task] = None
         self._rr = 0
         self._instances_event = asyncio.Event()
+        # stale-endpoint hygiene: an instance whose stats plane keeps
+        # failing (crashed worker with a live lease, wedged process,
+        # scrape blackout) is quarantined off the scrape-target list so
+        # the collectors stop paying per-round failures for it. It stays
+        # in ``instances`` — discovery, not one client's probe history,
+        # owns membership — and rejoins scraping on a successful periodic
+        # re-probe or a fresh discovery put.
+        self._stats_failures: Dict[int, int] = {}
+        self._stats_evicted: set = set()
+        self._stats_rounds = 0
 
     async def _start(self) -> None:
         prefix = instance_prefix(self.address.namespace, self.address.component,
@@ -389,14 +412,21 @@ class Client:
         async for ev in self._watch:
             if ev.event == "put":
                 inst = EndpointInstance.from_dict(unpack(ev.value))
+                # a fresh discovery record clears any quarantine: the
+                # worker re-registered, so probe it again
+                self._stats_evicted.discard(inst.instance_id)
+                self._stats_failures.pop(inst.instance_id, None)
                 self.instances[inst.instance_id] = inst
                 self._instances_event.set()
             elif ev.event == "delete":
                 lease_hex = ev.key.rsplit(":", 1)[-1]
                 try:
-                    self.instances.pop(int(lease_hex, 16), None)
+                    wid = int(lease_hex, 16)
                 except ValueError:
-                    pass
+                    continue
+                self.instances.pop(wid, None)
+                self._stats_evicted.discard(wid)
+                self._stats_failures.pop(wid, None)
                 if not self.instances:
                     self._instances_event.clear()
 
@@ -478,19 +508,70 @@ class Client:
 
     # ------------------------------------------------------------- stats
 
+    def evicted_ids(self) -> List[int]:
+        """Instances quarantined off the stats plane (crashed-but-leased
+        or blacked-out workers); they rejoin via a successful re-probe or
+        a fresh discovery put."""
+        return sorted(self._stats_evicted)
+
+    def _note_stats_ok(self, inst: EndpointInstance) -> None:
+        self._stats_failures.pop(inst.instance_id, None)
+        if inst.instance_id in self._stats_evicted:
+            log.info("instance %x of %s answered again; restoring to the "
+                     "scrape targets", inst.instance_id, self.address)
+            self._stats_evicted.discard(inst.instance_id)
+
+    def _note_stats_failure(self, inst: EndpointInstance) -> None:
+        wid = inst.instance_id
+        n = self._stats_failures.get(wid, 0) + 1
+        self._stats_failures[wid] = n
+        if wid not in self._stats_evicted \
+                and n >= self.STATS_EVICTION_THRESHOLD:
+            # crashed-but-leased worker: its discovery record outlives
+            # the process (keepalive thread / long TTL), so every scrape
+            # round would keep paying a failed probe for it — quarantine
+            # it off the scrape-target list. Discovery membership (and
+            # therefore routing) is untouched: that is owned by the
+            # instance records, not by one client's probe history.
+            log.warning(
+                "instance %x of %s failed %d consecutive stats probes; "
+                "evicting from scrape targets", wid, self.address, n)
+            self._stats_evicted.add(wid)
+
     async def collect_stats(self, timeout: float = 2.0) -> Dict[int, dict]:
         """Scrape per-instance stats over the request plane (reference
-        service.rs collect_services / $SRV.STATS)."""
-        out: Dict[int, dict] = {}
+        service.rs collect_services / $SRV.STATS).
 
-        async def _one(inst: EndpointInstance):
+        Instances that fail ``STATS_EVICTION_THRESHOLD`` consecutive
+        probes are quarantined off the scrape-target list (stale-endpoint
+        hygiene under fleet churn); quarantined instances are re-probed
+        every ``STATS_RETRY_EVERY``-th round and restored on success."""
+        self._stats_rounds += 1
+        retry_round = (self._stats_evicted
+                       and self._stats_rounds % self.STATS_RETRY_EVERY == 0)
+        targets = [i for i in sorted(self.instances.values(),
+                                     key=lambda i: i.instance_id)
+                   if retry_round
+                   or i.instance_id not in self._stats_evicted]
+
+        async def _one(inst: EndpointInstance) -> Optional[dict]:
             try:
-                resp = wire.decoded(wire.DCP_STATS_REPLY, unpack(
+                return wire.decoded(wire.DCP_STATS_REPLY, unpack(
                     await self.drt.dcp.request(
                         f"stats.{inst.subject}", b"", timeout=timeout)))
-                out[inst.instance_id] = resp
             except Exception:
-                pass
+                log.debug("stats probe failed for instance %x of %s",
+                          inst.instance_id, self.address, exc_info=True)
+                return None
 
-        await asyncio.gather(*(_one(i) for i in list(self.instances.values())))
+        replies = await asyncio.gather(*(_one(i) for i in targets))
+        # assemble in instance-id order (not completion order) so metric
+        # consumers — router scheduler, planner — see a deterministic view
+        out: Dict[int, dict] = {}
+        for inst, resp in zip(targets, replies):
+            if resp is None:
+                self._note_stats_failure(inst)
+            else:
+                self._note_stats_ok(inst)
+                out[inst.instance_id] = resp
         return out

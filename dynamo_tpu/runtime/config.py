@@ -87,6 +87,17 @@ register_env("DYN_KV_TRANSFER_INT8", "0", "llm/disagg",
              "int8-compress shipped KV pages (~half the DCN bytes; "
              "lossy). 1/true enables.")
 
+register_env("DYN_FLEET_DISCOVERY_TIMEOUT", "10.0", "fleet",
+             "Fleet simulator: wall-clock seconds to wait for spawned/"
+             "stopped workers to propagate through discovery watches "
+             "before a step proceeds.")
+register_env("DYN_FLEET_MAX_WORKERS", "64", "fleet",
+             "Fleet simulator: hard cap on workers the in-process fleet "
+             "controller will run, regardless of planner advisories.")
+register_env("DYN_FLEET_REPORT_DIR", None, "fleet",
+             "Fleet simulator CLI: also write each run's JSON report "
+             "into this directory (unset = stdout only).")
+
 register_env("DYN_DISABLE_PALLAS", None, "models",
              "Any non-empty value forces the XLA gather attention path "
              "everywhere (Pallas kill switch).")

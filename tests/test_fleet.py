@@ -1,0 +1,173 @@
+"""dynafleet: the deterministic fleet-scale serving simulator.
+
+Tier-1 coverage:
+
+- **smoke closed loop** — a small burst drives the real planner to emit a
+  scale-up advisory, the fleet controller actually adds workers, and the
+  post-scale SLO recovers (ROADMAP item 1's regression gate).
+- **determinism** — the acceptance contract: ``--scenario burst --seed
+  0`` twice renders byte-identical JSON reports.
+- **crash churn** — a mid-stream worker crash fails fast, the stale
+  endpoint is evicted from every collector's scrape targets, and the
+  planner re-scales the pool.
+- **traffic/model units** — seeded traces replay identically; the worker
+  queueing model stamps deterministic lifecycle times.
+
+Larger scenario sweeps are ``slow``-marked.
+"""
+
+import json
+
+import pytest
+
+from dynamo_tpu.fleet import (SCENARIOS, SimEngineModel, WorkerProfile,
+                              burst, get_scenario, run_scenario)
+from dynamo_tpu.fleet.clock import VirtualClock
+
+
+# ----------------------------------------------------------- pure pieces
+
+
+def test_traffic_trace_is_seed_deterministic():
+    t1 = burst(3, steps=20, base_rate=1.5, burst_rate=6.0,
+               burst_start=5, burst_end=10)
+    t2 = burst(3, steps=20, base_rate=1.5, burst_rate=6.0,
+               burst_start=5, burst_end=10)
+    assert t1.requests == t2.requests
+    assert [p.name for p in t1.phases] == ["warmup", "burst", "recovery"]
+    t3 = burst(4, steps=20, base_rate=1.5, burst_rate=6.0,
+               burst_start=5, burst_end=10)
+    assert t1.requests != t3.requests  # different seed, different trace
+
+
+def test_sim_engine_model_lifecycle():
+    clock = VirtualClock()
+    seen = []
+    model = SimEngineModel(
+        "w0", WorkerProfile(slots=1, prefill_steps=2, tokens_per_step=4),
+        block_size=8, clock=clock.now,
+        on_lifecycle=lambda rid, ev, vt: seen.append((rid, ev, vt)))
+    r1 = model.submit("a", list(range(16)), max_tokens=8)
+    r2 = model.submit("b", list(range(16)), max_tokens=4)
+    # step 0: a admitted (slot 1 of 1), prefill 1/2; b waits
+    model.step()
+    assert ("a", "admitted", 0.0) in seen
+    assert model.stats()["num_requests_waiting"] == 1
+    clock.advance()
+    model.step()   # a: prefill done -> first 4 tokens
+    assert ("a", "first_token", 1.0) in seen
+    clock.advance()
+    model.step()   # a: last 4 tokens -> done; b still waiting
+    assert ("a", "done", 2.0) in seen
+    clock.advance()
+    model.step()   # b admitted
+    assert ("b", "admitted", 3.0) in seen
+    assert r1.finished and not r2.finished
+    # events queues carry the released batches
+    assert r1.events.qsize() == 2
+
+
+# ------------------------------------------------------------ smoke loop
+
+
+def test_smoke_scenario_closes_the_loop(run_async):
+    """Burst -> planner advisory -> controller adds workers -> SLO
+    recovers. The tier-1 closed-loop regression gate."""
+    report = run_async(run_scenario(get_scenario("smoke"), seed=0))
+
+    # the planner emitted at least one scale-up advisory under the burst
+    ups = [a for a in report["advisories"] if a["direction"] == "up"]
+    assert ups, f"no scale-up advisory: {report['advisories']}"
+    assert ups[0]["at"] >= 6.0  # during the burst window, virtual time
+
+    # the fleet controller actually added workers
+    scale_ups = [a for a in report["actuations"]
+                 if a["action"] == "scale-up" and a["workers"]]
+    assert scale_ups, f"advisory never actuated: {report['actuations']}"
+    assert report["workers"]["peak_live"] > 2  # grew past the initial 2
+
+    # the loop also closed through the k8s dry-run reconcile controller
+    assert report["k8s_dry_run"]["deployment_replicas"] == \
+        ups[-1]["desired_replicas"]
+
+    # post-scale recovery: queue drained after the burst and the final
+    # phase met the scenario SLO
+    assert report["slo"]["time_to_recover_s"] is not None
+    assert report["slo"]["met"], report["phases"]
+    assert report["phases"]["recovery"]["queue_wait_p95_s"] \
+        <= report["slo"]["targets"]["queue_wait_p95_s"]
+    assert report["phases"]["recovery"]["ttft_p95_s"] \
+        <= report["slo"]["targets"]["ttft_p95_s"]
+
+    # every request made it through the real HTTP/router path
+    assert report["requests"]["failed"] == 0
+    assert report["requests"]["completed"] == report["requests"]["total"]
+    # advisory timeline is recorded in virtual time
+    assert all(isinstance(a["at"], float) for a in report["advisories"])
+
+
+def test_burst_reports_identical_across_runs(run_async):
+    """The acceptance contract: same scenario + seed => byte-identical
+    report, across independent event loops."""
+    sc = get_scenario("burst")
+    r1 = run_async(run_scenario(sc, seed=0))
+    r2 = run_async(run_scenario(get_scenario("burst"), seed=0))
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2, sort_keys=True)
+    # different seed produces a different trace (sanity that the seed
+    # actually flows through)
+    assert r1["requests"]["total"] > 0
+
+
+def test_crash_scenario_evicts_and_rescales(run_async):
+    """Worker crash mid-stream: streams fail fast, the stale endpoint is
+    quarantined off every collector's scrape targets, and the planner
+    re-scales the pool."""
+    report = run_async(run_scenario(get_scenario("crash"), seed=0))
+
+    crashes = [e for e in report["workers"]["timeline"]
+               if e["event"] == "crash"]
+    assert len(crashes) == 1
+    # in-flight streams on the crashed worker failed (fail-fast, not hang)
+    assert report["requests"]["failed"] >= 1
+    # stale-endpoint hygiene: both collectors evicted the crashed
+    # instance from their scrape targets
+    assert report["stats_evictions"]["aggregator"]
+    assert report["stats_evictions"]["router"]
+    # the planner saw the shrunken pool and re-scaled it
+    ups = [a for a in report["actuations"] if a["action"] == "scale-up"]
+    assert ups and ups[0]["vt"] > crashes[0]["vt"]
+    assert report["slo"]["met"], report["phases"]
+
+
+# ------------------------------------------------------------ slow sweep
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["diurnal", "hot-tenant", "blackout",
+                                  "join"])
+def test_scenario_sweep(run_async, name):
+    report = run_async(run_scenario(get_scenario(name), seed=1))
+    assert report["requests"]["completed"] > 0
+    assert report["slo"]["met"], report["phases"]
+    if name == "hot-tenant":
+        # shared-prefix traffic must register overlap in the router
+        assert report["router"]["avg_hit_rate"] > 0.3
+    if name == "blackout":
+        # zero-observed advisories are published but never actuated
+        ignored = [a for a in report["actuations"]
+                   if a["action"] == "ignored-zero-observed"]
+        zero_obs = [a for a in report["advisories"]
+                    if a["current_replicas"] == 0]
+        assert len(ignored) == len(zero_obs) > 0
+        assert report["workers"]["peak_live"] == 3
+
+
+def test_scenario_registry_complete():
+    for name in SCENARIOS:
+        sc = get_scenario(name)
+        assert sc.steps > 0 and sc.initial_workers >= 1
+        trace = sc.traffic(0)
+        assert trace.total > 0
+        assert trace.requests == sc.traffic(0).requests  # replayable
+    with pytest.raises(ValueError):
+        get_scenario("nope")

@@ -3,7 +3,9 @@ mock_worker.rs: the metrics plane is testable with no engine)."""
 
 import asyncio
 
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.metrics import MetricsAggregator, MockWorker
+from dynamo_tpu.runtime.component import Client
 from dynamo_tpu.runtime.runtime import DistributedRuntime
 
 
@@ -37,3 +39,76 @@ def test_aggregator_scrapes_mock_workers(run_async):
     assert 'namespace="dynamo"' in text
     assert agg.hit_rate_events > 0
     assert "dyn_kv_hit_rate_overlap_blocks" in text
+    assert "dyn_metrics_evicted_instances" in text
+
+
+def test_scrape_target_eviction_under_churn(run_async):
+    """Stale-endpoint hygiene: a worker that crashes WITHOUT deregistering
+    (lease still alive, discovery record intact) is evicted from the
+    scrape targets after consecutive probe failures instead of costing
+    every round a failed probe forever — and a rejoin (fresh discovery
+    put) restores it."""
+
+    async def scenario():
+        drt = await DistributedRuntime.detached()
+        drt2 = await DistributedRuntime.attach(drt.dcp.address)
+        w1 = MockWorker(drt, component="churn", seed=1,
+                        hit_rate_interval=9e9,
+                        profile=[ForwardPassMetrics(request_active_slots=1)])
+        w2 = MockWorker(drt2, component="churn", seed=2,
+                        hit_rate_interval=9e9,
+                        profile=[ForwardPassMetrics(request_active_slots=2)])
+        await w1.start()
+        await w2.start()
+        crash_id = drt2.instance_id
+
+        agg = MetricsAggregator(drt, "dynamo", "churn")
+        await agg.start(run_loop=False)
+        await agg.scrape_once()
+        healthy = dict(agg.worker_metrics)
+
+        # crash w2: drop its request-plane subscriptions but leave the
+        # discovery record (the keepalive thread still renews the lease)
+        for sid in w2._handle._sids:
+            await drt2.dcp.unsubscribe(sid)
+        w2._handle._sids.clear()
+
+        evictions_by_round = []
+        for _ in range(Client.STATS_EVICTION_THRESHOLD):
+            await agg.scrape_once()
+            evictions_by_round.append(list(agg._client.evicted_ids()))
+        metrics_after = dict(agg.worker_metrics)
+        still_discovered = crash_id in agg._client.instances
+
+        # rejoin: the worker re-registers (fresh discovery put) and must
+        # immediately be a scrape target again
+        await w2.stop()
+        w3 = MockWorker(drt2, component="churn", seed=3,
+                        hit_rate_interval=9e9,
+                        profile=[ForwardPassMetrics(request_active_slots=3)])
+        await w3.start()
+        await asyncio.sleep(0.1)   # watch fanout
+        rejoined_evicted = list(agg._client.evicted_ids())
+        await agg.scrape_once()
+        metrics_rejoined = dict(agg.worker_metrics)
+
+        await agg.stop()
+        await w1.stop()
+        await w3.stop()
+        await drt2.shutdown()
+        await drt.shutdown()
+        return (crash_id, healthy, evictions_by_round, metrics_after,
+                still_discovered, rejoined_evicted, metrics_rejoined)
+
+    (crash_id, healthy, rounds, after, still_discovered,
+     rejoined_evicted, rejoined) = run_async(scenario())
+    assert crash_id in healthy                       # scraped while alive
+    assert rounds[-1] == [crash_id]                  # evicted at threshold
+    assert all(not r for r in rounds[:-1])           # …not before
+    assert crash_id not in after                     # metrics dropped too
+    # discovery membership is NOT touched by the quarantine — the record
+    # belongs to the (still-live) lease, not to this client
+    assert still_discovered
+    assert rejoined_evicted == []                    # put clears quarantine
+    assert crash_id in rejoined                      # scraped again
+    assert rejoined[crash_id].request_active_slots == 3

@@ -79,6 +79,132 @@ class TestPolicy:
                       last_up_at=0.0) is None
 
 
+def test_scrape_blackout_never_applies_scale_down(run_async):
+    """Zero-observed guard end-to-end: a scrape blackout (no worker
+    answers stats → empty metrics, current_replicas == 0) must publish
+    at most a cold-start advisory and must NEVER edit the stored
+    deployment spec — and once the blackout lifts, normal advisories
+    resume and apply again."""
+
+    async def scenario():
+        drt = await DistributedRuntime.detached()
+        drt2 = await DistributedRuntime.attach(drt.dcp.address)
+        # workers whose stats handler fails: registered in discovery but
+        # dark on the stats plane — exactly a scrape blackout
+        dark = [True]
+
+        def _stats():
+            if dark[0]:
+                raise RuntimeError("scrape blackout")
+            return ForwardPassMetrics(num_requests_waiting=8).to_dict()
+
+        workers = []
+        for d in (drt, drt2):
+            w = MockWorker(d, component="pool", seed=5,
+                           hit_rate_interval=9e9)
+            w._stats = _stats
+            await w.start()
+            workers.append(w)
+
+        spec = {"metadata": {"name": "graph"},
+                "spec": {"services": {"pool": {"replicas": 2}}}}
+        await drt.dcp.kv_put("deployments/graph", pack(spec))
+
+        fake_now = [100.0]
+        planner = Planner(
+            drt, "dynamo",
+            [WatchTarget(component="pool", deployment="graph",
+                         config=PlannerConfig(min_replicas=1,
+                                              max_replicas=8))],
+            apply=True, clock=lambda: fake_now[0],
+            wall_clock=lambda: fake_now[0])
+        await planner.start(run_loop=False)
+
+        # blackout tick: empty metrics → cold-start advisory published…
+        advs_blackout = await planner.tick()
+        spec_after_blackout = unpack(
+            await drt.dcp.kv_get("deployments/graph"))
+
+        # …and re-emission is cooldown-rate-limited during the outage
+        fake_now[0] = 105.0
+        advs_repeat = await planner.tick()
+
+        # blackout lifts: waiting pressure resumes normal advisories,
+        # which DO apply to the stored spec again
+        dark[0] = False
+        fake_now[0] = 200.0
+        advs_after = await planner.tick()
+        spec_after_recover = unpack(
+            await drt.dcp.kv_get("deployments/graph"))
+
+        await planner.stop()
+        for w in workers:
+            await w.stop()
+        await drt2.shutdown()
+        await drt.shutdown()
+        return (advs_blackout, advs_repeat, advs_after,
+                spec_after_blackout, spec_after_recover)
+
+    (blackout, repeat, after, spec_blackout, spec_recover) = \
+        run_async(scenario())
+    # blackout: advisory emitted (cold-start shape), at the virtual time
+    assert len(blackout) == 1
+    assert blackout[0].current_replicas == 0
+    assert blackout[0].desired_replicas == 1
+    assert blackout[0].at == 100.0   # wall_clock hook, not time.time()
+    # …but the stored spec was NOT auto-applied (guard)
+    assert spec_blackout["spec"]["services"]["pool"]["replicas"] == 2
+    # cooldown suppresses re-publication while still dark
+    assert repeat == []
+    # recovery: both workers answer with 8 waiting each → scale-up that
+    # applies to the spec again
+    assert len(after) == 1 and after[0].direction == "up"
+    assert after[0].current_replicas == 2
+    assert spec_recover["spec"]["services"]["pool"]["replicas"] == \
+        after[0].desired_replicas
+
+
+def test_planner_start_waits_down_cooldown(run_async):
+    """Startup hysteresis: a fresh planner's first look at an idle pool
+    must not shed a replica — scale-down is gated on a full down-cooldown
+    from start; scale-up stays immediate."""
+
+    async def scenario():
+        drt = await DistributedRuntime.detached()
+        drt2 = await DistributedRuntime.attach(drt.dcp.address)
+        workers = [MockWorker(d, component="pool", seed=11,
+                              hit_rate_interval=9e9,
+                              profile=lambda tick: ForwardPassMetrics(
+                                  gpu_cache_usage_perc=0.01))
+                   for d in (drt, drt2)]
+        for w in workers:
+            await w.start()
+
+        fake_now = [50.0]
+        cfg = PlannerConfig(min_replicas=1, max_replicas=8,
+                            scale_down_cooldown_s=180.0)
+        planner = Planner(drt, "dynamo",
+                          [WatchTarget(component="pool", config=cfg)],
+                          clock=lambda: fake_now[0],
+                          wall_clock=lambda: fake_now[0])
+        await planner.start(run_loop=False)
+        idle_first = await planner.tick()      # inside startup cooldown
+        fake_now[0] = 50.0 + 181.0
+        idle_later = await planner.tick()      # cooldown elapsed
+
+        await planner.stop()
+        for w in workers:
+            await w.stop()
+        await drt2.shutdown()
+        await drt.shutdown()
+        return idle_first, idle_later
+
+    idle_first, idle_later = run_async(scenario())
+    assert idle_first == []                    # no knee-jerk shed
+    assert len(idle_later) == 1
+    assert idle_later[0].direction == "down"   # but downs still work
+
+
 def test_planner_emits_and_applies(run_async):
     """Two live mock workers + a deep queue → UP advisory on the bus, in
     KV, and applied to the stored deployment spec (the closed loop the
