@@ -39,8 +39,8 @@ import numpy as np
 from jax import lax
 
 from ..llm.protocols.common import (FINISH_CANCELLED, FINISH_EOS,
-                                    FINISH_LENGTH, EngineOutput,
-                                    PreprocessedRequest)
+                                    FINISH_LENGTH, FINISH_TIMEOUT,
+                                    EngineOutput, PreprocessedRequest)
 from ..models.config import ModelConfig
 from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
@@ -53,6 +53,14 @@ from .sampling import (SamplingBatch, logprob_aux, sample_tokens,
 from .spec_decode import propose_ngram_draft
 
 log = logging.getLogger("dynamo_tpu.engine")
+
+
+def _cancel_reason(ctx: Context) -> str:
+    """Why a stopped sequence is ending: the request deadline expired
+    (client-visible "timeout", HTTP 504) vs. the caller cancelled
+    ("cancelled"). Either way the sequence is terminated on the cancel
+    path and its pages free immediately."""
+    return FINISH_TIMEOUT if ctx.expired else FINISH_CANCELLED
 
 
 @dataclass
@@ -758,7 +766,7 @@ class JaxEngine:
             seq = self.waiting[0]
             if seq.context.stopped:
                 self.waiting.pop(0)
-                self._finish(seq, FINISH_CANCELLED)
+                self._finish(seq, _cancel_reason(seq.context))
                 continue
             if seq.num_prompt >= self.cap_tokens:
                 # admission is clamped to the warmed bucket grid: a prompt
@@ -914,7 +922,7 @@ class JaxEngine:
         for seq in list(self.prefilling):
             if seq.context.stopped:
                 self.prefilling.remove(seq)
-                self._terminate(seq, FINISH_CANCELLED)
+                self._terminate(seq, _cancel_reason(seq.context))
                 continue
             if self._unrestored_pages and not self._unrestored_pages.isdisjoint(
                     seq.pages):
@@ -1181,7 +1189,7 @@ class JaxEngine:
                 batch.remove(seq)
                 self.running.remove(seq)
                 self._release(seq)
-                self._finish(seq, FINISH_CANCELLED)
+                self._finish(seq, _cancel_reason(seq.context))
         self._grow_or_preempt(batch, 1)
         if not batch:
             return
@@ -1238,7 +1246,7 @@ class JaxEngine:
             return
         for seq in list(self.running):
             if seq.context.stopped:
-                self._terminate(seq, FINISH_CANCELLED)
+                self._terminate(seq, _cancel_reason(seq.context))
         batch = [s for s in self.running if s.finished is None]
         batch = batch[: self.ecfg.max_batch]
         if not batch:
@@ -1376,7 +1384,7 @@ class JaxEngine:
         if batch is None:
             for seq in list(self.running):
                 if seq.context.stopped:
-                    self._terminate(seq, FINISH_CANCELLED)
+                    self._terminate(seq, _cancel_reason(seq.context))
             batch = [s for s in self.running if s.finished is None]
         else:
             batch = [s for s in batch if s.finished is None

@@ -139,7 +139,8 @@ class FleetController:
         return [await self._spawn() for _ in range(n)]
 
     async def _drain(self, worker: SimWorker) -> None:
-        await worker.drain()
+        # SimWorker.drain is a sim-model state flip, not a socket drain
+        await worker.drain()  # dynalint: disable=unbounded-await
         log.info("fleet controller draining %s", worker.name)
 
     async def retire_idle_drained(self) -> List[str]:

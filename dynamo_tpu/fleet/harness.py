@@ -320,6 +320,12 @@ class FleetSim:
                 for worker in self.controller.live:
                     worker.set_blackout(False)
                 self.scorer.worker_event(vt, "blackout_end", "*")
+            elif fault.kind in ("flap_start", "flap_end"):
+                live = self.controller.live
+                if live:
+                    worker = live[min(fault.arg, len(live) - 1)]
+                    worker.set_blackout(fault.kind == "flap_start")
+                    self.scorer.worker_event(vt, fault.kind, worker.name)
 
     def _fleet_sample(self) -> None:
         waiting = sum(len(w.model.queue)
@@ -374,6 +380,21 @@ class FleetSim:
             "stats_evictions": {
                 "aggregator": self.agg._client.evicted_ids(),
                 "router": self.router.client.evicted_ids(),
+            },
+            # circuit-breaker evidence for the breaker scenario: how many
+            # times each collector's stats-plane breakers opened over the
+            # run, and which instances are open at the end
+            "breakers": {
+                "aggregator": {
+                    "opened_total":
+                        self.agg._client.breakers.opened_total("stats"),
+                    "open_now": self.agg._client.evicted_ids(),
+                },
+                "router": {
+                    "opened_total":
+                        self.router.client.breakers.opened_total("stats"),
+                    "open_now": self.router.client.evicted_ids(),
+                },
             },
             "advisories_in_kv": len(stored),
         }

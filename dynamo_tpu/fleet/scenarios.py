@@ -29,7 +29,9 @@ class FaultEvent:
     kinds: ``crash`` (crash the ``arg``-th live worker, mid-stream),
     ``join`` (spawn one extra worker outside the planner loop — delayed
     join), ``blackout_start`` / ``blackout_end`` (all live workers stop /
-    resume answering stats scrapes)."""
+    resume answering stats scrapes), ``flap_start`` / ``flap_end``
+    (ONE worker — the ``arg``-th — stops/resumes answering: the
+    circuit-breaker scenario's flapping instance)."""
 
     step: int
     kind: str
@@ -164,6 +166,30 @@ def _blackout() -> Scenario:
     )
 
 
+def _breaker() -> Scenario:
+    """A flapping worker (stats plane up/down/up/down) must be circuit-
+    broken by every collector — open after DYN_BREAKER_THRESHOLD
+    consecutive failed rounds, half-open re-probe cadence, close on the
+    final recovery — while traffic keeps flowing on the healthy pool."""
+    steps = 34
+    return Scenario(
+        name="breaker", steps=steps,
+        traffic=lambda seed: constant(seed, steps=steps, rate=3.0,
+                                      max_tokens=12),
+        initial_workers=3,
+        planner=PlannerConfig(min_replicas=3, max_replicas=4,
+                              waiting_per_worker_high=3.0,
+                              scale_up_cooldown_s=8.0,
+                              scale_down_cooldown_s=120.0),
+        faults=[FaultEvent(step=6, kind="flap_start", arg=0),
+                FaultEvent(step=11, kind="flap_end", arg=0),
+                FaultEvent(step=13, kind="flap_start", arg=0),
+                FaultEvent(step=18, kind="flap_end", arg=0)],
+        slo=SloTargets(ttft_p95=5.0, queue_wait_p95=4.0),
+        disturb_end_step=18,
+    )
+
+
 def _join() -> Scenario:
     """Delayed join: an out-of-band worker joins mid-run and must start
     taking routed traffic."""
@@ -185,6 +211,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "hot-tenant": _hot_tenant,
     "crash": _crash,
     "blackout": _blackout,
+    "breaker": _breaker,
     "join": _join,
 }
 

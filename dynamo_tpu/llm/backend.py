@@ -12,8 +12,8 @@ from __future__ import annotations
 from typing import AsyncIterator, List, Optional
 
 from ..runtime.engine import Context
-from .protocols.common import (FINISH_CANCELLED, FINISH_EOS, FINISH_LENGTH,
-                               FINISH_STOP, EngineOutput, PreprocessedRequest)
+from .protocols.common import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP,
+                               EngineOutput, PreprocessedRequest)
 from .tokenizer import Tokenizer
 
 
@@ -135,9 +135,11 @@ class Backend:
             out.text = released
             yield out
             if context.stopped:
+                # deadline expiry finishes as "timeout" (client-visible),
+                # caller cancellation as "cancelled"
                 context.stop_generating()
                 yield EngineOutput(text=_final_text("", False) or None,
-                                   finish_reason=FINISH_CANCELLED,
+                                   finish_reason=context.cancel_reason(),
                                    completion_tokens=produced)
                 return
         # engine stream exhausted without a finish reason: flush held text and

@@ -14,7 +14,8 @@ import asyncio
 import logging
 from typing import AsyncIterator, Optional
 
-from ...runtime import tracing
+from ...runtime import guard, tracing
+from ...runtime.config import env_float, env_int
 from ...runtime.engine import Context
 from ..protocols.common import (FINISH_CANCELLED, FINISH_ERROR, EngineOutput,
                                 PreprocessedRequest)
@@ -44,17 +45,29 @@ class DisaggDecodeEngine:
 
     def __init__(self, engine, queue: PrefillQueue, transfer: KvTransferServer,
                  router: DisaggRouter, engine_id: int,
-                 prefill_timeout: float = 120.0):
+                 prefill_timeout: Optional[float] = None,
+                 max_dispatches: Optional[int] = None):
         self.engine = engine
         self.queue = queue
         self.transfer = transfer
         self.router = router
         self.engine_id = engine_id
-        self.prefill_timeout = prefill_timeout
+        self.prefill_timeout = prefill_timeout if prefill_timeout is not None \
+            else (env_float("DYN_PREFILL_TIMEOUT", 120.0) or 120.0)
+        # hedged re-dispatch: when the transfer plane fails FAST (prefill
+        # worker died mid-transfer, severed conn) and budget remains, the
+        # job is re-enqueued to the shared queue — another worker picks it
+        # up — before giving up and falling back to local prefill. A slow
+        # timeout never re-dispatches (the budget is already spent).
+        self.max_dispatches = max(1, max_dispatches if max_dispatches
+                                  is not None
+                                  else (env_int("DYN_REDISPATCH_MAX", 2)
+                                        or 1))
         # observability
         self.remote_prefills = 0
         self.local_prefills = 0
         self.remote_fallbacks = 0
+        self.redispatches = 0
         # decode-side view of the remote leg: enqueue → KV landed + first
         # token (queue wait + prefill compute + page transfer), the
         # disagg-vs-agg transfer-overhead breakdown the reference's
@@ -66,6 +79,7 @@ class DisaggDecodeEngine:
         s.update(remote_prefills=self.remote_prefills,
                  local_prefills=self.local_prefills,
                  remote_fallbacks=self.remote_fallbacks,
+                 remote_redispatches=self.redispatches,
                  remote_wait_total_s=round(self.remote_wait_total_s, 3),
                  remote_prefill_wait_seconds_total=round(
                      self.remote_wait_total_s, 3))
@@ -127,7 +141,10 @@ class DisaggDecodeEngine:
                 pages, res = res.pages, None
                 await self.engine.release_pages(pages)
                 if context.stopped:
-                    yield EngineOutput(finish_reason=FINISH_CANCELLED)
+                    # deadline expiry surfaces as "timeout", caller
+                    # cancellation as "cancelled"
+                    yield EngineOutput(
+                        finish_reason=context.cancel_reason())
                     return
                 log.warning("remote prefill fell back to local for %s",
                             context.id)
@@ -176,44 +193,68 @@ class DisaggDecodeEngine:
 
     async def _remote_prefill(self, request: PreprocessedRequest,
                               context: Context, res) -> Optional[int]:
-        """Enqueue + await the KV arrival; returns the first token or None."""
+        """Enqueue + await the KV arrival; returns the first token or None.
+
+        The wait is bounded by ``min(prefill_timeout, request deadline)``.
+        A FAST failure (the transfer plane fails the waiter: prefill
+        worker died mid-transfer, severed connection, ingest error) is
+        hedged: while dispatches and budget remain, the job is re-enqueued
+        to the shared queue for another worker. A timeout — budget already
+        burned — falls straight back to local prefill."""
         import time as _time
 
         t0 = _time.monotonic()
-        fut = self.transfer.expect(context.id)
-        await self.queue.put(RemotePrefillRequest(
-            request_id=context.id,
-            token_ids=list(request.token_ids),
-            sampling=request.sampling.to_dict(),
-            eos_token_ids=list(request.eos_token_ids),
-            page_ids=list(res.pages),
-            skip_pages=res.skip_pages,
-            engine_id=self.engine_id,
-            # join the prefill worker's spans to this request's trace
-            # (None when not sampled → field absent on the wire)
-            trace_ctx=tracing.get_tracer().current_trace_ctx(),
-        ))
-        try:
-            first = await asyncio.wait_for(fut, self.prefill_timeout)
-            self.remote_wait_total_s += _time.monotonic() - t0
-            return first
-        except asyncio.TimeoutError:
-            self.transfer.cancel(context.id)
-            return None
-        except asyncio.CancelledError:
-            # handler task cancelled — cancel the waiter and propagate;
-            # generate()'s finally releases the reserved pages
-            self.transfer.cancel(context.id)
-            raise
-        except Exception as exc:  # noqa: BLE001
-            # a failed stream sets this exception on the waiter the moment
-            # the transfer plane knows (ingest error, sender abort, conn
-            # drop) — falling back NOW instead of idling out the full
-            # prefill_timeout
-            log.warning("remote prefill failed for %s (%s); falling back "
-                        "to local", context.id, exc)
-            self.transfer.cancel(context.id)
-            return None
+        deadline = context.deadline
+        for dispatch in range(self.max_dispatches):
+            fut = self.transfer.expect(context.id)
+            await self.queue.put(RemotePrefillRequest(
+                request_id=context.id,
+                token_ids=list(request.token_ids),
+                sampling=request.sampling.to_dict(),
+                eos_token_ids=list(request.eos_token_ids),
+                page_ids=list(res.pages),
+                skip_pages=res.skip_pages,
+                engine_id=self.engine_id,
+                # join the prefill worker's spans to this request's trace
+                # (None when not sampled → field absent on the wire)
+                trace_ctx=tracing.get_tracer().current_trace_ctx(),
+                # remaining budget travels with the job (absent = none)
+                deadline_ms=(deadline.to_wire_ms()
+                             if deadline is not None else None),
+            ))
+            try:
+                first = await guard.bound(fut, timeout=self.prefill_timeout,
+                                          deadline=deadline,
+                                          what="remote prefill")
+                self.remote_wait_total_s += _time.monotonic() - t0
+                return first
+            except asyncio.TimeoutError:
+                # covers DeadlineExceeded too: the budget is spent (or
+                # the prefill pool is too slow) — no hedge, fall back
+                self.transfer.cancel(context.id)
+                return None
+            except asyncio.CancelledError:
+                # handler task cancelled — cancel the waiter and propagate;
+                # generate()'s finally releases the reserved pages
+                self.transfer.cancel(context.id)
+                raise
+            except Exception as exc:  # noqa: BLE001
+                # fail-fast signal from the transfer plane: hedge if a
+                # dispatch remains and the budget can still cover work
+                self.transfer.cancel(context.id)
+                if dispatch + 1 < self.max_dispatches and \
+                        not (deadline is not None and deadline.expired):
+                    self.redispatches += 1
+                    guard.counter_inc("dyn_guard_hedged_redispatch_total")
+                    log.warning("remote prefill for %s failed fast (%s); "
+                                "re-enqueueing (dispatch %d/%d)",
+                                context.id, exc, dispatch + 2,
+                                self.max_dispatches)
+                    continue
+                log.warning("remote prefill failed for %s (%s); falling "
+                            "back to local", context.id, exc)
+                return None
+        return None
 
 
 async def build_disagg_decode(drt, engine, *, namespace: str = "dynamo",

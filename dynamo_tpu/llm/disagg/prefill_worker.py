@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
-from ...runtime import tracing
+from ...runtime import guard, tracing
 from ...runtime.engine import Context
 from ..protocols.common import (PreprocessedRequest, SamplingOptions,
                                 StopConditions)
@@ -64,7 +64,14 @@ class PrefillWorker:
         self._stopped = False
         self.completed = 0
         self.failed = 0
+        self.expired = 0            # jobs dropped: budget spent in-queue
         self.client_evictions = 0
+        # shared retry/breaker plane (replaces the PR 2 ad-hoc
+        # evict-and-retry-once): sends to a decode engine run under the
+        # RetryPolicy (budget-aware), and a per-engine circuit breaker
+        # fails jobs fast while an engine's transfer endpoint stays dead
+        self.retry = guard.RetryPolicy.from_env()
+        self.breakers = guard.BreakerBoard(f"prefill-worker:{namespace}")
         # per-stage transfer-pipeline accounting, shared by all clients
         self.xfer = TransferStats()
 
@@ -109,6 +116,15 @@ class PrefillWorker:
         pages = None
         tracing.bind_request_id(req.request_id)
         tracer = tracing.get_tracer()
+        # rebuild the job's deadline against this host's clock (absent on
+        # the wire = no deadline); a job whose budget died in the queue is
+        # dropped outright — the decode side has already fallen back
+        deadline = guard.Deadline.from_wire_ms(req.deadline_ms)
+        if deadline is not None and deadline.expired:
+            self.expired += 1
+            log.warning("dropping expired remote prefill job %s "
+                        "(budget spent in queue)", req.request_id)
+            return
         try:
             pre = PreprocessedRequest(
                 token_ids=list(req.token_ids),
@@ -126,11 +142,19 @@ class PrefillWorker:
                 first, pages = await self.engine.prefill_only(pre, ctx)
                 fsp.set_attribute("pages", len(pages))
 
+            if deadline is not None and deadline.expired:
+                # budget died during the prefill compute: shipping now
+                # cannot beat the decode side's (already fired) fallback —
+                # drop instead of racing a doomed transfer
+                self.expired += 1
+                log.warning("dropping remote prefill job %s after compute "
+                            "(budget spent)", req.request_id)
+                return
             ps = self.engine.ecfg.page_size
             n_prompt_pages = math.ceil(len(req.token_ids) / ps)
             local_send = pages[req.skip_pages:n_prompt_pages]
             remote_dst = req.page_ids[req.skip_pages:n_prompt_pages]
-            await self._send(req, local_send, remote_dst, first)
+            await self._send(req, local_send, remote_dst, first, deadline)
             self.completed += 1
         except Exception:  # noqa: BLE001 — a bad job must not kill the loop
             self.failed += 1
@@ -141,15 +165,20 @@ class PrefillWorker:
                 await self.engine.release_pages(pages)
 
     async def _send(self, req: RemotePrefillRequest, local_send: List[int],
-                    remote_dst: List[int], first: int) -> None:
+                    remote_dst: List[int], first: int,
+                    deadline: Optional[guard.Deadline] = None) -> None:
         """Ship the pages, surviving a decode-worker restart: the cached
-        client may point at a dead host:port, so on failure evict it,
-        re-resolve the endpoint from DCP, and retry once with a fresh
-        connection before giving up on the job. Stage times accumulate
-        into a per-send TransferStats (exact per-request trace spans) and
-        fold into the shared ``self.xfer`` totals afterwards."""
+        client may point at a dead host:port, so each failed attempt
+        evicts it, re-resolves the endpoint from DCP, and retries with a
+        fresh connection under the shared RetryPolicy (budget-aware —
+        never past the job's deadline). A per-engine circuit breaker
+        fails jobs fast while an engine's endpoint stays dead. Stage
+        times accumulate into a per-send TransferStats (exact per-request
+        trace spans) and fold into the shared ``self.xfer`` totals
+        afterwards."""
         tracer = tracing.get_tracer()
         per = TransferStats()
+        br = self.breakers.get("transfer", req.engine_id)
         span = tracer.start_span(
             "kv_transfer.send", parent=req.trace_ctx,
             attributes={"engine_id": f"{req.engine_id:x}",
@@ -157,21 +186,37 @@ class PrefillWorker:
                         "chunk_pages": self.chunk_pages})
         try:
             with span:
-                client = await self._client(req.engine_id)
-                try:
-                    await self._send_once(client, req, local_send,
-                                          remote_dst, first, per)
-                except asyncio.CancelledError:
-                    raise
-                except Exception as exc:  # noqa: BLE001 — retry fresh
-                    self._evict(req.engine_id, client)
-                    self.client_evictions += 1
-                    log.warning("KV send for %s to engine %x failed (%s); "
-                                "re-resolving endpoint and retrying",
-                                req.request_id, req.engine_id, exc)
+                if not br.allow():
+                    raise guard.NoCapacity(
+                        f"transfer endpoint for engine {req.engine_id:x} "
+                        f"is circuit-broken")
+                last: Optional[BaseException] = None
+                sent = False
+                async for _attempt in self.retry.attempts(deadline):
                     client = await self._client(req.engine_id)
-                    await self._send_once(client, req, local_send,
-                                          remote_dst, first, per)
+                    try:
+                        await self._send_once(client, req, local_send,
+                                              remote_dst, first, per,
+                                              deadline)
+                        br.record_success()
+                        sent = True
+                        break
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:  # noqa: BLE001 — retry fresh
+                        self._evict(req.engine_id, client)
+                        self.client_evictions += 1
+                        last = exc
+                        log.warning("KV send for %s to engine %x failed "
+                                    "(%s); re-resolving endpoint and "
+                                    "retrying within budget",
+                                    req.request_id, req.engine_id, exc)
+                if not sent:
+                    br.record_failure()
+                    raise last if last is not None else \
+                        guard.DeadlineExceeded(
+                            f"no budget left to send KV for "
+                            f"{req.request_id}")
                 span.set_attribute("bytes", per.bytes_sent)
                 span.set_attribute("chunks", per.chunks_sent)
                 # adopt the measured stage accumulators as child spans
@@ -190,13 +235,17 @@ class PrefillWorker:
     async def _send_once(self, client: KvTransferClient,
                          req: RemotePrefillRequest, local_send: List[int],
                          remote_dst: List[int], first: int,
-                         stats: TransferStats) -> None:
+                         stats: TransferStats,
+                         deadline: Optional[guard.Deadline] = None) -> None:
+        # the decode side's commit-ack wait is capped by the remaining
+        # request budget (None → the registered default)
+        timeout = None if deadline is None else max(deadline.cap(None), 0.05)
         cp = self.chunk_pages
         if cp and local_send:
             n_chunks = math.ceil(len(local_send) / cp)
             frames = self._frames(local_send, remote_dst, cp, stats)
             await client.send_kv_chunked(req.request_id, n_chunks, frames,
-                                         first, stats=stats)
+                                         first, timeout=timeout, stats=stats)
         else:
             t0 = time.monotonic()
             k, v = await self.engine.extract_pages(local_send)
@@ -207,6 +256,7 @@ class PrefillWorker:
             # with the chunked pipeline (whose wall covers extraction)
             stats.wall_seconds += dt
             await client.send_kv(req.request_id, remote_dst, k, v, first,
+                                 timeout=timeout,
                                  compress=self.compress_kv, stats=stats)
 
     async def _frames(self, local_send: List[int], remote_dst: List[int],
@@ -258,7 +308,9 @@ class PrefillWorker:
 
     def stats(self) -> dict:
         return {"inflight": len(self._tasks), "completed": self.completed,
-                "failed": self.failed,
+                "failed": self.failed, "expired_jobs": self.expired,
                 "client_evictions": self.client_evictions,
+                "transfer_breakers_open":
+                    len(self.breakers.not_closed("transfer")),
                 "chunk_pages": self.chunk_pages,
                 **{f"kv_send_{k}": v for k, v in self.xfer.to_dict().items()}}

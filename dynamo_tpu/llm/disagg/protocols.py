@@ -32,6 +32,10 @@ class RemotePrefillRequest:
     # spans join the same trace. Absent on the wire = no parent (old
     # peers interoperate unchanged).
     trace_ctx: Optional[dict] = None
+    # remaining request budget (ms) at enqueue time: the prefill worker
+    # drops jobs whose budget is spent and caps its ack waits by what is
+    # left. Absent on the wire = no deadline (legacy peers unchanged).
+    deadline_ms: Optional[int] = None
 
     def to_dict(self) -> dict:
         d = {
@@ -45,6 +49,8 @@ class RemotePrefillRequest:
         }
         if self.trace_ctx is not None:
             d["trace_ctx"] = self.trace_ctx
+        if self.deadline_ms is not None:
+            d["deadline_ms"] = int(self.deadline_ms)
         return wire.checked(wire.PREFILL_REMOTE_REQUEST, d)
 
     @classmethod
@@ -57,4 +63,5 @@ class RemotePrefillRequest:
                    page_ids=list(d.get("page_ids", [])),
                    skip_pages=int(d.get("skip_pages", 0)),
                    engine_id=int(d.get("engine_id", 0)),
-                   trace_ctx=d.get("trace_ctx"))
+                   trace_ctx=d.get("trace_ctx"),
+                   deadline_ms=d.get("deadline_ms"))

@@ -40,11 +40,21 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ...runtime import codec, tracing, wire
+from ...runtime import codec, guard, tracing, wire
 from ...runtime.codec import TwoPartMessage
+from ...runtime.config import env_float
 from ...runtime.dcp_client import DcpClient
 
 log = logging.getLogger("dynamo_tpu.llm.disagg")
+
+
+def _io_timeout() -> float:
+    return env_float("DYN_IO_TIMEOUT", 30.0) or 30.0
+
+
+def _ack_timeout(timeout: Optional[float]) -> float:
+    return timeout if timeout is not None \
+        else (env_float("DYN_REQUEST_TIMEOUT", 60.0) or 60.0)
 
 
 def metadata_key(namespace: str, engine_id: int) -> str:
@@ -178,7 +188,7 @@ class KvTransferServer:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+            await asyncio.wait_for(self._server.wait_closed(), _io_timeout())
         # drop established connections too — a stop() is a restart from the
         # sender's point of view, and senders probe liveness through the
         # socket, not the (gone) listener
@@ -240,7 +250,10 @@ class KvTransferServer:
         try:
             while True:
                 try:
-                    msg = await codec.decode(reader)
+                    # idle ingest read: frames arrive whenever a prefill
+                    # worker sends; stream lifetime == connection lifetime
+                    msg = await codec.decode(reader)  # dynalint: disable=unbounded-await
+                    await guard.chaos_point("kv.recv", writer)
                 except (asyncio.IncompleteReadError, ConnectionError,
                         codec.CodecError):
                     return
@@ -272,7 +285,10 @@ class KvTransferServer:
                     async with wlock:
                         writer.write(codec.encode(
                             TwoPartMessage(header=nack)))
-                        await writer.drain()
+                        # frame atomicity needs the lock across the
+                        # (bounded) drain
+                        await asyncio.wait_for(  # dynalint: disable=lock-across-blocking
+                            writer.drain(), _io_timeout())
                     continue
                 if kind == "abort":
                     st = self._ingests.get(rid)
@@ -311,7 +327,9 @@ class KvTransferServer:
         no longer serializes the whole transfer plane."""
         try:
             while True:
-                msg = await st.queue.get()
+                # bounded by the connection: _on_conn cancels this task
+                # the moment the conn drops, so the wait cannot outlive it
+                msg = await st.queue.get()  # dynalint: disable=unbounded-await
                 if msg is None:  # sender abort
                     self.streams_failed += 1
                     self._fail_waiter(request_id, RuntimeError(
@@ -374,7 +392,10 @@ class KvTransferServer:
                         ack.update(ok=False, error=st.error)
                 async with wlock:
                     writer.write(codec.encode(TwoPartMessage(header=ack)))
-                    await writer.drain()
+                    # frame atomicity needs the lock across the (bounded)
+                    # drain
+                    await asyncio.wait_for(  # dynalint: disable=lock-across-blocking
+                        writer.drain(), _io_timeout())
                 if final:
                     return
         except asyncio.CancelledError:
@@ -473,8 +494,12 @@ class KvTransferClient:
     async def _ensure(self) -> None:
         async with self._conn_lock:
             if self._writer is None or self._writer.is_closing():
-                self._reader, self._writer = await asyncio.open_connection(
-                    self.host, self.port)
+                await guard.chaos_point("kv.connect")
+                # the connect lock only guards (re)connection, never an
+                # ack wait; the connect itself is bounded
+                self._reader, self._writer = await asyncio.wait_for(  # dynalint: disable=lock-across-blocking
+                    asyncio.open_connection(self.host, self.port),
+                    _io_timeout())
                 self._ack_task = asyncio.ensure_future(
                     self._ack_loop(self._reader, self._writer))
 
@@ -484,7 +509,9 @@ class KvTransferClient:
         pending send so none of them idles out its timeout."""
         try:
             while True:
-                msg = await codec.decode(reader)
+                # idle demux read: senders bound their own ack waits; this
+                # loop lives exactly as long as the connection
+                msg = await codec.decode(reader)  # dynalint: disable=unbounded-await
                 ack = wire.decoded(wire.KV_TRANSFER_ACK, msg.header)
                 q = self._pending.get(ack.get("request_id"))
                 if q is not None:
@@ -522,7 +549,7 @@ class KvTransferClient:
 
     async def send_kv(self, request_id: str, page_ids, k: np.ndarray,
                       v: np.ndarray, first_token: int,
-                      timeout: float = 60.0,
+                      timeout: Optional[float] = None,
                       compress: bool = False,
                       stats: Optional[TransferStats] = None) -> None:
         """Bulk mode (``chunk_pages=0``): ship all pages
@@ -534,6 +561,7 @@ class KvTransferClient:
         so the receiver restores into its pool dtype. ``stats`` overrides
         the accumulator (per-send accounting for trace spans)."""
         st = stats if stats is not None else self.stats
+        timeout = _ack_timeout(timeout)
         header, parts = _bulk_frame(request_id, page_ids, k, v,
                                     first_token, compress)
         tc = tracing.get_tracer().current_trace_ctx()
@@ -543,9 +571,10 @@ class KvTransferClient:
         t_wall = time.monotonic()
         try:
             await self._ensure()
+            await guard.chaos_point("kv.send", self._writer)
             t0 = time.monotonic()
             self._writer.writelines(codec.encode_parts(header, parts))
-            await self._writer.drain()
+            await asyncio.wait_for(self._writer.drain(), _io_timeout())
             now = time.monotonic()
             st.wire_seconds += now - t0
             st.bytes_sent += sum(p.nbytes for p in parts)
@@ -559,7 +588,7 @@ class KvTransferClient:
 
     async def send_kv_chunked(self, request_id: str, n_chunks: int, frames,
                               first_token: int,
-                              timeout: float = 60.0,
+                              timeout: Optional[float] = None,
                               stats: Optional[TransferStats] = None) -> None:
         """Streamed mode: consume ``frames`` — an async iterator yielding
         ``(dst_page_ids, header_extra, body_parts, nbytes)`` per chunk —
@@ -571,6 +600,7 @@ class KvTransferClient:
         (which fails the decode-side waiter → immediate local fallback).
         ``stats`` overrides the accumulator (per-send accounting)."""
         st = stats if stats is not None else self.stats
+        timeout = _ack_timeout(timeout)
         tc = tracing.get_tracer().current_trace_ctx()
         q = self._register(request_id)
         t_wall = time.monotonic()
@@ -600,9 +630,10 @@ class KvTransferClient:
                     header["first_token"] = int(first_token)
                     if tc is not None:  # commit chunk carries the trace ctx
                         header["trace"] = tc
+                await guard.chaos_point("kv.send", self._writer)
                 t0 = time.monotonic()
                 self._writer.writelines(codec.encode_parts(header, parts))
-                await self._writer.drain()
+                await asyncio.wait_for(self._writer.drain(), _io_timeout())
                 st.wire_seconds += time.monotonic() - t0
                 st.bytes_sent += nbytes
                 st.chunks_sent += 1
@@ -648,7 +679,7 @@ class KvTransferClient:
                 self._writer.writelines(codec.encode_parts(
                     wire.checked(wire.KV_TRANSFER_ABORT, {
                         "kind": "abort", "request_id": request_id})))
-                await self._writer.drain()
+                await asyncio.wait_for(self._writer.drain(), _io_timeout())
         except Exception:  # noqa: BLE001 — the conn may be the failure
             pass
 

@@ -155,6 +155,11 @@ class Metrics:
         _h("stage_duration_seconds", "histogram",
            "Per-stage request durations from dyntrace spans")
         self.stage.render(lines, f"{PREFIX}_stage_duration_seconds", "stage")
+        # dynaguard plane: route-fallback/hedge/deadline counters + per-
+        # endpoint circuit-breaker state gauges (guard.render_prom_lines)
+        from ...runtime import guard
+
+        lines.extend(guard.render_prom_lines())
         return "\n".join(lines) + "\n"
 
 

@@ -19,7 +19,8 @@ from typing import AsyncIterator, Callable, Dict, Optional
 
 from aiohttp import web
 
-from ...runtime import tracing
+from ...runtime import guard, tracing
+from ...runtime.dcp_client import NoRespondersError
 from ...runtime.engine import Annotated, Context
 from ...runtime.tasks import spawn_tracked
 from ..protocols.openai import (ChatAggregator, ChatCompletionRequest,
@@ -173,9 +174,12 @@ class HttpService:
                          f"{sorted(engines)}", hdrs)
             span.set_attribute("model", req.model)
             span.set_attribute("stream", bool(req.stream))
-            guard = self.metrics.guard(
+            mguard = self.metrics.guard(
                 req.model, endpoint, "stream" if req.stream else "unary")
-            ctx = Context(rid)
+            # end-to-end deadline: `timeout` body field (seconds) beats the
+            # X-Request-Deadline-Ms header beats the registered default
+            deadline = _request_deadline(request, req)
+            ctx = Context(rid, deadline=deadline)
             try:
                 t0 = time.monotonic()
                 n = getattr(req, "n", 1) or 1
@@ -184,16 +188,33 @@ class HttpService:
                 else:
                     aiter = engine(req, ctx).__aiter__()
                 # pull the first item BEFORE committing response headers so
-                # early failures (validation, routing) map to clean errors
+                # early failures (validation, routing) map to clean errors;
+                # the pull itself is bounded by the request deadline
                 try:
-                    first = await aiter.__anext__()
+                    first = await guard.bound(aiter.__anext__(),
+                                              deadline=deadline,
+                                              what="first response item")
                 except StopAsyncIteration:
                     first = None
                 if req.stream:
                     return await self._sse(request, req, first, aiter, ctx,
-                                           guard, t0, hdrs)
-                return await self._unary(req, first, aiter, endpoint, guard,
-                                         hdrs)
+                                           mguard, t0, hdrs, endpoint)
+                return await self._unary(req, first, aiter, endpoint,
+                                         mguard, hdrs, deadline)
+            except guard.DeadlineExceeded as e:
+                ctx.kill()  # release whatever is still running upstream
+                return _error_response(504, f"deadline exceeded: {e}",
+                                       hdrs, err_type="timeout_error")
+            except guard.NoCapacity as e:
+                # no live/healthy instance right now: retryable, tell the
+                # client when to come back — not a 500
+                return _error_response(
+                    503, str(e), {**hdrs, "Retry-After": "1"},
+                    err_type="overloaded_error")
+            except NoRespondersError as e:
+                return _error_response(
+                    503, str(e), {**hdrs, "Retry-After": "1"},
+                    err_type="overloaded_error")
             except ValueError as e:
                 return _error_response(400, str(e), hdrs)
             except (ConnectionResetError, asyncio.CancelledError):
@@ -202,11 +223,12 @@ class HttpService:
                 log.exception("request %s failed", ctx.id)
                 return _error_response(500, repr(e), hdrs)
             finally:
-                guard.done()
+                mguard.done()
 
     async def _sse(self, http_request: web.Request, req, first, aiter,
-                   ctx: Context, guard, t0: float,
-                   hdrs: Optional[dict] = None) -> web.StreamResponse:
+                   ctx: Context, mguard, t0: float,
+                   hdrs: Optional[dict] = None,
+                   endpoint: str = "completions") -> web.StreamResponse:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -251,15 +273,37 @@ class HttpService:
 
         try:
             if await _write_chunk(first):
-                async for chunk in aiter:
+                while True:
+                    # each pull is bounded by the request deadline: a
+                    # wedged upstream turns into a clean final timeout
+                    # chunk, never a hung stream
+                    try:
+                        chunk = await guard.bound(
+                            aiter.__anext__(), deadline=ctx.deadline,
+                            what="stream item")
+                    except StopAsyncIteration:
+                        break
                     if not await _write_chunk(chunk):
                         break
             if not errored:
                 await resp.write(b"data: [DONE]\n\n")
-                guard.mark_ok()
+                mguard.mark_ok()
         except (ConnectionResetError, asyncio.CancelledError):
             ctx.kill()  # client went away → propagate cancellation upstream
             raise
+        except guard.DeadlineExceeded:
+            # deadline ran out mid-stream and the engine chain could not
+            # emit its own finish: close the stream with a well-formed
+            # final chunk carrying finish_reason "timeout"
+            ctx.kill()
+            try:
+                await resp.write(
+                    b"data: " +
+                    json.dumps(_timeout_chunk(endpoint, req.model,
+                                              ctx.id)).encode() + b"\n\n")
+                await resp.write(b"data: [DONE]\n\n")
+            except (ConnectionError, RuntimeError):
+                pass
         except Exception as e:  # noqa: BLE001 — headers are committed; emit
             # an SSE error event instead of a second response
             log.exception("stream %s failed mid-flight", ctx.id)
@@ -273,12 +317,20 @@ class HttpService:
         return resp
 
     async def _unary(self, req, first, aiter, endpoint: str,
-                     guard, hdrs: Optional[dict] = None) -> web.Response:
+                     mguard, hdrs: Optional[dict] = None,
+                     deadline=None) -> web.Response:
         async def _items():
+            # every pull bounded by the request deadline: the 504 path in
+            # _serve handles the resulting DeadlineExceeded
             if first is not None:
                 yield first
-            async for chunk in aiter:
-                yield chunk
+            while True:
+                try:
+                    yield await guard.bound(aiter.__anext__(),
+                                            deadline=deadline,
+                                            what="response item")
+                except StopAsyncIteration:
+                    return
 
         if endpoint == "chat_completions":
             agg = ChatAggregator(req.model)
@@ -291,8 +343,15 @@ class HttpService:
                 from ..protocols.openai import ChatCompletionChunk
 
                 agg.add_chunk(ChatCompletionChunk(**data))
-            guard.mark_ok()
-            return web.json_response(agg.response().model_dump(exclude_none=True),
+            out = agg.response()
+            if any(c.finish_reason == "timeout" for c in out.choices):
+                # unary semantics: a partial answer is not an answer —
+                # deadline expiry maps to 504 (streams instead end with a
+                # finish_reason "timeout" chunk)
+                return _error_response(504, "deadline exceeded", hdrs,
+                                       err_type="timeout_error")
+            mguard.mark_ok()
+            return web.json_response(out.model_dump(exclude_none=True),
                                      headers=hdrs)
         agg = CompletionAggregator(req.model)
         async for chunk in _items():
@@ -310,8 +369,12 @@ class HttpService:
                 from ..protocols.openai import Usage
 
                 agg.usage = Usage(**data["usage"])
-        guard.mark_ok()
-        return web.json_response(agg.response().model_dump(exclude_none=True),
+        out = agg.response()
+        if any(c.finish_reason == "timeout" for c in out.choices):
+            return _error_response(504, "deadline exceeded", hdrs,
+                                   err_type="timeout_error")
+        mguard.mark_ok()
+        return web.json_response(out.model_dump(exclude_none=True),
                                  headers=hdrs)
 
 
@@ -370,7 +433,9 @@ async def _fanout_choices(engine, req, ctx: Context, n: int):
     usage_template = None
     try:
         while live:
-            i, item = await queue.get()
+            # bounded by the request deadline (504/timeout-chunk upstream)
+            i, item = await guard.bound(queue.get(), deadline=ctx.deadline,
+                                        what="fanout item")
             if item is DONE:
                 live -= 1
                 continue
@@ -492,9 +557,44 @@ def _chunk_dict(chunk) -> Optional[dict]:
     return chunk
 
 
+def _request_deadline(http_request: web.Request, req):
+    """Resolve the request's end-to-end deadline: `timeout` body field
+    (seconds) > X-Request-Deadline-Ms header > DYN_REQUEST_DEADLINE_MS
+    registered default > none."""
+    body_timeout = getattr(req, "timeout", None)
+    if body_timeout is not None and body_timeout > 0:
+        return guard.Deadline.after_s(float(body_timeout))
+    hdr = (http_request.headers.get("X-Request-Deadline-Ms") or "").strip()
+    if hdr:
+        try:
+            return guard.Deadline.from_wire_ms(float(hdr))
+        except ValueError:
+            log.warning("ignoring malformed X-Request-Deadline-Ms %r", hdr)
+    return guard.default_deadline()
+
+
+def _timeout_chunk(endpoint: str, model: str, rid: str) -> dict:
+    """Well-formed final SSE chunk closing a stream whose deadline
+    expired before the engine chain could emit its own finish."""
+    import time as _time
+
+    if endpoint == "chat_completions":
+        return {"id": f"chatcmpl-{rid}", "object": "chat.completion.chunk",
+                "created": int(_time.time()), "model": model,
+                "choices": [{"index": 0, "delta": {},
+                             "finish_reason": "timeout"}]}
+    return {"id": f"cmpl-{rid}", "object": "text_completion",
+            "created": int(_time.time()), "model": model,
+            "choices": [{"index": 0, "text": "",
+                         "finish_reason": "timeout"}]}
+
+
 def _error_response(status: int, message: str,
-                    headers: Optional[dict] = None) -> web.Response:
+                    headers: Optional[dict] = None,
+                    err_type: Optional[str] = None) -> web.Response:
+    if err_type is None:
+        err_type = ("invalid_request_error" if status < 500
+                    else "internal_error")
     return web.json_response(
-        {"error": {"message": message, "type": "invalid_request_error"
-                   if status < 500 else "internal_error", "code": status}},
+        {"error": {"message": message, "type": err_type, "code": status}},
         status=status, headers=headers)

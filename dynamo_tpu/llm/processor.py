@@ -14,6 +14,7 @@ import time
 import uuid
 from typing import AsyncIterator, Optional
 
+from ..runtime import guard
 from ..runtime.component import Client
 from ..runtime.dcp_client import NoRespondersError
 from ..runtime.engine import Context
@@ -36,24 +37,34 @@ class _RemoteTokenEngine:
         self.client = client
         self.worker_id = worker_id
 
-    async def generate(self, request: PreprocessedRequest, context: Context):
+    async def _dispatch(self, request: PreprocessedRequest,
+                        context: Context):
+        """Route the request: the KV-routed direct pick first, then the
+        shared RetryPolicy's round-robin path (``Client.generate``
+        retries under the policy, budget-aware, with per-instance
+        breakers). The fallback is counted — not silent — as
+        ``dyn_llm_route_fallback_total``."""
         if self.worker_id is not None:
             try:
-                stream = await self.client.direct(request.to_dict(),
-                                                  self.worker_id,
-                                                  context=context)
+                return await self.client.direct(request.to_dict(),
+                                                self.worker_id,
+                                                context=context)
+            except guard.DeadlineExceeded:
+                raise
             except (RuntimeError, NoRespondersError) as e:
                 # the routed worker vanished between the router's scrape
-                # and the direct call (drain/crash churn): any live
-                # worker beats a 500 — the prefix-overlap win is gone,
-                # correctness is not
+                # and the direct call (drain/crash churn), or its breaker
+                # is open: any live worker beats a 500 — the
+                # prefix-overlap win is gone, correctness is not
+                guard.counter_inc("dyn_llm_route_fallback_total",
+                                  reason=type(e).__name__)
                 log.warning("direct route to %x failed (%s); falling "
                             "back to round-robin", self.worker_id, e)
-                stream = await self.client.round_robin(request.to_dict(),
-                                                       context=context)
-        else:
-            stream = await self.client.round_robin(request.to_dict(),
-                                                   context=context)
+        return await self.client.round_robin(request.to_dict(),
+                                             context=context)
+
+    async def generate(self, request: PreprocessedRequest, context: Context):
+        stream = await self._dispatch(request, context)
         try:
             async for env in stream:
                 if env.is_error:

@@ -125,6 +125,9 @@ FINISH_EOS = "eos"
 FINISH_STOP = "stop"
 FINISH_LENGTH = "length"
 FINISH_CANCELLED = "cancelled"
+# the request's end-to-end deadline expired: cancelled by the budget, not
+# the caller — clients see finish_reason "timeout" / HTTP 504
+FINISH_TIMEOUT = "timeout"
 FINISH_ERROR = "error"
 
 

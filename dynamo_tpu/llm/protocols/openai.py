@@ -69,6 +69,9 @@ class ChatCompletionRequest(BaseModel):
     top_logprobs: Optional[int] = None
     user: Optional[str] = None
     min_tokens: Optional[int] = None
+    # end-to-end request deadline in SECONDS (dynaguard); overrides the
+    # X-Request-Deadline-Ms header and the DYN_REQUEST_DEADLINE_MS default
+    timeout: Optional[float] = None
     ext: Optional[Ext] = None
     # accept the reference's field name too
     nvext: Optional[Ext] = None
@@ -106,6 +109,9 @@ class CompletionRequest(BaseModel):
     echo: bool = False
     user: Optional[str] = None
     min_tokens: Optional[int] = None
+    # end-to-end request deadline in SECONDS (dynaguard); overrides the
+    # X-Request-Deadline-Ms header and the DYN_REQUEST_DEADLINE_MS default
+    timeout: Optional[float] = None
     ext: Optional[Ext] = None
     nvext: Optional[Ext] = None
 
@@ -194,10 +200,14 @@ class ModelList(BaseModel):
 
 
 def _finish_reason_openai(reason: Optional[str]) -> Optional[str]:
+    """Engine finish reason → client-visible OpenAI finish_reason.
+    "cancelled" and "timeout" pass through distinctly (the seed collapsed
+    cancelled→stop, which hid deadline expiry from clients entirely)."""
     if reason is None:
         return None
     return {"eos": "stop", "stop": "stop", "length": "length",
-            "cancelled": "stop", "error": "error"}.get(reason, reason)
+            "cancelled": "cancelled", "timeout": "timeout",
+            "error": "error"}.get(reason, reason)
 
 
 class ChatDeltaGenerator:

@@ -199,11 +199,15 @@ class MetricsAggregator:
             f'{self.consecutive_scrape_failures}')
         evicted = len(self._client.evicted_ids()) if self._client else 0
         lines.append("# HELP dyn_metrics_evicted_instances instances "
-                     "quarantined off the stats plane after consecutive "
-                     "probe failures (stale-endpoint hygiene)")
+                     "whose stats-plane circuit breaker is open after "
+                     "consecutive probe failures (stale-endpoint hygiene)")
         lines.append("# TYPE dyn_metrics_evicted_instances gauge")
         lines.append(f'dyn_metrics_evicted_instances{{namespace="{ns}"}} '
                      f'{evicted}')
+        # dynaguard plane: per-endpoint breaker state gauges + counters
+        from ..runtime import guard
+
+        lines.extend(guard.render_prom_lines())
         return "\n".join(lines) + "\n"
 
 

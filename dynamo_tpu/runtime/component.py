@@ -15,10 +15,12 @@ import asyncio
 import logging
 import random
 from dataclasses import dataclass
-from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
+from typing import (Any, AsyncIterator, Awaitable, Callable, Dict, List,
+                    Optional, Tuple)
 
-from . import tracing, wire
+from . import guard, tracing, wire
 from .codec import TwoPartMessage
+from .config import env_float, env_int
 from .dcp_client import DcpClient, Message, NoRespondersError, pack, unpack
 from .engine import Annotated, Context
 from .tasks import cancel_join, spawn_tracked
@@ -268,6 +270,10 @@ class ServeHandle:
             # dyntrace wire propagation: absent field = no parent (old
             # peers interoperate unchanged)
             trace_ctx = envelope.get("trace")
+            # deadline propagation: absent field = no deadline (legacy
+            # peer); the value is the REMAINING budget at the sender's
+            # send time, rebuilt against this host's clock
+            deadline_ms = envelope.get("deadline_ms")
         except Exception as e:  # noqa: BLE001
             if msg.needs_reply:
                 await msg.respond_error(f"bad request envelope: {e!r}")
@@ -276,13 +282,16 @@ class ServeHandle:
             await msg.respond(pack(wire.checked(wire.DCP_REQUEST_ACK, {
                 "accepted": True,
                 "instance_id": self.instance.instance_id})))
-        spawn_tracked(self._run_request(req_id, conn_info, request, trace_ctx),
+        spawn_tracked(self._run_request(req_id, conn_info, request, trace_ctx,
+                                        deadline_ms),
                       name=f"serve-{req_id}")
 
     async def _run_request(self, req_id: str, conn_info: TcpConnectionInfo,
                            request: Any,
-                           trace_ctx: Optional[dict] = None) -> None:
-        ctx = Context(req_id)
+                           trace_ctx: Optional[dict] = None,
+                           deadline_ms: Optional[int] = None) -> None:
+        ctx = Context(req_id,
+                      deadline=guard.Deadline.from_wire_ms(deadline_ms))
         self._inflight[req_id] = ctx
         tracing.bind_request_id(req_id)
         tracer = tracing.get_tracer()
@@ -339,16 +348,32 @@ class AsyncResponseStream:
         return self
 
     async def __anext__(self) -> Annotated:
-        item = await self._pending.queue.get()
+        # the stream read is bounded by the request deadline: a wedged
+        # worker costs the caller its remaining budget, never forever
+        try:
+            item = await guard.bound(self._pending.queue.get(),
+                                     deadline=self.context.deadline,
+                                     what="response stream read")
+        except guard.DeadlineExceeded:
+            self.context.kill()
+            await self._pending.send_ctrl("kill")
+            self._pending.close()
+            raise
         if item is STREAM_COMPLETE:
             self._pending.close()
             raise StopAsyncIteration
         if isinstance(item, StreamError):
             self._pending.close()
-            # client-error kinds re-raise as ValueError so frontends map
-            # them to 4xx; everything else is a server-side RuntimeError
+            # typed re-raise by worker-side exception kind: client-error
+            # kinds map to 4xx, deadline/capacity kinds keep their type
+            # across the hop so frontends answer 504/503 — everything
+            # else is a server-side RuntimeError
             if item.kind in ("ValueError", "ValidationError"):
                 raise ValueError(item.message)
+            if item.kind == "DeadlineExceeded":
+                raise guard.DeadlineExceeded(item.message)
+            if item.kind in ("NoCapacity", "NoRespondersError"):
+                raise guard.NoCapacity(item.message)
             raise RuntimeError(
                 f"stream error ({item.kind or 'unknown'}): {item.message}")
         return Annotated.from_dict(unpack(item))
@@ -371,12 +396,14 @@ class Client:
     live instance list, and routes ``random`` / ``round_robin`` / ``direct``.
     """
 
-    # consecutive stats-plane failures before an instance is quarantined
+    # consecutive stats-plane failures before an instance's breaker opens
+    # (the PR 6 quarantine, now the shared CircuitBreaker implementation)
     STATS_EVICTION_THRESHOLD = 3
-    # evicted instances are re-probed every Nth collect_stats round
+    # an open breaker offers a half-open probe every Nth denied round
     STATS_RETRY_EVERY = 5
 
-    def __init__(self, drt, address: EndpointAddress):
+    def __init__(self, drt, address: EndpointAddress,
+                 retry: Optional[guard.RetryPolicy] = None):
         self.drt = drt
         self.address = address
         self.instances: Dict[int, EndpointInstance] = {}
@@ -384,16 +411,22 @@ class Client:
         self._watch_task: Optional[asyncio.Task] = None
         self._rr = 0
         self._instances_event = asyncio.Event()
-        # stale-endpoint hygiene: an instance whose stats plane keeps
-        # failing (crashed worker with a live lease, wedged process,
-        # scrape blackout) is quarantined off the scrape-target list so
-        # the collectors stop paying per-round failures for it. It stays
-        # in ``instances`` — discovery, not one client's probe history,
-        # owns membership — and rejoins scraping on a successful periodic
-        # re-probe or a fresh discovery put.
-        self._stats_failures: Dict[int, int] = {}
-        self._stats_evicted: set = set()
-        self._stats_rounds = 0
+        # per-endpoint circuit breakers, one per (plane, instance):
+        # "stats" guards the scrape plane (a crashed-but-leased worker
+        # stops costing every round a failed probe), "request" guards
+        # routing (a dead instance stops receiving picks). Discovery,
+        # not breaker state, owns membership: instances stay in
+        # ``instances`` and a fresh discovery put resets their breakers.
+        self.breakers = guard.BreakerBoard(
+            f"client:{address}",
+            guard.BreakerConfig(
+                threshold=env_int("DYN_BREAKER_THRESHOLD",
+                                  self.STATS_EVICTION_THRESHOLD) or 3,
+                probe_every=env_int("DYN_BREAKER_PROBE_EVERY",
+                                    self.STATS_RETRY_EVERY) or 5,
+                reset_after_s=env_float("DYN_BREAKER_RESET_S", 0.0) or 0.0))
+        # shared retry policy: route resolution, dispatch, stats scrapes
+        self.retry = retry or guard.RetryPolicy.from_env()
 
     async def _start(self) -> None:
         prefix = instance_prefix(self.address.namespace, self.address.component,
@@ -412,10 +445,10 @@ class Client:
         async for ev in self._watch:
             if ev.event == "put":
                 inst = EndpointInstance.from_dict(unpack(ev.value))
-                # a fresh discovery record clears any quarantine: the
-                # worker re-registered, so probe it again
-                self._stats_evicted.discard(inst.instance_id)
-                self._stats_failures.pop(inst.instance_id, None)
+                # a fresh discovery record closes the instance's
+                # breakers: the worker re-registered, so probe it again
+                self.breakers.reset("stats", inst.instance_id)
+                self.breakers.reset("request", inst.instance_id)
                 self.instances[inst.instance_id] = inst
                 self._instances_event.set()
             elif ev.event == "delete":
@@ -425,8 +458,8 @@ class Client:
                 except ValueError:
                     continue
                 self.instances.pop(wid, None)
-                self._stats_evicted.discard(wid)
-                self._stats_failures.pop(wid, None)
+                self.breakers.drop("stats", wid)
+                self.breakers.drop("request", wid)
                 if not self.instances:
                     self._instances_event.clear()
 
@@ -444,37 +477,99 @@ class Client:
 
     # ------------------------------------------------------------- routing
 
-    def _pick(self, mode: str, instance_id: Optional[int]) -> Optional[str]:
-        """Returns the request-plane subject for the chosen route."""
+    def _pick(self, mode: str, instance_id: Optional[int]
+              ) -> Tuple[int, str]:
+        """Returns ``(instance_id, subject)`` for the chosen route.
+        Instances whose request-plane breaker is open are skipped
+        (half-open single probes are admitted); when the breaker blocks
+        every live instance the caller gets a typed :class:`NoCapacity`
+        (HTTP 503), not a hang or a 500."""
         ids = self.instance_ids()
         if mode == "direct":
             if instance_id not in self.instances:
                 raise RuntimeError(
                     f"instance {instance_id:x} of {self.address} not found"
                     if instance_id is not None else "direct() needs instance_id")
-            return self.instances[instance_id].subject
+            if not self.breakers.get("request", instance_id).allow():
+                raise guard.NoCapacity(
+                    f"instance {instance_id:x} of {self.address} is "
+                    f"circuit-broken")
+            return instance_id, self.instances[instance_id].subject
         if not ids:
             raise NoRespondersError(f"no live instances of {self.address}")
+        avail = [i for i in ids if self.breakers.get("request", i).allow()]
+        if not avail:
+            raise guard.NoCapacity(
+                f"all {len(ids)} instances of {self.address} are "
+                f"circuit-broken")
         if mode == "random":
-            return self.instances[random.choice(ids)].subject
-        if mode == "round_robin":
-            subject = self.instances[ids[self._rr % len(ids)]].subject
+            wid = random.choice(avail)
+        elif mode == "round_robin":
+            wid = avail[self._rr % len(avail)]
             self._rr += 1
-            return subject
-        raise ValueError(f"unknown routing mode {mode}")
+        else:
+            raise ValueError(f"unknown routing mode {mode}")
+        for i in avail:  # hand back unused half-open probe permits
+            if i != wid:
+                self.breakers.get("request", i).release_probe()
+        return wid, self.instances[wid].subject
 
     async def generate(self, request: Any, *, mode: str = "round_robin",
                        instance_id: Optional[int] = None,
                        context: Optional[Context] = None,
-                       timeout: float = 60.0) -> AsyncResponseStream:
+                       timeout: Optional[float] = None,
+                       retry: Optional[guard.RetryPolicy] = None
+                       ) -> AsyncResponseStream:
         """Issue a request; returns the streaming response.
 
         Reference egress/push.rs:83-181 — registers the local response
         stream, sends the request (with call-home connection info) over the
         request plane, awaits the worker's ack.
+
+        Route resolution and dispatch run under the shared
+        :class:`~dynamo_tpu.runtime.guard.RetryPolicy` (budget-aware:
+        attempts never outlive ``context.deadline``); each attempt's ack
+        wait is capped by the remaining deadline, and per-instance
+        request breakers record the outcome. ``direct`` mode never
+        retries — the caller (the processor) owns its fallback.
         """
-        subject = self._pick(mode, instance_id)
         ctx = context or Context()
+        deadline = ctx.deadline
+        if timeout is None:
+            timeout = env_float("DYN_REQUEST_TIMEOUT", 60.0) or 60.0
+        policy = retry or self.retry
+        last: Optional[BaseException] = None
+        async for _attempt in policy.attempts(deadline):
+            try:
+                wid, subject = self._pick(mode, instance_id)
+            except (NoRespondersError, guard.NoCapacity) as e:
+                if mode == "direct":
+                    raise
+                last = e
+                continue  # instances may (re)appear within the budget
+            try:
+                return await self._dispatch(wid, subject, request, ctx,
+                                            timeout, deadline)
+            except asyncio.CancelledError:
+                raise
+            except guard.DeadlineExceeded:
+                raise
+            except Exception as e:  # noqa: BLE001 — ack timeout/refusal
+                self.breakers.get("request", wid).record_failure()
+                if mode == "direct":
+                    raise
+                last = e
+                log.warning("dispatch to instance %x of %s failed (%s); "
+                            "retrying within budget", wid, self.address, e)
+        raise last if last is not None else NoRespondersError(
+            f"no live instances of {self.address}")
+
+    async def _dispatch(self, wid: int, subject: str, request: Any,
+                        ctx: Context, timeout: float,
+                        deadline) -> AsyncResponseStream:
+        """One dispatch attempt: register the response stream, send the
+        envelope (deadline budget re-stamped at send time), await the
+        worker's ack bounded by min(timeout, remaining budget)."""
         server: TcpStreamServer = await self.drt.tcp_server()
         pending = server.register()
         env_dict = {
@@ -485,16 +580,22 @@ class Client:
         trace_ctx = tracing.get_tracer().current_trace_ctx()
         if trace_ctx is not None:  # omitted entirely when not sampled
             env_dict["trace"] = trace_ctx
+        if deadline is not None:  # absent on the wire = no deadline
+            env_dict["deadline_ms"] = deadline.to_wire_ms()
         envelope = pack(wire.checked(wire.DCP_REQUEST_ENVELOPE, env_dict))
         try:
             ack = wire.decoded(wire.DCP_REQUEST_ACK, unpack(
-                await self.drt.dcp.request(subject, envelope,
-                                           timeout=timeout)))
+                await guard.bound(
+                    self.drt.dcp.request(subject, envelope,
+                                         timeout=timeout),
+                    timeout=timeout, deadline=deadline,
+                    what=f"request ack from {self.address}")))
             if not ack.get("accepted"):
                 raise RuntimeError(f"request rejected: {ack}")
-        except Exception:
+        except BaseException:
             pending.close()
             raise
+        self.breakers.get("request", wid).record_success()
         return AsyncResponseStream(pending, ctx)
 
     async def round_robin(self, request: Any, **kw) -> AsyncResponseStream:
@@ -509,56 +610,42 @@ class Client:
     # ------------------------------------------------------------- stats
 
     def evicted_ids(self) -> List[int]:
-        """Instances quarantined off the stats plane (crashed-but-leased
-        or blacked-out workers); they rejoin via a successful re-probe or
-        a fresh discovery put."""
-        return sorted(self._stats_evicted)
+        """Instances whose stats-plane breaker is not closed (crashed-
+        but-leased or blacked-out workers): off the scrape targets until
+        a half-open probe succeeds or a fresh discovery put resets them.
+        Only live-discovered instances are reported."""
+        return sorted(wid for wid in self.instances
+                      if self.breakers.get("stats", wid).state
+                      != guard.BREAKER_CLOSED)
 
-    def _note_stats_ok(self, inst: EndpointInstance) -> None:
-        self._stats_failures.pop(inst.instance_id, None)
-        if inst.instance_id in self._stats_evicted:
-            log.info("instance %x of %s answered again; restoring to the "
-                     "scrape targets", inst.instance_id, self.address)
-            self._stats_evicted.discard(inst.instance_id)
-
-    def _note_stats_failure(self, inst: EndpointInstance) -> None:
-        wid = inst.instance_id
-        n = self._stats_failures.get(wid, 0) + 1
-        self._stats_failures[wid] = n
-        if wid not in self._stats_evicted \
-                and n >= self.STATS_EVICTION_THRESHOLD:
-            # crashed-but-leased worker: its discovery record outlives
-            # the process (keepalive thread / long TTL), so every scrape
-            # round would keep paying a failed probe for it — quarantine
-            # it off the scrape-target list. Discovery membership (and
-            # therefore routing) is untouched: that is owned by the
-            # instance records, not by one client's probe history.
-            log.warning(
-                "instance %x of %s failed %d consecutive stats probes; "
-                "evicting from scrape targets", wid, self.address, n)
-            self._stats_evicted.add(wid)
-
-    async def collect_stats(self, timeout: float = 2.0) -> Dict[int, dict]:
+    async def collect_stats(self, timeout: Optional[float] = None
+                            ) -> Dict[int, dict]:
         """Scrape per-instance stats over the request plane (reference
         service.rs collect_services / $SRV.STATS).
 
-        Instances that fail ``STATS_EVICTION_THRESHOLD`` consecutive
-        probes are quarantined off the scrape-target list (stale-endpoint
-        hygiene under fleet churn); quarantined instances are re-probed
-        every ``STATS_RETRY_EVERY``-th round and restored on success."""
-        self._stats_rounds += 1
-        retry_round = (self._stats_evicted
-                       and self._stats_rounds % self.STATS_RETRY_EVERY == 0)
+        Each instance's probe runs behind its stats-plane circuit
+        breaker: ``STATS_EVICTION_THRESHOLD`` consecutive failed rounds
+        open it (the instance stops costing every round a failed probe),
+        an open breaker admits a single half-open re-probe every
+        ``STATS_RETRY_EVERY``-th round, and a success closes it again.
+        A failed probe is retried within the round under the shared
+        RetryPolicy before it counts against the breaker."""
+        if timeout is None:
+            timeout = env_float("DYN_STATS_TIMEOUT", 2.0) or 2.0
         targets = [i for i in sorted(self.instances.values(),
                                      key=lambda i: i.instance_id)
-                   if retry_round
-                   or i.instance_id not in self._stats_evicted]
+                   if self.breakers.get("stats", i.instance_id).allow()]
+
+        async def _probe(inst: EndpointInstance) -> dict:
+            return wire.decoded(wire.DCP_STATS_REPLY, unpack(
+                await self.drt.dcp.request(
+                    f"stats.{inst.subject}", b"", timeout=timeout)))
 
         async def _one(inst: EndpointInstance) -> Optional[dict]:
             try:
-                return wire.decoded(wire.DCP_STATS_REPLY, unpack(
-                    await self.drt.dcp.request(
-                        f"stats.{inst.subject}", b"", timeout=timeout)))
+                return await self.retry.run(
+                    lambda: _probe(inst), retry_on=(Exception,),
+                    what=f"stats probe {inst.instance_id:x}")
             except Exception:
                 log.debug("stats probe failed for instance %x of %s",
                           inst.instance_id, self.address, exc_info=True)
@@ -569,9 +656,19 @@ class Client:
         # consumers — router scheduler, planner — see a deterministic view
         out: Dict[int, dict] = {}
         for inst, resp in zip(targets, replies):
+            br = self.breakers.get("stats", inst.instance_id)
+            was_open = br.state != guard.BREAKER_CLOSED
             if resp is None:
-                self._note_stats_failure(inst)
+                br.record_failure()
+                if not was_open and br.state == guard.BREAKER_OPEN:
+                    log.warning(
+                        "instance %x of %s failed %d consecutive stats "
+                        "rounds; breaker open (off the scrape targets)",
+                        inst.instance_id, self.address, br.cfg.threshold)
             else:
-                self._note_stats_ok(inst)
+                br.record_success()
+                if was_open:
+                    log.info("instance %x of %s answered again; breaker "
+                             "closed", inst.instance_id, self.address)
                 out[inst.instance_id] = resp
         return out

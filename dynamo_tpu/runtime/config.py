@@ -45,6 +45,21 @@ def register_env(name: str, default: Optional[str], component: str,
 # Keep alphabetical within each component block; docs/env_vars.md renders
 # straight from this table.
 
+register_env("DYN_BREAKER_PROBE_EVERY", "5", "runtime",
+             "Circuit breakers: an OPEN breaker offers a single half-open "
+             "probe every Nth denied call (deterministic cadence; works "
+             "on stepped virtual time).")
+register_env("DYN_BREAKER_RESET_S", "0", "runtime",
+             "Circuit breakers: additionally offer the half-open probe "
+             "once this many seconds have passed since opening "
+             "(0 = count-based cadence only).")
+register_env("DYN_BREAKER_THRESHOLD", "3", "runtime",
+             "Circuit breakers: consecutive failures that flip an "
+             "endpoint's breaker closed→open.")
+register_env("DYN_CHAOS", None, "runtime",
+             "Chaos-injection scenario for the real transports, e.g. "
+             "'seed=42;sever:kv.send@after=1;delay:tcp.send@ms=50,p=0.2' "
+             "(grammar in docs/robustness.md). Unset = no chaos.")
 register_env("DYN_CONFIG_PATH", None, "runtime",
              "Path to a YAML/JSON RuntimeConfig overlay file.")
 register_env("DYN_DCP_ADDRESS", None, "runtime",
@@ -52,12 +67,31 @@ register_env("DYN_DCP_ADDRESS", None, "runtime",
              "in-process server; CLIs fall back to 127.0.0.1:6650.")
 register_env("DYN_LEASE_TTL", "10.0", "runtime",
              "Primary-lease TTL in seconds (worker liveness).")
+register_env("DYN_IO_TIMEOUT", "30.0", "runtime",
+             "Bound (seconds) on single network IO steps: connects, "
+             "handshakes, socket-buffer drains. A dead peer fails a hop "
+             "in this long instead of wedging it forever.")
 register_env("DYN_LOG", "INFO", "runtime",
              "Root log level (DEBUG/INFO/WARNING/...).")
 register_env("DYN_LOGGING_JSONL", "0", "runtime",
              "Emit JSONL structured logs instead of text (1/true).")
+register_env("DYN_REQUEST_DEADLINE_MS", "0", "runtime",
+             "Default end-to-end request deadline in milliseconds, "
+             "applied at the HTTP frontend when the request carries "
+             "neither a `timeout` body field nor an X-Request-Deadline-Ms "
+             "header. 0 = no implicit deadline.")
 register_env("DYN_REQUEST_TIMEOUT", "60.0", "runtime",
              "Default request-plane timeout in seconds.")
+register_env("DYN_RETRY_BASE_MS", "50", "runtime",
+             "RetryPolicy: decorrelated-jitter backoff base in ms.")
+register_env("DYN_RETRY_CAP_MS", "2000", "runtime",
+             "RetryPolicy: backoff ceiling in ms.")
+register_env("DYN_RETRY_MAX_ATTEMPTS", "3", "runtime",
+             "RetryPolicy: total attempts (first try included) for route "
+             "resolution, remote-prefill dispatch, and stats scrapes. "
+             "Retries never run past the request deadline.")
+register_env("DYN_STATS_TIMEOUT", "2.0", "runtime",
+             "Per-instance stats-plane scrape probe timeout in seconds.")
 register_env("DYN_STEP_TIMELINE", "512", "runtime",
              "Engine step-timeline ring capacity (events kept per engine "
              "for /v1/traces); 0 disables the timeline.")
@@ -86,6 +120,15 @@ register_env("DYN_KV_TRANSFER_CHUNK_PAGES", "4", "llm/disagg",
 register_env("DYN_KV_TRANSFER_INT8", "0", "llm/disagg",
              "int8-compress shipped KV pages (~half the DCN bytes; "
              "lossy). 1/true enables.")
+register_env("DYN_PREFILL_TIMEOUT", "120.0", "llm/disagg",
+             "Decode-side cap (seconds) on one remote-prefill wait "
+             "(enqueue to KV commit); the request deadline caps it "
+             "further. On expiry the request falls back to local "
+             "prefill.")
+register_env("DYN_REDISPATCH_MAX", "2", "llm/disagg",
+             "Max remote-prefill dispatches per request (first + hedged "
+             "re-enqueues after a fast transfer-plane failure, e.g. a "
+             "prefill worker dying mid-transfer). 1 disables hedging.")
 
 register_env("DYN_FLEET_DISCOVERY_TIMEOUT", "10.0", "fleet",
              "Fleet simulator: wall-clock seconds to wait for spawned/"

@@ -18,8 +18,13 @@ from typing import AsyncIterator, Awaitable, Callable, Dict, List, Optional, Tup
 import msgpack
 
 from . import wire
+from .config import env_float
 from .dcp_server import pack_frame, read_frame
 from .tasks import cancel_join, spawn_tracked
+
+
+def _io_timeout() -> float:
+    return env_float("DYN_IO_TIMEOUT", 30.0) or 30.0
 
 log = logging.getLogger("dynamo_tpu.dcp.client")
 
@@ -76,7 +81,8 @@ class DcpClient:
     async def connect(cls, address: str) -> "DcpClient":
         self = cls()
         host, _, port = address.rpartition(":")
-        self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), _io_timeout())
         self._rx_task = spawn_tracked(self._rx_loop(),
                                       name=f"dcp-client-rx-{address}")
         self.address = address
@@ -88,7 +94,8 @@ class DcpClient:
         if self._writer:
             try:
                 self._writer.close()
-                await self._writer.wait_closed()
+                await asyncio.wait_for(self._writer.wait_closed(),
+                                       _io_timeout())
             except Exception:
                 pass
         for fut in self._pending.values():
@@ -105,7 +112,9 @@ class DcpClient:
     async def _rx_loop(self) -> None:
         try:
             while True:
-                msg = await read_frame(self._reader)
+                # idle demux read: every RPC bounds its own reply future;
+                # this loop lives exactly as long as the connection
+                msg = await read_frame(self._reader)  # dynalint: disable=unbounded-await
                 if "push" in msg:
                     await self._on_push(msg)
                 else:
@@ -154,7 +163,10 @@ class DcpClient:
     async def _send_raw(self, msg: dict) -> None:
         async with self._wlock:
             self._writer.write(pack_frame(msg))
-            await self._writer.drain()
+            # bounded drain under the frame lock: atomicity needs the
+            # lock held across the write, DYN_IO_TIMEOUT bounds it
+            await asyncio.wait_for(  # dynalint: disable=lock-across-blocking
+                self._writer.drain(), _io_timeout())
 
     async def _call(self, op: str, timeout: Optional[float] = None, **kw) -> dict:
         if self._closed:
@@ -352,7 +364,9 @@ class PrefixWatch:
     async def __anext__(self) -> WatchEvent:
         if self._stopped:
             raise StopAsyncIteration
-        ev = await self._queue.get()
+        # a watch stream is unbounded by design; server death enqueues a
+        # None sentinel (rx loop finally), so this can never wedge
+        ev = await self._queue.get()  # dynalint: disable=unbounded-await
         if ev is None:
             raise StopAsyncIteration
         return ev

@@ -53,11 +53,13 @@ def pack_frame(msg: dict) -> bytes:
 
 
 async def read_frame(reader: asyncio.StreamReader) -> dict:
-    hdr = await reader.readexactly(4)
+    # the DCP frame-read primitive (DL011 anchor): callers bound their
+    # `await read_frame(...)` or justify an idle server/demux read
+    hdr = await reader.readexactly(4)  # dynalint: disable=unbounded-await
     n = int.from_bytes(hdr, "big")
     if n > MAX_FRAME:
         raise ValueError(f"frame too large: {n}")
-    body = await reader.readexactly(n)
+    body = await reader.readexactly(n)  # dynalint: disable=unbounded-await
     return msgpack.unpackb(body, raw=False)
 
 
@@ -133,8 +135,11 @@ class _Conn:
             while True:
                 msg = await self._outq.get()
                 self.writer.write(pack_frame(msg))
-                await self.writer.drain()
-        except (ConnectionError, RuntimeError, asyncio.CancelledError):
+                # a consumer that stops reading long enough to block the
+                # drain past the IO bound is dead: drop the connection
+                await asyncio.wait_for(self.writer.drain(), 30.0)
+        except (ConnectionError, RuntimeError, asyncio.CancelledError,
+                asyncio.TimeoutError):
             self.alive = False
 
     async def send(self, msg: dict) -> None:
@@ -254,7 +259,9 @@ class DcpServer:
         self._conns[conn.id] = conn
         try:
             while True:
-                msg = await read_frame(reader)
+                # idle server read: a control-plane client is allowed to
+                # sit quiet; conn close / lease expiry bound the session
+                msg = await read_frame(reader)  # dynalint: disable=unbounded-await
                 if msg.get("op") in self._BLOCKING_OPS:
                     spawn_tracked(self._dispatch(conn, msg),
                                   name=f"dcp-op-{msg.get('op')}")

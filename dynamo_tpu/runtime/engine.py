@@ -23,27 +23,44 @@ from typing import Any, AsyncIterator, Optional, Protocol, runtime_checkable
 
 
 class Context:
-    """Per-request context: id + cancellation controls.
+    """Per-request context: id + cancellation controls + deadline.
 
     ``stop_generating`` asks for a graceful early finish (emit what you have);
     ``kill`` demands immediate termination (reference engine.rs:47-85).
+    ``deadline`` (a :class:`~dynamo_tpu.runtime.guard.Deadline`, or None)
+    is the request's end-to-end budget: once it expires, ``stopped``
+    reports True, so every loop that already polls cancellation — engine
+    admission, decode dispatch, the detokenizing backend — enforces the
+    deadline with no extra plumbing, and the sequence's pages free on the
+    normal cancel path.
     """
 
-    __slots__ = ("id", "_stop", "_kill", "annotations")
+    __slots__ = ("id", "_stop", "_kill", "annotations", "deadline")
 
-    def __init__(self, request_id: Optional[str] = None):
+    def __init__(self, request_id: Optional[str] = None, deadline=None):
         self.id: str = request_id or uuid.uuid4().hex
         self._stop = asyncio.Event()
         self._kill = asyncio.Event()
         self.annotations: dict = {}
+        self.deadline = deadline
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and self.deadline.expired
 
     @property
     def stopped(self) -> bool:
-        return self._stop.is_set() or self._kill.is_set()
+        return self._stop.is_set() or self._kill.is_set() or self.expired
 
     @property
     def killed(self) -> bool:
         return self._kill.is_set()
+
+    def cancel_reason(self) -> str:
+        """Finish reason for a cancelled request: "timeout" when the
+        deadline (not the caller) ended it — the satellite the OpenAI
+        finish_reason mapping surfaces to clients."""
+        return "timeout" if self.expired else "cancelled"
 
     def stop_generating(self) -> None:
         self._stop.set()

@@ -24,11 +24,18 @@ import uuid
 from dataclasses import dataclass
 from typing import Dict, Optional
 
-from . import wire
+from . import guard, wire
 from .codec import TwoPartMessage, decode, encode
+from .config import env_float
 from .tasks import cancel_join, spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.tcp")
+
+
+def _io_timeout() -> float:
+    """Bound on single IO steps (connect/handshake/drain): a dead peer
+    fails a hop in DYN_IO_TIMEOUT instead of wedging it forever."""
+    return env_float("DYN_IO_TIMEOUT", 30.0) or 30.0
 
 # sentinel objects pushed into the receive queue
 STREAM_COMPLETE = object()
@@ -90,8 +97,10 @@ class PendingStream:
             try:
                 self._writer.write(encode(TwoPartMessage(wire.checked(
                     wire.TCP_CTRL, {"t": "ctrl", "kind": kind}))))
-                await self._writer.drain()
-            except (ConnectionError, RuntimeError):
+                # frame atomicity needs the lock across the (bounded) drain
+                await asyncio.wait_for(  # dynalint: disable=lock-across-blocking
+                    self._writer.drain(), _io_timeout())
+            except (ConnectionError, RuntimeError, asyncio.TimeoutError):
                 pass
 
     def close(self) -> None:
@@ -158,7 +167,7 @@ class TcpStreamServer:
         ps: Optional[PendingStream] = None
         self._writers.add(writer)
         try:
-            hello = await asyncio.wait_for(decode(reader), 30.0)
+            hello = await asyncio.wait_for(decode(reader), _io_timeout())
             hh = wire.decoded(wire.TCP_HELLO, hello.header)
             if hh.get("t") != "hello":
                 raise ValueError(f"bad handshake: {hh}")
@@ -168,11 +177,14 @@ class TcpStreamServer:
                 writer.write(encode(TwoPartMessage(wire.checked(
                     wire.TCP_ERR,
                     {"t": "err", "message": f"unknown stream {subject}"}))))
-                await writer.drain()
+                await asyncio.wait_for(writer.drain(), _io_timeout())
                 return
             ps._attach(writer)
             while True:
-                msg = await decode(reader)
+                # idle server read: a response stream legitimately waits
+                # as long as the worker generates; the REQUEST's deadline
+                # bounds the consumer side (AsyncResponseStream)
+                msg = await decode(reader)  # dynalint: disable=unbounded-await
                 mh = wire.decoded(
                     (wire.TCP_DATA, wire.TCP_COMPLETE, wire.TCP_ERR),
                     msg.header)
@@ -223,10 +235,12 @@ class TcpCallHome:
 
     @classmethod
     async def connect(cls, info: TcpConnectionInfo, on_ctrl=None,
-                      timeout: float = 30.0) -> "TcpCallHome":
+                      timeout: Optional[float] = None) -> "TcpCallHome":
+        await guard.chaos_point("tcp.connect")
         host, _, port = info.address.rpartition(":")
         reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(host, int(port)), timeout)
+            asyncio.open_connection(host, int(port)),
+            timeout if timeout is not None else _io_timeout())
         self = cls(reader, writer, on_ctrl)
         await self._send(TwoPartMessage(wire.checked(
             wire.TCP_HELLO, {"t": "hello", "subject": info.subject})))
@@ -235,7 +249,9 @@ class TcpCallHome:
     async def _ctrl_loop(self) -> None:
         try:
             while True:
-                msg = await decode(self._reader)
+                # ctrl frames arrive whenever the caller chooses; this
+                # read lives exactly as long as the connection
+                msg = await decode(self._reader)  # dynalint: disable=unbounded-await
                 ch = wire.decoded(wire.TCP_CTRL, msg.header)
                 if ch.get("t") == "ctrl" and self._on_ctrl is not None:
                     self._on_ctrl(ch.get("kind"))
@@ -246,9 +262,12 @@ class TcpCallHome:
                 self._on_ctrl("disconnect")
 
     async def _send(self, msg: TwoPartMessage) -> None:
+        await guard.chaos_point("tcp.send", self._writer)
         async with self._wlock:
             self._writer.write(encode(msg))
-            await self._writer.drain()
+            # frame atomicity needs the lock across the (bounded) drain
+            await asyncio.wait_for(  # dynalint: disable=lock-across-blocking
+                self._writer.drain(), _io_timeout())
 
     async def send_data(self, body: bytes) -> None:
         await self._send(TwoPartMessage(
@@ -266,7 +285,7 @@ class TcpCallHome:
         await cancel_join(self._ctrl_task)
         try:
             self._writer.close()
-            await self._writer.wait_closed()
+            await asyncio.wait_for(self._writer.wait_closed(), _io_timeout())
         except Exception:
             pass
 

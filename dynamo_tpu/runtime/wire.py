@@ -256,7 +256,7 @@ def validate_outgoing(header: dict) -> None:
 # --- DCP request plane (runtime/component.py) ------------------------------
 
 DCP_REQUEST_ENVELOPE = register_frame(
-    "dcp.request_envelope", version=2,
+    "dcp.request_envelope", version=3,
     doc="Request-plane envelope a Client sends to a served endpoint; the "
         "response streams back over the TCP call-home connection named in "
         "`conn`.",
@@ -267,6 +267,9 @@ DCP_REQUEST_ENVELOPE = register_frame(
         ("payload", "bytes", "required", 1, "msgpack-packed request body"),
         ("trace", "dict", "optional", 2,
          "dyntrace ctx {trace_id, span_id}; absent = not sampled"),
+        ("deadline_ms", "int", "optional", 3,
+         "remaining end-to-end budget in ms at send time (each hop "
+         "re-stamps what is left); absent = no deadline"),
     ])
 
 DCP_REQUEST_ACK = register_frame(
@@ -330,7 +333,7 @@ DCP_PUSH_REQ = register_frame(
 # --- disaggregated prefill queue (llm/disagg/protocols.py) -----------------
 
 PREFILL_REMOTE_REQUEST = register_frame(
-    "prefill.remote_request", version=2,
+    "prefill.remote_request", version=3,
     doc="One queued remote-prefill job (decode worker -> prefill queue -> "
         "any prefill worker).",
     fields=[
@@ -346,6 +349,10 @@ PREFILL_REMOTE_REQUEST = register_frame(
          "decode engine instance id (transfer-endpoint lookup key)"),
         ("trace_ctx", "dict", "optional", 2,
          "dyntrace ctx of the decode-side request; absent = no parent"),
+        ("deadline_ms", "int", "optional", 3,
+         "remaining request budget in ms at enqueue time; the prefill "
+         "worker drops jobs whose budget is spent and caps its ack "
+         "waits by what remains. Absent = no deadline"),
     ])
 
 # --- KV transfer plane (llm/disagg/transfer.py) ----------------------------

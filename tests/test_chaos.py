@@ -1,0 +1,945 @@
+"""dynaguard: deadlines, retry policy, circuit breakers, chaos injection.
+
+The acceptance contract (ISSUE 7): under injected prefill crash, transfer
+sever, and worker blackout, every request either completes within its
+deadline or fails fast with a TYPED error (HTTP 504/503, finish_reason
+"timeout") — zero hangs, zero waits that outlive the request budget; all
+breaker transitions deterministic under an injected clock; everything on
+CPU against the REAL transports (DCP + TCP call-home + KV transfer).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.runtime import guard
+from dynamo_tpu.runtime.engine import Context
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_chaos():
+    """Each test opts into chaos explicitly; none leaks between tests."""
+    guard.set_chaos(None)
+    yield
+    guard.set_chaos(None)
+
+
+# ------------------------------------------------------------------ deadline
+
+
+def test_deadline_decrements_and_expires():
+    clk = FakeClock()
+    d = guard.Deadline.after_ms(1000, clock=clk)
+    assert not d.expired and d.remaining_ms() == 1000
+    clk.advance(0.4)
+    assert 599 <= d.to_wire_ms() <= 600       # hop re-stamps what is left
+    clk.advance(0.7)
+    assert d.expired and d.remaining_s() == 0.0
+    assert d.to_wire_ms() == 1                # floor: never "no deadline"
+    with pytest.raises(guard.DeadlineExceeded):
+        d.check("test")
+
+
+def test_deadline_wire_roundtrip_and_absent():
+    clk = FakeClock()
+    assert guard.Deadline.from_wire_ms(None, clock=clk) is None
+    assert guard.Deadline.from_wire_ms(0, clock=clk) is None
+    d = guard.Deadline.from_wire_ms(250, clock=clk)
+    assert d.cap(10.0) == pytest.approx(0.25)
+    assert d.cap(0.1) == pytest.approx(0.1)
+
+
+def test_deadline_is_timeout_error():
+    """except asyncio.TimeoutError must catch budget exhaustion."""
+    assert issubclass(guard.DeadlineExceeded, asyncio.TimeoutError)
+
+
+def test_bound_raises_deadline_not_plain_timeout(run_async):
+    async def main():
+        d = guard.Deadline.after_ms(30)
+        with pytest.raises(guard.DeadlineExceeded):
+            await guard.bound(asyncio.sleep(5), deadline=d)
+        # plain timeout (no deadline) keeps the plain TimeoutError type
+        with pytest.raises(asyncio.TimeoutError) as ei:
+            await guard.bound(asyncio.sleep(5), timeout=0.01)
+        assert not isinstance(ei.value, guard.DeadlineExceeded)
+
+    run_async(main())
+
+
+def test_context_stopped_includes_expiry():
+    clk = FakeClock()
+    ctx = Context("r", deadline=guard.Deadline.after_ms(100, clock=clk))
+    assert not ctx.stopped and ctx.cancel_reason() == "cancelled"
+    clk.advance(0.2)
+    assert ctx.stopped and ctx.expired
+    assert ctx.cancel_reason() == "timeout"
+
+
+# -------------------------------------------------------------- retry policy
+
+
+def test_retry_policy_budget_aware(run_async):
+    """Backoffs are decorrelated-jitter bounded, and the policy never
+    sleeps (or retries) past the deadline."""
+    import random
+
+    slept = []
+
+    async def fake_sleep(s):
+        slept.append(s)
+        clk.advance(s)
+
+    clk = FakeClock()
+    pol = guard.RetryPolicy(max_attempts=5, base_s=0.1, cap_s=0.5,
+                            rng=random.Random(0), sleep=fake_sleep)
+
+    async def main():
+        # plenty of budget: all attempts run
+        attempts = [i async for i in pol.attempts(None)]
+        assert attempts == [0, 1, 2, 3, 4]
+        assert len(slept) == 4
+        assert all(0.1 <= s <= 0.5 for s in slept)
+        # tiny budget: first attempt always runs, no retry can be afforded
+        slept.clear()
+        d = guard.Deadline.after_ms(50, clock=clk)
+        attempts = [i async for i in pol.attempts(d)]
+        assert attempts == [0] and slept == []
+
+    run_async(main())
+
+
+def test_retry_run_reraises_last_and_propagates_deadline(run_async):
+    async def main():
+        pol = guard.RetryPolicy(max_attempts=3, base_s=0.001, cap_s=0.002)
+        calls = []
+
+        async def flaky():
+            calls.append(1)
+            raise ValueError(f"boom {len(calls)}")
+
+        with pytest.raises(ValueError, match="boom 3"):
+            await pol.run(flaky, what="flaky")
+        assert len(calls) == 3
+
+        async def too_slow():
+            raise guard.DeadlineExceeded("spent")
+
+        calls.clear()
+
+        async def once():
+            calls.append(1)
+            raise guard.DeadlineExceeded("spent")
+
+        with pytest.raises(guard.DeadlineExceeded):
+            await pol.run(once)
+        assert len(calls) == 1  # deadline errors are never retried
+
+    run_async(main())
+
+
+# ----------------------------------------------------------- circuit breaker
+
+
+def test_breaker_transitions_deterministic_under_injected_clock():
+    clk = FakeClock()
+    br = guard.CircuitBreaker(
+        guard.BreakerConfig(threshold=3, probe_every=4, reset_after_s=0.0),
+        clock=clk)
+    # closed: failures below threshold keep admitting
+    assert br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == guard.BREAKER_CLOSED and br.allow()
+    br.record_failure()                      # third consecutive → open
+    assert br.state == guard.BREAKER_OPEN and br.opened_total == 1
+    # open: denies, then the probe_every-th denial converts to the single
+    # half-open probe
+    assert [br.allow() for _ in range(3)] == [False, False, False]
+    assert br.allow() is True                # 4th call: half-open probe
+    assert br.state == guard.BREAKER_HALF_OPEN
+    assert br.allow() is False               # single probe: no second admit
+    br.record_failure()                      # failed probe → straight open
+    assert br.state == guard.BREAKER_OPEN and br.opened_total == 2
+    assert [br.allow() for _ in range(3)] == [False] * 3
+    assert br.allow() is True                # next probe window
+    br.record_success()                      # probe succeeded → closed
+    assert br.state == guard.BREAKER_CLOSED
+    assert br.failures == 0 and br.allow()
+
+
+def test_breaker_clock_based_probe():
+    clk = FakeClock()
+    br = guard.CircuitBreaker(
+        guard.BreakerConfig(threshold=1, probe_every=0, reset_after_s=5.0),
+        clock=clk)
+    br.record_failure()
+    assert br.state == guard.BREAKER_OPEN
+    assert not br.allow()
+    clk.advance(4.9)
+    assert not br.allow()
+    clk.advance(0.2)                         # reset_after elapsed
+    assert br.allow() and br.state == guard.BREAKER_HALF_OPEN
+    br.record_success()
+    assert br.state == guard.BREAKER_CLOSED
+
+
+def test_breaker_release_probe_hands_back_the_slot():
+    clk = FakeClock()
+    br = guard.CircuitBreaker(
+        guard.BreakerConfig(threshold=1, probe_every=1), clock=clk)
+    br.record_failure()
+    assert br.allow() and br.state == guard.BREAKER_HALF_OPEN
+    assert not br.allow()
+    br.release_probe()                       # picked another instance
+    assert br.allow()                        # slot available again
+
+
+# -------------------------------------------------------------- chaos parser
+
+
+def test_chaos_spec_parse():
+    seed, rules = guard.parse_chaos(
+        "seed=42;sever:kv.send@after=1;delay:tcp.send@ms=50,p=0.25;"
+        "drop:kv.recv@nth=3,times=1")
+    assert seed == 42 and len(rules) == 3
+    sever, delay, drop = rules
+    assert (sever.action, sever.point, sever.after) == ("sever", "kv.send", 1)
+    assert (delay.ms, delay.p) == (50.0, 0.25)
+    assert (drop.nth, drop.times) == (3, 1)
+
+
+def test_chaos_spec_rejects_malformed():
+    with pytest.raises(ValueError):
+        guard.parse_chaos("explode:kv.send")
+    with pytest.raises(ValueError):
+        guard.parse_chaos("drop:kv.send@wat=1")
+
+
+def test_chaos_rules_fire_deterministically(run_async):
+    async def main():
+        inj = guard.set_chaos("seed=1;drop:x.point@nth=2,times=1")
+        await guard.chaos_point("x.point")           # hit 1: no fire
+        with pytest.raises(guard.ChaosError):
+            await guard.chaos_point("x.point")       # hit 2: drop
+        await guard.chaos_point("x.point")           # times=1: spent
+        assert inj.injected[("x.point", "drop")] == 1
+
+    run_async(main())
+
+
+# ---------------------------------------------- engine: deadline frees pages
+
+
+def _tiny_engine(params=None, seed=2):
+    import jax
+
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.llama import init_params
+
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=8,
+                           hidden_size=32, vocab_size=128)
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_batch=4,
+                        prefill_chunk=32, batch_buckets=(1, 2, 4),
+                        prefill_buckets=(8, 32), page_buckets=(8,),
+                        watermark_pages=2)
+    if params is None:
+        params = init_params(cfg, jax.random.PRNGKey(seed))
+    return JaxEngine(cfg, ecfg, params=params), params
+
+
+def _req(tokens, max_tokens=6):
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+
+    return PreprocessedRequest(token_ids=tokens,
+                               sampling=SamplingOptions(),
+                               stop=StopConditions(max_tokens=max_tokens))
+
+
+async def _collect(engine, req, ctx):
+    toks = []
+    async for out in engine.generate(req, ctx):
+        toks.extend(out.token_ids)
+        if out.finish_reason is not None:
+            return toks, out.finish_reason
+    return toks, None
+
+
+def test_engine_expired_at_admission_cancels_and_frees_pages(run_async):
+    async def main():
+        engine, _ = _tiny_engine()
+        clk = FakeClock()
+        ctx = Context("exp", deadline=guard.Deadline.after_ms(5, clock=clk))
+        clk.advance(1.0)                       # expired before admission
+        baseline = engine.pm.active
+        toks, fin = await _collect(engine, _req(list(range(1, 20))), ctx)
+        assert fin == "timeout" and toks == []
+        assert engine.pm.active == baseline    # nothing leaked
+        await engine.stop()
+
+    run_async(main())
+
+
+def test_engine_mid_decode_expiry_finishes_timeout_and_frees(run_async):
+    async def main():
+        engine, _ = _tiny_engine()
+        clk = FakeClock()
+        ctx = Context("mid", deadline=guard.Deadline.after_ms(1000,
+                                                              clock=clk))
+        baseline = engine.pm.active
+        toks = []
+        fin = None
+        async for out in engine.generate(_req(list(range(1, 20)),
+                                              max_tokens=64), ctx):
+            toks.extend(out.token_ids)
+            if out.finish_reason is not None:
+                fin = out.finish_reason
+                break
+            if len(toks) >= 2:
+                clk.advance(2.0)               # budget dies mid-decode
+        assert fin == "timeout"
+        assert 0 < len(toks) < 64
+        # pages free on the cancel path (give the loop a tick to settle)
+        for _ in range(50):
+            if engine.pm.active == baseline:
+                break
+            await asyncio.sleep(0.05)
+        assert engine.pm.active == baseline
+        await engine.stop()
+
+    run_async(main())
+
+
+# -------------------------------- disagg: chaos on the real transfer plane
+
+
+def test_transfer_sever_mid_stream_hedge_recovers(run_async):
+    """kv.send severed on the SECOND chunk (a prefill worker dying
+    mid-transfer): the conn drop fails the decode waiter fast, the job is
+    hedged onto the queue, the second dispatch commits — the request
+    completes remotely with the exact local output, well inside the
+    prefill timeout."""
+
+    async def main():
+        from dynamo_tpu.llm.disagg import DisaggRouter, PrefillWorker
+        from dynamo_tpu.llm.disagg.decode import build_disagg_decode
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        engine, params = _tiny_engine(seed=4)
+        prompt = [(i * 7) % 100 + 1 for i in range(20)]
+        want, want_fin = await _collect(engine, _req(prompt), Context())
+        await engine.stop()
+
+        drt = await DistributedRuntime.detached()
+        try:
+            decode_eng, _ = _tiny_engine(params=params)
+            prefill_eng, _ = _tiny_engine(params=params)
+            router = DisaggRouter(max_local_prefill_length=4)
+            disagg = await build_disagg_decode(drt, decode_eng,
+                                               namespace="chaos",
+                                               router=router,
+                                               watch_config=False)
+            disagg.prefill_timeout = 30.0      # the hedge must beat this
+            pw = PrefillWorker(drt, prefill_eng, namespace="chaos",
+                               chunk_pages=1)
+            # one attempt per dispatch: the recovery under test is the
+            # decode-side hedge, not the worker's own send retry
+            pw.retry = guard.RetryPolicy(max_attempts=1)
+            pw.start()
+
+            guard.set_chaos("seed=7;sever:kv.send@nth=2")
+            t0 = time.monotonic()
+            got, fin = await asyncio.wait_for(
+                _collect(disagg, _req(prompt), Context()), timeout=25.0)
+            elapsed = time.monotonic() - t0
+            assert got == want and fin == want_fin
+            assert disagg.redispatches == 1            # hedged once
+            assert disagg.remote_fallbacks == 0        # …and it worked
+            assert pw.failed == 1 and pw.completed == 1
+            # fail-fast + hedge, not a prefill_timeout burn
+            assert elapsed < 15.0, f"hedge took {elapsed:.1f}s"
+
+            await pw.stop()
+            await disagg.transfer.stop()
+            await prefill_eng.stop()
+            await decode_eng.stop()
+        finally:
+            guard.set_chaos(None)
+            await drt.shutdown()
+
+    run_async(main())
+
+
+def test_transfer_dead_plane_respects_deadline(run_async):
+    """EVERY kv.send severed before the first frame: the decode side can
+    never hear a fail-fast (nothing reached the server), so the request
+    budget is what bounds the wait — the request finishes with
+    finish_reason "timeout" in ~deadline, never prefill_timeout."""
+
+    async def main():
+        from dynamo_tpu.llm.disagg import DisaggRouter, PrefillWorker
+        from dynamo_tpu.llm.disagg.decode import build_disagg_decode
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            decode_eng, params = _tiny_engine(seed=4)
+            prefill_eng, _ = _tiny_engine(params=params)
+            router = DisaggRouter(max_local_prefill_length=4)
+            disagg = await build_disagg_decode(drt, decode_eng,
+                                               namespace="dead",
+                                               router=router,
+                                               watch_config=False)
+            disagg.prefill_timeout = 30.0     # deliberately way past budget
+            pw = PrefillWorker(drt, prefill_eng, namespace="dead",
+                               chunk_pages=1)
+            pw.start()
+
+            guard.set_chaos("seed=13;sever:kv.send@after=1")
+            prompt = [(i * 7) % 100 + 1 for i in range(20)]
+            ctx = Context("dead-req",
+                          deadline=guard.Deadline.after_s(2.5))
+            t0 = time.monotonic()
+            toks, fin = await asyncio.wait_for(
+                _collect(disagg, _req(prompt), ctx), timeout=20.0)
+            elapsed = time.monotonic() - t0
+            assert fin == "timeout"
+            assert elapsed < 8.0, f"request outlived its budget ({elapsed:.1f}s)"
+            assert disagg.remote_fallbacks == 1
+
+            await pw.stop()
+            await disagg.transfer.stop()
+            await prefill_eng.stop()
+            await decode_eng.stop()
+        finally:
+            guard.set_chaos(None)
+            await drt.shutdown()
+
+    run_async(main())
+
+
+def test_decode_hedged_redispatch_then_local_fallback(run_async):
+    """A fast transfer-plane failure re-enqueues the job (hedge) before
+    falling back: with no worker to serve either dispatch, two queue
+    entries appear and the request still completes locally."""
+
+    async def main():
+        from dynamo_tpu.llm.disagg import DisaggRouter
+        from dynamo_tpu.llm.disagg.decode import build_disagg_decode
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            decode_eng, params = _tiny_engine(seed=5)
+            local_ref, _ = _tiny_engine(params=params)
+            prompt = [(i * 3) % 50 + 1 for i in range(20)]
+            want, _ = await _collect(local_ref, _req(prompt), Context())
+            await local_ref.stop()
+
+            router = DisaggRouter(max_local_prefill_length=4)
+            disagg = await build_disagg_decode(drt, decode_eng,
+                                               namespace="hedge",
+                                               router=router,
+                                               watch_config=False)
+            assert disagg.max_dispatches == 2   # DYN_REDISPATCH_MAX default
+
+            async def fail_waiters_fast():
+                # the "prefill worker died mid-transfer" signal, delivered
+                # through the real waiter plumbing for each dispatch
+                for _ in range(2):
+                    while not disagg.transfer._waiters:
+                        await asyncio.sleep(0.01)
+                    rid = next(iter(disagg.transfer._waiters))
+                    disagg.transfer._fail_waiter(
+                        rid, ConnectionError("worker died mid-transfer"))
+                    await asyncio.sleep(0.05)
+
+            failer = asyncio.ensure_future(fail_waiters_fast())
+            got, fin = await asyncio.wait_for(
+                _collect(disagg, _req(prompt), Context()), timeout=20.0)
+            await failer
+            assert got == want
+            assert disagg.redispatches == 1     # hedged exactly once
+            assert disagg.remote_fallbacks == 1
+            assert await disagg.queue.depth() == 2  # both dispatches queued
+
+            await disagg.transfer.stop()
+            await decode_eng.stop()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+def test_expired_job_dropped_by_prefill_worker(run_async):
+    """A job whose 1ms budget cannot survive the prefill compute is
+    dropped by the worker (counted as expired, not failed) instead of
+    racing a doomed transfer the decode side already abandoned."""
+
+    async def main():
+        from dynamo_tpu.llm.disagg import PrefillWorker, RemotePrefillRequest
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            prefill_eng, _ = _tiny_engine(seed=6)
+            pw = PrefillWorker(drt, prefill_eng, namespace="expired")
+            await pw.queue.put(RemotePrefillRequest(
+                request_id="dead", token_ids=list(range(1, 20)),
+                page_ids=[1, 2, 3], engine_id=1, deadline_ms=1))
+            pw.start()
+            for _ in range(200):
+                if pw.expired:
+                    break
+                await asyncio.sleep(0.05)
+            assert pw.expired == 1 and pw.failed == 0
+            await pw.stop()
+            await prefill_eng.stop()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+# ------------------------------ request plane: severed call-home, breakers
+
+
+def test_severed_callhome_is_typed_fail_fast(run_async):
+    """Chaos severs the worker's TCP call-home mid-stream: the client's
+    stream read raises a typed error promptly — never hangs."""
+
+    async def main():
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            async def handler(request, ctx):
+                for i in range(50):
+                    yield {"i": i}
+                    await asyncio.sleep(0.01)
+
+            ep = drt.namespace("sever").component("w").endpoint("gen")
+            handle = await ep.serve(handler)
+            client = await ep.client()
+
+            guard.set_chaos("seed=3;sever:tcp.send@nth=4")
+            stream = await client.round_robin({"x": 1})
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError):
+                async for _env in stream:
+                    pass
+            assert time.monotonic() - t0 < 10.0
+            await handle.stop()
+            await client.close()
+        finally:
+            guard.set_chaos(None)
+            await drt.shutdown()
+
+    run_async(main())
+
+
+def test_client_route_retry_waits_out_late_instance(run_async):
+    """Route resolution under the RetryPolicy: no instance at dispatch
+    time, one registers during the backoff window → the request succeeds
+    instead of 500ing."""
+
+    async def main():
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            ep = drt.namespace("late").component("w").endpoint("gen")
+            client = await ep.client()
+            client.retry = guard.RetryPolicy(max_attempts=8, base_s=0.05,
+                                             cap_s=0.2)
+
+            async def handler(request, ctx):
+                yield {"ok": True}
+
+            async def register_later():
+                await asyncio.sleep(0.3)
+                return await ep.serve(handler)
+
+            reg = asyncio.ensure_future(register_later())
+            stream = await client.round_robin({"x": 1})
+            out = [env.data async for env in stream]
+            assert out == [{"ok": True}]
+            handle = await reg
+            await handle.stop()
+            await client.close()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+def test_request_breaker_opens_and_recovers_via_discovery_put(run_async):
+    """Request-plane breaker: a dead-but-discovered instance stops being
+    picked after threshold failures (typed NoCapacity when it is the only
+    one), and a fresh discovery put closes the breaker."""
+
+    async def main():
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            async def handler(request, ctx):
+                yield {"ok": True}
+
+            ep = drt.namespace("brk").component("w").endpoint("gen")
+            handle = await ep.serve(handler)
+            client = await ep.client()
+            await client.wait_for_instances(timeout=5)
+            wid = client.instance_ids()[0]
+
+            # crash without deregistering: unsubscribe the handlers but
+            # keep the discovery record (crashed-but-leased worker)
+            for sid in handle._sids:
+                await drt.dcp.unsubscribe(sid)
+            handle._sids.clear()
+
+            client.retry = guard.RetryPolicy(max_attempts=1)
+            failures = 0
+            for _ in range(client.breakers.cfg.threshold):
+                with pytest.raises(Exception):
+                    await client.round_robin({"x": 1}, timeout=0.5)
+                failures += 1
+            br = client.breakers.get("request", wid)
+            assert br.state == guard.BREAKER_OPEN
+            # every instance circuit-broken → typed NoCapacity (503)
+            with pytest.raises((guard.NoCapacity, Exception)) as ei:
+                await client.round_robin({"x": 1}, timeout=0.5)
+            # re-register: discovery put must close the breaker
+            handle2 = await ep.serve(handler)
+            for _ in range(100):
+                if br.state == guard.BREAKER_CLOSED:
+                    break
+                await asyncio.sleep(0.02)
+            assert br.state == guard.BREAKER_CLOSED
+            stream = await client.round_robin({"x": 1})
+            assert [env.data async for env in stream] == [{"ok": True}]
+            await handle2.stop()
+            await client.close()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+# --------------------------------------------------- HTTP: 504 / 503 / SSE
+
+
+def _service_with(engine_fn, model="m"):
+    from dynamo_tpu.llm.http.service import HttpService
+
+    service = HttpService()
+    service.manager.add_completions_model(model, engine_fn)
+    return service
+
+
+def test_http_unary_deadline_maps_to_504(run_async):
+    async def main():
+        import aiohttp
+
+        async def stuck_engine(req, ctx):
+            await asyncio.sleep(60)
+            yield {}
+
+        service = _service_with(stuck_engine)
+        await service.start(host="127.0.0.1", port=0)
+        try:
+            async with aiohttp.ClientSession() as http:
+                t0 = time.monotonic()
+                async with http.post(
+                        f"http://127.0.0.1:{service.port}/v1/completions",
+                        json={"model": "m", "prompt": "hi",
+                              "timeout": 0.3}) as resp:
+                    body = await resp.json()
+                assert resp.status == 504
+                assert body["error"]["type"] == "timeout_error"
+                assert body["error"]["code"] == 504
+                assert "X-Request-Id" in resp.headers
+                assert time.monotonic() - t0 < 5.0
+        finally:
+            await service.stop()
+
+    run_async(main())
+
+
+def test_http_header_deadline_and_504(run_async):
+    async def main():
+        import aiohttp
+
+        async def stuck_engine(req, ctx):
+            # engine honors nothing: the service-level bound must fire
+            await asyncio.sleep(60)
+            yield {}
+
+        service = _service_with(stuck_engine)
+        await service.start(host="127.0.0.1", port=0)
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                        f"http://127.0.0.1:{service.port}/v1/completions",
+                        json={"model": "m", "prompt": "hi"},
+                        headers={"X-Request-Deadline-Ms": "300"}) as resp:
+                    assert resp.status == 504
+        finally:
+            await service.stop()
+
+    run_async(main())
+
+
+def test_http_streaming_deadline_emits_timeout_finish(run_async):
+    """SSE: deadline dies mid-stream → final chunk finish_reason
+    "timeout" + [DONE]; the stream ends cleanly instead of hanging."""
+
+    async def main():
+        import json as _json
+
+        import aiohttp
+
+        async def slow_engine(req, ctx):
+            yield {"id": "cmpl-1", "object": "text_completion", "created": 1,
+                   "model": "m", "choices": [{"index": 0, "text": "tok",
+                                              "finish_reason": None}]}
+            await asyncio.sleep(60)
+
+        service = _service_with(slow_engine)
+        await service.start(host="127.0.0.1", port=0)
+        try:
+            async with aiohttp.ClientSession() as http:
+                finishes = []
+                done = False
+                async with http.post(
+                        f"http://127.0.0.1:{service.port}/v1/completions",
+                        json={"model": "m", "prompt": "hi", "stream": True,
+                              "timeout": 0.4}) as resp:
+                    assert resp.status == 200
+                    async for raw in resp.content:
+                        line = raw.strip()
+                        if not line.startswith(b"data: "):
+                            continue
+                        if line == b"data: [DONE]":
+                            done = True
+                            break
+                        chunk = _json.loads(line[len(b"data: "):])
+                        finishes.extend(
+                            c.get("finish_reason")
+                            for c in chunk.get("choices", []))
+                assert done
+                assert finishes[-1] == "timeout"
+        finally:
+            await service.stop()
+
+    run_async(main())
+
+
+def test_http_no_capacity_maps_to_503_with_retry_after(run_async):
+    async def main():
+        import aiohttp
+
+        from dynamo_tpu.runtime.dcp_client import NoRespondersError
+
+        async def no_cap_engine(req, ctx):
+            raise guard.NoCapacity("all instances circuit-broken")
+            yield {}
+
+        async def no_resp_engine(req, ctx):
+            raise NoRespondersError("no live instances")
+            yield {}
+
+        for engine, name in ((no_cap_engine, "m"), (no_resp_engine, "m2")):
+            service = _service_with(engine, model=name)
+            await service.start(host="127.0.0.1", port=0)
+            try:
+                async with aiohttp.ClientSession() as http:
+                    async with http.post(
+                            f"http://127.0.0.1:{service.port}"
+                            f"/v1/completions",
+                            json={"model": name, "prompt": "x"}) as resp:
+                        body = await resp.json()
+                    assert resp.status == 503
+                    assert resp.headers.get("Retry-After") == "1"
+                    assert body["error"]["type"] == "overloaded_error"
+            finally:
+                await service.stop()
+
+    run_async(main())
+
+
+def test_guard_metrics_exposed(run_async):
+    async def main():
+        import aiohttp
+
+        guard.counter_inc("dyn_llm_route_fallback_total",
+                          reason="NoRespondersError")
+
+        async def ok_engine(req, ctx):
+            yield {"id": "cmpl-1", "object": "text_completion", "created": 1,
+                   "model": "m", "choices": [{"index": 0, "text": "x",
+                                              "finish_reason": "stop"}]}
+
+        service = _service_with(ok_engine)
+        await service.start(host="127.0.0.1", port=0)
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                        f"http://127.0.0.1:{service.port}/metrics") as resp:
+                    text = await resp.text()
+            assert "dyn_llm_route_fallback_total" in text
+        finally:
+            await service.stop()
+
+    run_async(main())
+
+
+# ------------------- the full stack under chaos: complete-or-fail, no hang
+
+
+def test_full_stack_chaos_completes_or_fails_typed_within_deadline(run_async):
+    """HTTP → processor → router → disagg decode → engine on CPU with the
+    transfer plane severed under every send and a per-request deadline:
+    every request completes (local-prefill fallback) or fails typed —
+    none outlives its budget, none hangs."""
+
+    async def main():
+        import json as _json
+
+        import aiohttp
+
+        from dynamo_tpu.llm.disagg import DisaggRouter, PrefillWorker
+        from dynamo_tpu.llm.disagg.decode import build_disagg_decode
+        from dynamo_tpu.llm.http.service import HttpService
+        from dynamo_tpu.llm.kv_router.router import KvRouter
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.llm.processor import Processor
+        from dynamo_tpu.llm.worker import serve_token_model
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        service = None
+        try:
+            decode_eng, params = _tiny_engine(seed=9)
+            prefill_eng, _ = _tiny_engine(params=params)
+            decode_eng.warmup()
+            prefill_eng.warmup(decode=False)
+            router = DisaggRouter(max_local_prefill_length=4)
+            disagg = await build_disagg_decode(drt, decode_eng,
+                                               namespace="stack",
+                                               router=router,
+                                               watch_config=False)
+            disagg.prefill_timeout = 20.0
+            pw = PrefillWorker(drt, prefill_eng, namespace="stack",
+                               chunk_pages=1)
+            pw.start()
+
+            mdc = ModelDeploymentCard(name="m", tokenizer_kind="byte",
+                                      kv_block_size=16,
+                                      model_type="completions")
+            await serve_token_model(drt, mdc, disagg, namespace="stack",
+                                    component="w",
+                                    publish_kv_events=False)
+            kvr = KvRouter(drt, "stack", "w", block_size=16,
+                           scrape_interval=1.0, seed=0)
+            await kvr.start(run_loop=False)
+            await kvr.scrape_once()
+            token_client = await drt.namespace("stack").component("w") \
+                .endpoint("generate_tokens").client()
+            processor = Processor(mdc, token_client, kvr)
+            service = HttpService()
+            service.manager.add_completions_model("m", processor.completion)
+            await service.start(host="127.0.0.1", port=0)
+
+            # sever every transfer send: remote prefill can never commit;
+            # every request must degrade to local prefill inside its budget
+            guard.set_chaos("seed=11;sever:kv.send@after=1")
+
+            deadline_s = 15.0
+            async with aiohttp.ClientSession() as http:
+                async def one(i):
+                    prompt = "chaos " * (3 + i % 3)
+                    t0 = time.monotonic()
+                    async with http.post(
+                            f"http://127.0.0.1:{service.port}"
+                            f"/v1/completions",
+                            json={"model": "m", "prompt": prompt,
+                                  "stream": True, "max_tokens": 6,
+                                  "timeout": deadline_s}) as resp:
+                        assert resp.status in (200, 503, 504), resp.status
+                        finishes = []
+                        if resp.status == 200:
+                            async for raw in resp.content:
+                                line = raw.strip()
+                                if line == b"data: [DONE]":
+                                    break
+                                if line.startswith(b"data: "):
+                                    chunk = _json.loads(
+                                        line[len(b"data: "):])
+                                    finishes.extend(
+                                        c.get("finish_reason")
+                                        for c in chunk.get("choices", []))
+                        elapsed = time.monotonic() - t0
+                        assert elapsed < deadline_s + 5.0, \
+                            f"request {i} outlived its budget ({elapsed:.1f}s)"
+                        if finishes:
+                            assert finishes[-1] in ("stop", "length",
+                                                    "timeout"), finishes
+
+                await asyncio.wait_for(
+                    asyncio.gather(*(one(i) for i in range(4))),
+                    timeout=120.0)
+
+            assert disagg.remote_fallbacks >= 1, \
+                "chaos never exercised the fallback path"
+
+            await pw.stop()
+            await kvr.stop()
+            await token_client.close()
+            await disagg.transfer.stop()
+            await prefill_eng.stop()
+            await decode_eng.stop()
+        finally:
+            guard.set_chaos(None)
+            if service is not None:
+                await service.stop()
+            await drt.shutdown()
+
+    run_async(main())
+
+
+# --------------------------------------------------- fleet breaker scenario
+
+
+def test_fleet_breaker_scenario_circuit_breaks_and_recovers(run_async):
+    """--scenario breaker: the flapping worker's stats breaker opens in
+    every collector (once per flap), closes again by run end, and traffic
+    keeps meeting the SLO on the healthy pool."""
+    from dynamo_tpu.fleet.harness import run_scenario
+    from dynamo_tpu.fleet.scenarios import get_scenario
+
+    report = run_async(run_scenario(get_scenario("breaker"), seed=0))
+    flaps = [e for e in report["workers"]["timeline"]
+             if e["event"] == "flap_start"]
+    assert len(flaps) == 2
+    for collector in ("aggregator", "router"):
+        b = report["breakers"][collector]
+        assert b["opened_total"] >= 1, (collector, b)
+        assert b["open_now"] == [], (collector, b)
+    assert report["requests"]["failed"] == 0
+    assert report["slo"]["met"], report["phases"]

@@ -377,6 +377,64 @@ def test_dl007_quiet_on_good():
     assert "DL007" not in codes(DL007_GOOD)
 
 
+# --------------------------------------------------- DL011 unbounded-await
+
+
+DL011_BAD = """
+import asyncio
+async def talk(reader, writer, work_queue):
+    r, w = await asyncio.open_connection("h", 1)   # unbounded connect
+    data = await reader.readexactly(4)             # unbounded read
+    await writer.drain()                           # unbounded drain
+    item = await work_queue.get()                  # unbounded queue get
+"""
+
+DL011_BAD_CODEC = """
+from . import codec
+async def loop(reader):
+    msg = await codec.decode(reader)               # frame-read primitive
+    frame = await read_frame(reader)               # dcp primitive
+"""
+
+DL011_GOOD = """
+import asyncio
+from . import codec, guard
+async def talk(reader, writer, work_queue, deadline):
+    r, w = await asyncio.wait_for(
+        asyncio.open_connection("h", 1), 30.0)      # bounded connect
+    data = await asyncio.wait_for(reader.readexactly(4), 5.0)
+    await asyncio.wait_for(writer.drain(), 30.0)
+    item = await guard.bound(work_queue.get(), deadline=deadline)
+    msg = await asyncio.wait_for(codec.decode(reader), 10.0)
+async def not_network(seq, d):
+    out = await seq.out.get()       # not queue-shaped: engine stream
+    val = d.get("k")                # sync dict get: no await
+"""
+
+DL011_SUPPRESSED = """
+async def server_loop(reader):
+    while True:
+        # idle server read: lifetime is the connection
+        msg = await decode(reader)  # dynalint: disable=unbounded-await
+"""
+
+
+def test_dl011_fires_on_naked_net_awaits():
+    assert codes(DL011_BAD).count("DL011") == 4
+
+
+def test_dl011_fires_on_codec_primitives():
+    assert codes(DL011_BAD_CODEC).count("DL011") == 2
+
+
+def test_dl011_quiet_on_bounded():
+    assert "DL011" not in codes(DL011_GOOD)
+
+
+def test_dl011_suppression():
+    assert "DL011" not in codes(DL011_SUPPRESSED)
+
+
 # ------------------------------------------------- dynaflow fixture plumbing
 
 

@@ -409,7 +409,8 @@ FRAME_EXAMPLES = {
     "dcp.request_envelope": {"req_id": "r1", "conn": {"address": "h:1",
                                                       "subject": "s"},
                              "payload": b"x", "trace": {"trace_id": "t",
-                                                        "span_id": "s"}},
+                                                        "span_id": "s"},
+                             "deadline_ms": 1500},
     "dcp.request_ack": {"accepted": True, "instance_id": 7},
     "dcp.stats_reply": {"instance_id": 7, "subject": "ns.c.e-7",
                         "inflight": 0, "data": {"kv_active_blocks": 1}},
@@ -424,7 +425,8 @@ FRAME_EXAMPLES = {
                                "page_ids": [3], "skip_pages": 0,
                                "engine_id": 1,
                                "trace_ctx": {"trace_id": "t",
-                                             "span_id": "s"}},
+                                             "span_id": "s"},
+                               "deadline_ms": 1500},
     "kv_transfer.bulk": {"request_id": "r", "page_ids": [1], "shape":
                          [2, 1, 2, 4, 8], "dtype": "float32", "k_len": 512,
                          "first_token": 5, "quant": "int8", "v": 2},

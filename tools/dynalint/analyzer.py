@@ -52,6 +52,10 @@ RULES: Dict[str, Tuple[str, str]] = {
               "codec encode/encode_parts call site whose header matches "
               "no registered wire frame: declare it in runtime/wire.py "
               "and anchor the site with wire.checked(...)"),
+    "DL011": ("unbounded-await",
+              "await on a network primitive (stream read/drain/connect, "
+              "queue get, codec decode) with no asyncio.wait_for/"
+              "deadline bound: a dead peer wedges this task forever"),
 }
 
 NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}
@@ -117,6 +121,21 @@ ENV_ALLOWED_SUFFIXES = ("runtime/config.py",)
 # accesses that count as "the span is closed somewhere".
 SPAN_START_ATTRS = frozenset({"start_span"})
 SPAN_CLOSE_ATTRS = frozenset({"end", "__exit__"})
+
+# DL011: awaited calls that park on a network peer. A naked await on one
+# of these wedges its task forever if the peer dies silently; they must
+# run under asyncio.wait_for / guard.bound (the await's TOP-LEVEL call),
+# or carry an inline disable with a justification (idle server reads
+# whose lifetime IS the connection). Method names:
+NET_AWAIT_ATTRS = frozenset({"drain", "readexactly", "readline",
+                             "readuntil", "wait_closed"})
+# dotted/bare call names (codec.decode and read_frame are this tree's
+# frame-read primitives — readexactly under the hood):
+NET_AWAIT_CALLS = frozenset({"asyncio.open_connection", "open_connection",
+                             "codec.decode", "decode", "read_frame"})
+# `await <recv>.get()` counts when the receiver is queue-shaped (its
+# final segment names a queue); `seq.out.get()` et al. stay exempt.
+NET_QUEUE_RE = re.compile(r"(?i)(^|[._])(queue|q)$")
 
 SUPPRESS_RE = re.compile(r"#\s*dynalint:\s*disable=([A-Za-z0-9_,\-]+)")
 
@@ -443,7 +462,31 @@ class _Analyzer(ast.NodeVisitor):
             if d in LONG_AWAIT_CALLS or attr in LONG_AWAIT_ATTRS:
                 what = d or f".{attr}()"
                 self.emit(node, "DL004", f"long `await {what}` under lock")
+        self._check_unbounded_await(node)
         self.generic_visit(node)
+
+    # ------------------------------------------------- DL011 unbounded await
+
+    def _check_unbounded_await(self, node: ast.Await) -> None:
+        """Flag ``await <net primitive>(...)`` at the await's top level.
+        A wrapped form — ``await asyncio.wait_for(prim(...), t)`` or
+        ``await guard.bound(prim(...), ...)`` — never fires, because the
+        awaited call is then the wrapper, not the primitive."""
+        if not isinstance(node.value, ast.Call):
+            return
+        call = node.value
+        d = dotted(call.func)
+        attr = call_attr(call)
+        if d in NET_AWAIT_CALLS:
+            self.emit(node, "DL011", f"`await {d}(...)`")
+            return
+        if attr in NET_AWAIT_ATTRS:
+            self.emit(node, "DL011", f"`await ....{attr}()`")
+            return
+        if attr == "get" and isinstance(call.func, ast.Attribute):
+            recv = dotted(call.func.value)
+            if recv is not None and NET_QUEUE_RE.search(recv):
+                self.emit(node, "DL011", f"`await {recv}.get()`")
 
     # -------------------------------------------------------- DL005 host sync
 
