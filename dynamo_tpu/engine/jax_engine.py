@@ -340,7 +340,11 @@ class JaxEngine:
                        else make_long_prefill_fn)
             self.long_prefill_fn = builder(model_cfg, mesh)
             self._seq_par = mesh.shape["seq"]
-        self.pm = PageManager(self.ecfg.num_pages, self.ecfg.page_size,
+        # async frames must take _pm_lock (declared below) before
+        # touching the page pool; sync frames on the engine step path
+        # are serialized by the single-worker executor
+        self.pm = PageManager(self.ecfg.num_pages,  # guarded-by: self._pm_lock
+                              self.ecfg.page_size,
                               host_pages=self.ecfg.host_pages)
         # host-DRAM offload pools (same per-page layout as the HBM pool)
         self.host_k = self.host_v = None
@@ -1546,10 +1550,11 @@ class JaxEngine:
         counts = np.zeros((pad_to, V), np.int32)
         presence = np.zeros((pad_to, V), np.int8)
         for i, s in enumerate(seqs):
-            gen = np.asarray(s.tokens[s.num_prompt:], np.int64)
+            # host-list → host-array construction, not a device sync
+            gen = np.asarray(s.tokens[s.num_prompt:], np.int64)  # dynalint: disable=jax-host-sync-in-hot-path
             if gen.size:
                 counts[i] = np.bincount(gen, minlength=V)[:V]
-            ctx = np.asarray(s.tokens, np.int64)
+            ctx = np.asarray(s.tokens, np.int64)  # dynalint: disable=jax-host-sync-in-hot-path
             presence[i, ctx[ctx < V]] = 1
         return (jnp.asarray(counts), jnp.asarray(presence))
 

@@ -104,20 +104,25 @@ class PageManager:
     def __init__(self, num_pages: int, page_size: int, host_pages: int = 0):
         self.num_pages = num_pages
         self.page_size = page_size
+        # every pool structure below is event-loop-affine: all methods
+        # are sync (each call is one atomic block under the loop), and
+        # cross-thread callers serialize on the engine's _pm_lock. The
+        # annotations make dynarace reject any future async method that
+        # lets an await interleave with pool invariants mid-update.
         # page 0 is reserved as the padding target in device page tables
-        self.pages: List[PageState] = [PageState() for _ in range(num_pages)]
-        self.free: deque = deque(range(1, num_pages))
-        self.reusable: "OrderedDict[int, None]" = OrderedDict()  # LRU order
-        self.by_hash: Dict[int, int] = {}  # block_hash → page id
-        self.events: List[KvEvent] = []
+        self.pages: List[PageState] = [PageState() for _ in range(num_pages)]  # guarded-by: loop
+        self.free: deque = deque(range(1, num_pages))  # guarded-by: loop
+        self.reusable: "OrderedDict[int, None]" = OrderedDict()  # guarded-by: loop
+        self.by_hash: Dict[int, int] = {}  # guarded-by: loop
+        self.events: List[KvEvent] = []  # guarded-by: loop
         self.pages[0].refcount = 1  # never allocated
         # host offload tier
         self.host_pages = host_pages
-        self.host_free: deque = deque(range(host_pages))
-        self.host_by_hash: Dict[int, int] = {}   # block_hash → host slot
-        self.host_lru: "OrderedDict[int, int]" = OrderedDict()  # slot → hash
-        self.pending_offload: List[Tuple[int, int]] = []  # (page, host_slot)
-        self.pending_restore: List[Tuple[int, int]] = []  # (page, host_slot)
+        self.host_free: deque = deque(range(host_pages))  # guarded-by: loop
+        self.host_by_hash: Dict[int, int] = {}   # guarded-by: loop
+        self.host_lru: "OrderedDict[int, int]" = OrderedDict()  # guarded-by: loop
+        self.pending_offload: List[Tuple[int, int]] = []  # guarded-by: loop
+        self.pending_restore: List[Tuple[int, int]] = []  # guarded-by: loop
         # host slots planned for restore inside an in-progress
         # allocate_sequence call: _pop_fresh→_host_slot evictions triggered
         # by the same call must not reassign them (they reach

@@ -60,12 +60,14 @@ class FleetController:
             f"{self.namespace}.{PLANNER_ADVISORY_SUBJECT}", self._on_adv)
 
     async def stop(self) -> None:
-        if self._sid is not None:
+        # claim the subscription before the await: a concurrent stop()
+        # interleaving at the unsubscribe must not double-unsubscribe
+        sid, self._sid = self._sid, None
+        if sid is not None:
             try:
-                await self.drt.dcp.unsubscribe(self._sid)
+                await self.drt.dcp.unsubscribe(sid)
             except Exception:
                 log.debug("unsubscribe failed during stop", exc_info=True)
-            self._sid = None
 
     async def _on_adv(self, msg) -> None:
         try:

@@ -296,14 +296,16 @@ class SimWorker:
     async def drain(self) -> None:
         """Leave discovery; in-flight requests keep stepping to done."""
         self.draining = True
-        if self._handle:
-            await self._handle.stop()
-            self._handle = None
+        # claim the handle before the await: drain/stop racing each
+        # other at the handle.stop() must not double-stop it
+        handle, self._handle = self._handle, None
+        if handle:
+            await handle.stop()
 
     async def stop(self) -> None:
-        if self._handle:
-            await self._handle.stop()
-            self._handle = None
+        handle, self._handle = self._handle, None
+        if handle:
+            await handle.stop()
         await self.drt.shutdown()
 
 

@@ -291,11 +291,19 @@ class PrefillWorker:
 
     async def _client(self, engine_id: int) -> KvTransferClient:
         client = self._clients.get(engine_id)
-        if client is None:
-            client = await KvTransferClient.lookup(self.drt.dcp,
-                                                   self.namespace, engine_id,
-                                                   stats=self.xfer)
-            self._clients[engine_id] = client
+        if client is not None:
+            return client
+        client = await KvTransferClient.lookup(self.drt.dcp,
+                                               self.namespace, engine_id,
+                                               stats=self.xfer)
+        # re-check after the lookup await: a concurrent job for the same
+        # engine may have resolved it first — without this, the loser
+        # clobbers the cache and the winner's connection leaks
+        cached = self._clients.get(engine_id)
+        if cached is not None:
+            client.close()
+            return cached
+        self._clients[engine_id] = client
         return client
 
     def _evict(self, engine_id: int, client: Optional[KvTransferClient]

@@ -472,11 +472,16 @@ class KvTransferClient:
                  stats: Optional[TransferStats] = None):
         self.host = host
         self.port = port
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
-        self._ack_task: Optional[asyncio.Task] = None
+        # the connection triple is written by _ensure (reconnect) and
+        # nulled by the ack loop on connection loss — both under the
+        # lock; senders hold the writer _ensure returned, never re-read
+        # self._writer across their awaits
+        self._reader: Optional[asyncio.StreamReader] = None  # guarded-by: self._conn_lock
+        self._writer: Optional[asyncio.StreamWriter] = None  # guarded-by: self._conn_lock
+        self._ack_task: Optional[asyncio.Task] = None  # guarded-by: self._conn_lock
         self._conn_lock = asyncio.Lock()  # held for connect only, never acks
-        self._pending: Dict[str, asyncio.Queue] = {}
+        # ack demux table: single-statement register/pop/get only
+        self._pending: Dict[str, asyncio.Queue] = {}  # guarded-by: loop
         self.stats = stats if stats is not None else TransferStats()
 
     @classmethod
@@ -491,7 +496,12 @@ class KvTransferClient:
         meta = json.loads(raw)
         return cls(meta["host"], meta["port"], stats=stats)
 
-    async def _ensure(self) -> None:
+    async def _ensure(self) -> asyncio.StreamWriter:
+        """(Re)connect if needed; returns the live writer. Senders keep
+        this local reference across their awaits — re-reading
+        ``self._writer`` mid-send races the ack loop nulling it on
+        connection loss (the demux would yank the writer out from under
+        an in-flight frame)."""
         async with self._conn_lock:
             if self._writer is None or self._writer.is_closing():
                 await guard.chaos_point("kv.connect")
@@ -502,6 +512,7 @@ class KvTransferClient:
                     _io_timeout())
                 self._ack_task = asyncio.ensure_future(
                     self._ack_loop(self._reader, self._writer))
+            return self._writer
 
     async def _ack_loop(self, reader: asyncio.StreamReader,
                         writer: asyncio.StreamWriter) -> None:
@@ -525,8 +536,9 @@ class KvTransferClient:
                    "error": f"transfer connection lost: {exc}"}
             for q in self._pending.values():
                 q.put_nowait(err)
-            if self._writer is writer:
-                self._writer = None
+            async with self._conn_lock:
+                if self._writer is writer:
+                    self._writer = None
             writer.close()
 
     def _register(self, request_id: str) -> asyncio.Queue:
@@ -570,11 +582,11 @@ class KvTransferClient:
         q = self._register(request_id)
         t_wall = time.monotonic()
         try:
-            await self._ensure()
-            await guard.chaos_point("kv.send", self._writer)
+            writer = await self._ensure()
+            await guard.chaos_point("kv.send", writer)
             t0 = time.monotonic()
-            self._writer.writelines(codec.encode_parts(header, parts))
-            await asyncio.wait_for(self._writer.drain(), _io_timeout())
+            writer.writelines(codec.encode_parts(header, parts))
+            await asyncio.wait_for(writer.drain(), _io_timeout())
             now = time.monotonic()
             st.wire_seconds += now - t0
             st.bytes_sent += sum(p.nbytes for p in parts)
@@ -607,7 +619,7 @@ class KvTransferClient:
         nxt: Optional[asyncio.Future] = None
         committed = False
         try:
-            await self._ensure()
+            writer = await self._ensure()
             nxt = asyncio.ensure_future(frames.__anext__())
             idx = 0
             while True:
@@ -630,10 +642,10 @@ class KvTransferClient:
                     header["first_token"] = int(first_token)
                     if tc is not None:  # commit chunk carries the trace ctx
                         header["trace"] = tc
-                await guard.chaos_point("kv.send", self._writer)
+                await guard.chaos_point("kv.send", writer)
                 t0 = time.monotonic()
-                self._writer.writelines(codec.encode_parts(header, parts))
-                await asyncio.wait_for(self._writer.drain(), _io_timeout())
+                writer.writelines(codec.encode_parts(header, parts))
+                await asyncio.wait_for(writer.drain(), _io_timeout())
                 st.wire_seconds += time.monotonic() - t0
                 st.bytes_sent += nbytes
                 st.chunks_sent += 1
@@ -675,11 +687,13 @@ class KvTransferClient:
         and fail the waiter now, without closing the shared connection
         under other in-flight requests."""
         try:
-            if self._writer is not None and not self._writer.is_closing():
-                self._writer.writelines(codec.encode_parts(
+            async with self._conn_lock:
+                writer = self._writer  # snapshot: the ack loop may null it
+            if writer is not None and not writer.is_closing():
+                writer.writelines(codec.encode_parts(
                     wire.checked(wire.KV_TRANSFER_ABORT, {
                         "kind": "abort", "request_id": request_id})))
-                await asyncio.wait_for(self._writer.drain(), _io_timeout())
+                await asyncio.wait_for(writer.drain(), _io_timeout())
         except Exception:  # noqa: BLE001 — the conn may be the failure
             pass
 

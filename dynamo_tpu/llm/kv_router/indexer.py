@@ -140,9 +140,9 @@ class KvIndexer:
         # tree has no worker-enumeration API, and the router's dead-worker
         # prune needs one (reading the Python tree's ``lookup`` dict broke
         # every scrape pass under the native backend)
-        self._workers: set = set()
+        self._workers: set = set()  # guarded-by: loop
         if backend == "python":
-            self.tree = RadixTree()
+            self.tree = RadixTree()  # guarded-by: loop
         else:
             from .native_indexer import make_radix_tree
 

@@ -40,8 +40,11 @@ class KvEventPublisher:
             self._task = spawn_tracked(self._loop(), name="kv-event-pub")
 
     async def stop(self) -> None:
-        await cancel_join(self._task)
-        self._task = None
+        # claim the task before the await: a concurrent stop() must not
+        # double-cancel (and a start() during the join must not be
+        # clobbered by our late `= None`)
+        task, self._task = self._task, None
+        await cancel_join(task)
         await self.flush()
 
     async def flush(self) -> None:
@@ -133,8 +136,8 @@ class NativeEventBridge:
                                        name="native-kv-event-bridge")
 
     async def stop(self) -> None:
-        await cancel_join(self._task)
-        self._task = None
+        task, self._task = self._task, None  # claim before the await
+        await cancel_join(task)
         await self.flush()
 
     async def _loop(self) -> None:

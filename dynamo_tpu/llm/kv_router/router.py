@@ -40,9 +40,14 @@ class KvRouter:
         self.drt = drt
         self.namespace = namespace
         self.component = component
-        self.indexer = KvIndexer(block_size)
+        # event-loop-affine: the event subscription, the scrape loop and
+        # every schedule() call share these; each touch is one atomic
+        # sync call (reference indexer.rs single-writer discipline —
+        # the asyncio loop provides it without thread hops), and
+        # dynarace rejects any access pattern that straddles an await
+        self.indexer = KvIndexer(block_size)  # guarded-by: loop
         # seed: deterministic tie-breaking for simulated / replayed runs
-        self.scheduler = KvScheduler(
+        self.scheduler = KvScheduler(  # guarded-by: loop
             block_size=block_size, load_balance_weight=load_balance_weight,
             on_hit_rate_event=self._on_hit_rate,
             rng=random.Random(seed) if seed is not None else random.Random())
@@ -130,8 +135,13 @@ class KvRouter:
             if not self.scheduler.workers:
                 # no stats yet: fall back to any live instance
                 ids = await self.client.wait_for_instances(timeout=10)
-                self.scheduler.update_metrics(
-                    {wid: ForwardPassMetrics() for wid in ids})
+                if not self.scheduler.workers:
+                    # re-check after the wait: a scrape may have landed
+                    # real occupancy during it, and zeroed fallback
+                    # metrics must not clobber that view (the router
+                    # would dogpile the busiest worker)
+                    self.scheduler.update_metrics(
+                        {wid: ForwardPassMetrics() for wid in ids})
             overlaps = self.indexer.find_matches_for_request(token_ids)
             # only consider overlaps from live workers
             wid = self.scheduler.schedule(len(token_ids), overlaps)

@@ -37,7 +37,9 @@ class MetricsAggregator:
         self.namespace = namespace
         self.address = EndpointAddress(namespace, component, endpoint)
         self.interval = interval
-        self.worker_metrics: Dict[int, ForwardPassMetrics] = {}
+        # written by the scrape loop, read by every /metrics render;
+        # single-statement accesses only (atomic under the event loop)
+        self.worker_metrics: Dict[int, ForwardPassMetrics] = {}  # guarded-by: loop
         self.hit_rate_isl_blocks = 0
         self.hit_rate_overlap_blocks = 0
         self.hit_rate_events = 0

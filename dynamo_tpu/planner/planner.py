@@ -91,10 +91,11 @@ class Planner:
             self._task = spawn_tracked(self._loop(), name="planner-tick")
 
     async def stop(self) -> None:
-        # wait the cancellation out before closing the clients the
-        # in-flight tick may still be using
-        await cancel_join(self._task)
-        self._task = None
+        # claim the task before the await (concurrent stops must not
+        # double-cancel), then wait the cancellation out before closing
+        # the clients the in-flight tick may still be using
+        task, self._task = self._task, None
+        await cancel_join(task)
         for c in self._clients.values():
             await c.close()
         self._clients.clear()

@@ -406,7 +406,11 @@ class Client:
                  retry: Optional[guard.RetryPolicy] = None):
         self.drt = drt
         self.address = address
-        self.instances: Dict[int, EndpointInstance] = {}
+        # written by the watch loop, snapshotted by routing and stats
+        # collection; every post-await consumer re-validates membership
+        # against it (collect_stats drops instances that departed during
+        # the scrape gather rather than resurrecting their breakers)
+        self.instances: Dict[int, EndpointInstance] = {}  # guarded-by: loop
         self._watch = None
         self._watch_task: Optional[asyncio.Task] = None
         self._rr = 0
@@ -656,6 +660,11 @@ class Client:
         # consumers — router scheduler, planner — see a deterministic view
         out: Dict[int, dict] = {}
         for inst, resp in zip(targets, replies):
+            if inst.instance_id not in self.instances:
+                # departed during the gather (watch-loop delete dropped
+                # its breakers): recording would resurrect a breaker for
+                # a dead instance and leak a ghost gauge row
+                continue
             br = self.breakers.get("stats", inst.instance_id)
             was_open = br.state != guard.BREAKER_CLOSED
             if resp is None:

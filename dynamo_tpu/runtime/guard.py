@@ -379,7 +379,10 @@ class BreakerBoard:
         self.name = name
         self.cfg = cfg or BreakerConfig.from_env()
         self.clock = clock
-        self.breakers: Dict[Tuple[str, Any], CircuitBreaker] = {}
+        # shared by every task routing/scraping through one client; all
+        # board methods are sync (atomic under the event loop), and
+        # dynarace rejects any future access that straddles an await
+        self.breakers: Dict[Tuple[str, Any], CircuitBreaker] = {}  # guarded-by: loop
         _BOARDS.add(self)
 
     def get(self, plane: str, key: Any) -> CircuitBreaker:

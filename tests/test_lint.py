@@ -25,9 +25,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tools.dynalint import (CallGraph, analyze_project,  # noqa: E402
-                            analyze_source, analyze_tree, apply_baseline,
-                            load_baseline, load_source, load_wire_schemas,
-                            parse_module)
+                            analyze_races, analyze_source, analyze_tree,
+                            apply_baseline, load_baseline, load_source,
+                            load_wire_schemas, parse_module)
 
 BASELINE = os.path.join(REPO, "tools", "dynalint", "baseline.txt")
 GATE_PATHS = [os.path.join(REPO, "dynamo_tpu"),
@@ -826,6 +826,417 @@ def test_baseline_count_suffix(tmp_path):
     assert not fresh and not stale
 
 
+# ----------------------------------------------- dynarace fixture plumbing
+
+
+def race(*mods):
+    """Run the dynarace passes (DL012-DL014 + interprocedural DL005)
+    over in-memory fixture modules given as (path, src) pairs."""
+    return analyze_races([parse_module(src, path) for path, src in mods])
+
+
+def race_codes(src, path="pkg/m.py"):
+    return [v.code for v in race((path, src))]
+
+
+# --------------------------------------------- DL012 atomicity-across-await
+
+
+DL012_BAD = """
+import asyncio
+from dynamo_tpu.runtime.tasks import spawn_tracked
+
+class Svc:
+    async def start(self):
+        spawn_tracked(self.loop_a())
+        spawn_tracked(self.loop_b())
+
+    async def loop_a(self):
+        while True:
+            n = self.counter
+            await asyncio.sleep(1)
+            self.counter = n + 1        # lost update across the await
+
+    async def loop_b(self):
+        self.counter = 0
+"""
+
+DL012_BAD_STALE_CHECK = """
+import asyncio
+
+class Svc:
+    async def ensure(self):
+        if self._conn is None:          # stale check...
+            await asyncio.sleep(1)
+            self._conn = object()       # ...acted on after the await
+
+    async def drop(self):
+        self._conn = None
+"""
+
+DL012_GOOD_LOCK = """
+import asyncio
+
+class Svc:
+    async def ensure(self):
+        async with self._lock:          # one lock across the whole
+            if self._conn is None:      # read-check-act sequence
+                await asyncio.sleep(1)
+                self._conn = object()
+
+    async def drop(self):
+        async with self._lock:
+            self._conn = None
+"""
+
+DL012_GOOD_RECHECK = """
+import asyncio
+
+class Svc:
+    async def ensure(self):
+        if self._conn is None:
+            await asyncio.sleep(1)
+            if self._conn is None:      # double-checked: re-validated
+                self._conn = object()   # after the await
+
+    async def drop(self):
+        self._conn = None
+"""
+
+DL012_GOOD_ATOMIC = """
+import asyncio
+
+class Svc:
+    async def bump(self):
+        self.counter += 1               # single statement: atomic
+        await asyncio.sleep(1)
+        self.counter += 1
+
+    async def other(self):
+        self.counter = 0
+
+    def sync_path(self):
+        n = self.counter                # sync frame: cannot interleave
+        self.counter = n + 1
+"""
+
+DL012_SUPPRESSED_WRITE = """
+import asyncio
+
+class Svc:
+    async def ensure(self):
+        if self._conn is None:
+            await asyncio.sleep(1)
+            # single caller by construction (start() runs once)
+            self._conn = object()  # dynalint: disable=atomicity-across-await
+
+    async def drop(self):
+        self._conn = None
+"""
+
+DL012_SUPPRESSED_READ = """
+import asyncio
+
+class Svc:
+    async def ensure(self):
+        if self._conn is None:  # dynalint: disable=DL012
+            await asyncio.sleep(1)
+            self._conn = object()
+
+    async def drop(self):
+        self._conn = None
+"""
+
+
+def test_dl012_fires_on_lost_update():
+    vs = [v for v in race(("pkg/m.py", DL012_BAD)) if v.code == "DL012"]
+    assert len(vs) == 1
+    assert vs[0].scope == "Svc.loop_a" and "counter" in vs[0].message
+
+
+def test_dl012_fires_on_stale_check():
+    vs = [v for v in race(("pkg/m.py", DL012_BAD_STALE_CHECK))
+          if v.code == "DL012"]
+    assert len(vs) == 1 and "_conn" in vs[0].message
+
+
+def test_dl012_quiet_on_lock_held_both_ends():
+    assert "DL012" not in race_codes(DL012_GOOD_LOCK)
+
+
+def test_dl012_quiet_on_recheck_after_await():
+    assert "DL012" not in race_codes(DL012_GOOD_RECHECK)
+
+
+def test_dl012_quiet_on_atomic_and_sync():
+    assert "DL012" not in race_codes(DL012_GOOD_ATOMIC)
+
+
+def test_dl012_suppression_both_ends():
+    for src in (DL012_SUPPRESSED_WRITE, DL012_SUPPRESSED_READ):
+        assert "DL012" not in race_codes(src)
+
+
+# ---------------------------------------- DL013 unguarded-concurrent-mutation
+
+
+DL013_BAD_GUARDED = """
+import asyncio
+
+class Svc:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._conn = None  # guarded-by: self._lock
+
+    async def touch(self):
+        self._conn = object()           # async frame, lock not held
+"""
+
+DL013_GOOD_GUARDED = """
+import asyncio
+
+class Svc:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._conn = None  # guarded-by: self._lock
+
+    async def touch(self):
+        async with self._lock:
+            self._conn = object()
+
+    def sync_touch(self):
+        self._conn = None               # sync frame: event-loop atomic
+"""
+
+DL013_BAD_UNKNOWN_LOCK = """
+class Svc:
+    def __init__(self):
+        self._conn = None  # guarded-by: self._nope_lock
+"""
+
+DL013_BAD_INCONSISTENT = """
+import asyncio
+from dynamo_tpu.runtime.tasks import spawn_tracked
+
+class Svc:
+    async def start(self):
+        spawn_tracked(self.locked())
+        spawn_tracked(self.unlocked())
+
+    async def locked(self):
+        async with self._wlock:
+            self.table[1] = 1           # mutation under the lock...
+
+    async def unlocked(self):
+        self.table[2] = 2               # ...and without it elsewhere
+"""
+
+DL013_SUPPRESSED = """
+import asyncio
+
+class Svc:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._conn = None  # guarded-by: self._lock
+
+    async def touch(self):
+        # teardown path: the loop is already stopped here
+        self._conn = None  # dynalint: disable=unguarded-concurrent-mutation
+"""
+
+
+def test_dl013_fires_on_guarded_access_without_lock():
+    vs = [v for v in race(("pkg/m.py", DL013_BAD_GUARDED))
+          if v.code == "DL013"]
+    assert len(vs) == 1
+    assert vs[0].scope == "Svc.touch" and "guarded-by" in vs[0].message
+
+
+def test_dl013_quiet_on_lock_held_and_sync_frames():
+    assert "DL013" not in race_codes(DL013_GOOD_GUARDED)
+
+
+def test_dl013_fires_on_unknown_lock():
+    vs = [v for v in race(("pkg/m.py", DL013_BAD_UNKNOWN_LOCK))
+          if v.code == "DL013"]
+    assert len(vs) == 1 and "never" in vs[0].message
+
+
+def test_dl013_fires_on_inconsistent_discipline():
+    vs = [v for v in race(("pkg/m.py", DL013_BAD_INCONSISTENT))
+          if v.code == "DL013"]
+    assert len(vs) == 1
+    assert vs[0].scope == "Svc.unlocked" and "_wlock" in vs[0].message
+
+
+def test_dl013_suppression():
+    assert "DL013" not in race_codes(DL013_SUPPRESSED)
+
+
+# -------------------------------------------- DL014 lock-order-inversion
+
+
+DL014_BAD = """
+import asyncio
+
+class Svc:
+    async def fwd(self):
+        async with self.a_lock:
+            async with self.b_lock:
+                pass
+
+    async def rev(self):
+        async with self.b_lock:
+            async with self.a_lock:
+                pass
+"""
+
+DL014_GOOD = """
+import asyncio
+
+class Svc:
+    async def one(self):
+        async with self.a_lock:
+            async with self.b_lock:
+                pass
+
+    async def two(self):
+        async with self.a_lock:         # same order everywhere: fine
+            async with self.b_lock:
+                pass
+"""
+
+DL014_INTERPROCEDURAL = """
+import asyncio
+
+class Svc:
+    async def outer(self):
+        async with self.a_lock:
+            await self.inner()          # acquires b under a...
+
+    async def inner(self):
+        async with self.b_lock:
+            pass
+
+    async def other(self):
+        async with self.b_lock:
+            async with self.a_lock:     # ...opposite order here
+                pass
+"""
+
+DL014_SUPPRESSED = """
+import asyncio
+
+class Svc:
+    async def fwd(self):
+        async with self.a_lock:
+            # startup-only path, never concurrent with rev()
+            async with self.b_lock:  # dynalint: disable=DL014
+                pass
+
+    async def rev(self):
+        async with self.b_lock:
+            async with self.a_lock:  # dynalint: disable=lock-order-inversion
+                pass
+"""
+
+
+def test_dl014_fires_on_inverted_pair():
+    vs = [v for v in race(("pkg/m.py", DL014_BAD)) if v.code == "DL014"]
+    assert len(vs) == 2                      # one per direction
+    assert {v.scope for v in vs} == {"Svc.fwd", "Svc.rev"}
+
+
+def test_dl014_quiet_on_consistent_order():
+    assert "DL014" not in race_codes(DL014_GOOD)
+
+
+def test_dl014_fires_through_call_under_lock():
+    vs = [v for v in race(("pkg/m.py", DL014_INTERPROCEDURAL))
+          if v.code == "DL014"]
+    assert vs and any(v.scope == "Svc.other" for v in vs)
+
+
+def test_dl014_suppression():
+    assert "DL014" not in race_codes(DL014_SUPPRESSED)
+
+
+# ------------------------------------------- DL005 interprocedural (dynarace)
+
+
+DL005_TRANSITIVE = """
+import numpy as np
+
+class JaxEngine:
+    def _step(self):
+        self._helper()
+
+    def _helper(self):
+        np.asarray(self.kv)
+"""
+
+DL005_TRANSITIVE_ALLOWLISTED = """
+import numpy as np
+
+class JaxEngine:
+    def _step(self):
+        self._decode_step_single()      # allowlisted sync arm
+
+    def _decode_step_single(self):
+        np.asarray(self.kv)
+"""
+
+DL005_TRANSITIVE_SUPPRESSED = """
+import numpy as np
+
+class JaxEngine:
+    def _step(self):
+        self._helper()  # dynalint: disable=jax-host-sync-in-hot-path
+
+    def _helper(self):
+        np.asarray(self.kv)
+"""
+
+
+def test_dl005_interprocedural_fires_at_hot_call_site():
+    vs = [v for v in race(("dynamo_tpu/engine/fixture.py", DL005_TRANSITIVE))
+          if v.code == "DL005"]
+    assert len(vs) == 1
+    assert vs[0].scope == "JaxEngine._step" and "_helper" in vs[0].message
+
+
+def test_dl005_interprocedural_scoped_to_engine():
+    assert "DL005" not in [
+        v.code for v in race(("dynamo_tpu/llm/fixture.py", DL005_TRANSITIVE))]
+
+
+def test_dl005_interprocedural_respects_allowlist():
+    assert "DL005" not in [
+        v.code for v in race(("dynamo_tpu/engine/fixture.py",
+                              DL005_TRANSITIVE_ALLOWLISTED))]
+
+
+def test_dl005_interprocedural_suppression_at_call_site():
+    assert "DL005" not in [
+        v.code for v in race(("dynamo_tpu/engine/fixture.py",
+                              DL005_TRANSITIVE_SUPPRESSED))]
+
+
+# ----------------------------------------------------- dynarace determinism
+
+
+def test_dynarace_deterministic_output():
+    """Two runs over the same fixture set produce byte-identical findings
+    in identical order (the gate diffs against a baseline, so ordering
+    churn would thrash it)."""
+    mods = (("pkg/a.py", DL012_BAD), ("pkg/b.py", DL013_BAD_INCONSISTENT),
+            ("pkg/c.py", DL014_BAD),
+            ("dynamo_tpu/engine/fixture.py", DL005_TRANSITIVE))
+    first = [v.render() for v in race(*mods)]
+    second = [v.render() for v in race(*mods)]
+    assert first and first == second
+
+
 # ------------------------------------------------------- generated artifacts
 
 
@@ -913,6 +1324,35 @@ def test_cli_json_reports_wall_time():
     assert "wall_seconds" in out and out["wall_seconds"] >= 0
 
 
+def test_cli_all_entry():
+    """`python -m tools.dynalint --all` runs per-file + dynaflow +
+    dynarace off one shared parse cache; --json carries per-rule counts
+    and per-pass wall seconds (the dynarace pass timed separately)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dynalint", "--all", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    out = json.loads(proc.stdout)
+    assert out["violations"] == []
+    assert "rule_counts" in out
+    for p in ("per_file", "dynaflow", "dynarace"):
+        assert out["passes"][p] >= 0
+
+
+def test_lint_suite_wall_budget():
+    """The whole in-process suite (per-file + dynaflow + dynarace, one
+    shared parse) must stay within a pinned CPU-seconds ceiling so
+    tier-1 does not bloat as the tree grows."""
+    import time
+
+    t0 = time.process_time()
+    analyze_tree(GATE_PATHS, root=REPO)
+    cpu = time.process_time() - t0
+    assert cpu < 30.0, f"lint suite took {cpu:.1f} CPU-seconds (budget 30)"
+
+
 def test_cli_callgraph_dot(tmp_path):
     dot = tmp_path / "graph.dot"
     proc = subprocess.run(
@@ -925,6 +1365,10 @@ def test_cli_callgraph_dot(tmp_path):
     assert text.startswith("digraph dynaflow")
     # async transfer-plane entrypoints are annotated
     assert "KvTransferServer._ingest_worker" in text
+    # dynarace concurrency coloring: roots bold orange, shared-state
+    # touchers double-bordered
+    assert "#e06c00" in text
+    assert "peripheries=2" in text
 
 
 def test_env_registry_rejects_unregistered():

@@ -1,0 +1,254 @@
+"""Regression tests for the true-positive races dynarace (PR 8) surfaced.
+
+Each test pins a fixed interleaving bug: stats assembly acting on
+instances that departed during the scrape gather, the router's zeroed
+fallback metrics clobbering a scrape that landed mid-wait, the prefill
+worker's transfer-client cache double-connecting under concurrent
+misses, the transfer client's ack demux yanking the shared writer out
+from under an in-flight send, and double-release on concurrent stop().
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+from types import SimpleNamespace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics  # noqa: E402
+from dynamo_tpu.llm.kv_router.router import KvRouter  # noqa: E402
+from dynamo_tpu.runtime import guard  # noqa: E402
+from dynamo_tpu.runtime.component import (Client, EndpointAddress,  # noqa: E402
+                                          EndpointInstance)
+
+
+def _instance(wid: int) -> EndpointInstance:
+    return EndpointInstance("ns", "c", "e", wid, f"subj-{wid:x}")
+
+
+# --------------------------------------------- Client.collect_stats assembly
+
+
+def test_collect_stats_skips_instances_departed_mid_gather(run_async):
+    """An instance deleted by the watch loop WHILE the stats gather is in
+    flight must not have its breakers resurrected by the assembly loop —
+    the pre-await target snapshot is stale by then (DL012 shape)."""
+
+    client = Client.__new__(Client)
+    client.address = EndpointAddress("ns", "c", "e")
+    client.instances = {7: _instance(7)}
+    client.breakers = guard.BreakerBoard("test", guard.BreakerConfig())
+    client.retry = guard.RetryPolicy(max_attempts=1, base_s=0.001,
+                                     cap_s=0.002)
+
+    async def request(subject, payload, timeout=None):
+        # simulate the watch loop's delete landing during the probe
+        client.instances.pop(7, None)
+        client.breakers.drop("stats", 7)
+        client.breakers.drop("request", 7)
+        raise RuntimeError("probe raced the delete")
+
+    client.drt = SimpleNamespace(dcp=SimpleNamespace(request=request))
+
+    out = run_async(client.collect_stats(timeout=0.1))
+    assert out == {}
+    # before the fix, record_failure() re-created a breaker for the dead
+    # instance via breakers.get(...) — a ghost gauge row that never dies
+    assert ("stats", 7) not in client.breakers.breakers
+
+
+def test_collect_stats_still_records_for_live_instances(run_async):
+    client = Client.__new__(Client)
+    client.address = EndpointAddress("ns", "c", "e")
+    client.instances = {7: _instance(7)}
+    client.breakers = guard.BreakerBoard("test", guard.BreakerConfig())
+    client.retry = guard.RetryPolicy(max_attempts=1, base_s=0.001,
+                                     cap_s=0.002)
+
+    async def request(subject, payload, timeout=None):
+        raise RuntimeError("down")
+
+    client.drt = SimpleNamespace(dcp=SimpleNamespace(request=request))
+    run_async(client.collect_stats(timeout=0.1))
+    assert client.breakers.get("stats", 7).failures == 1
+
+
+# ------------------------------------------------ router fallback clobbering
+
+
+def test_router_fallback_does_not_clobber_fresh_metrics(run_async):
+    """schedule() with an empty scheduler waits for instances; if a real
+    scrape lands DURING that wait, the zeroed fallback metrics must not
+    overwrite it (the router would dogpile the busiest worker)."""
+
+    async def publish(subject, payload):
+        return None
+
+    drt = SimpleNamespace(dcp=SimpleNamespace(publish=publish))
+    router = KvRouter(drt, "ns", "c", block_size=4, seed=0)
+    real = ForwardPassMetrics(request_active_slots=3,
+                              request_total_slots=8,
+                              kv_active_blocks=5, kv_total_blocks=10)
+
+    class FakeClient:
+        async def collect_stats(self, timeout=None):
+            return {}            # stats plane empty: forces the fallback
+
+        def instance_ids(self):
+            return [1]
+
+        async def wait_for_instances(self, timeout=30.0):
+            # a scrape completes while schedule() is parked here
+            router.scheduler.update_metrics({1: real})
+            return [1]
+
+    router.client = FakeClient()
+
+    async def go():
+        return await router.schedule([1, 2, 3, 4, 5])
+
+    wid = run_async(go())
+    assert wid == 1
+    assert router.scheduler.workers[1].metrics.request_active_slots == 3
+
+
+# ------------------------------------- prefill-worker transfer-client cache
+
+
+def test_transfer_client_cache_single_connection_per_engine(run_async):
+    """Two concurrent cache misses for the same engine must converge on
+    ONE client; the loser's fresh connection is closed, not leaked, and
+    the cache is never clobbered (read-lookup-store TOCTOU)."""
+    from dynamo_tpu.llm.disagg import prefill_worker as pw_mod
+    from dynamo_tpu.llm.disagg.prefill_worker import PrefillWorker
+    from dynamo_tpu.llm.disagg.transfer import TransferStats
+
+    made = []
+
+    class StubClient:
+        def __init__(self):
+            self.closed = False
+            made.append(self)
+
+        def close(self):
+            self.closed = True
+
+    async def fake_lookup(dcp, namespace, engine_id, stats=None):
+        await asyncio.sleep(0)   # force the interleave at the await
+        return StubClient()
+
+    pw = PrefillWorker.__new__(PrefillWorker)
+    pw._clients = {}
+    pw.drt = SimpleNamespace(dcp=None)
+    pw.namespace = "ns"
+    pw.xfer = TransferStats()
+
+    orig = pw_mod.KvTransferClient.lookup
+    pw_mod.KvTransferClient.lookup = staticmethod(fake_lookup)
+    try:
+        async def go():
+            return await asyncio.gather(pw._client(1), pw._client(1))
+
+        a, b = run_async(go())
+    finally:
+        pw_mod.KvTransferClient.lookup = orig
+
+    assert a is b
+    assert len(made) == 2            # both tasks looked up...
+    assert sum(1 for c in made if not c.closed) == 1  # ...one survived
+    assert pw._clients[1] is a
+
+
+# --------------------------------------- transfer-client writer demux race
+
+
+def test_ack_loop_nulls_writer_under_lock_and_fails_pending(run_async):
+    """Connection loss in the ack demux must fail every pending send AND
+    clear the shared writer under _conn_lock so the next send
+    reconnects; senders keep the writer _ensure returned, so the null
+    can never yank it out from under an in-flight frame."""
+    from dynamo_tpu.llm.disagg.transfer import KvTransferClient
+
+    class StubWriter:
+        def __init__(self):
+            self.closed = False
+
+        def is_closing(self):
+            return self.closed
+
+        def close(self):
+            self.closed = True
+
+    class BoomReader:
+        async def readexactly(self, n):
+            raise ConnectionError("peer died")
+
+    async def go():
+        client = KvTransferClient("h", 1)
+        w = StubWriter()
+        client._writer = w
+        # _ensure returns the live writer without reconnecting — the
+        # local reference senders must hold across their awaits
+        assert await client._ensure() is w
+        q = client._register("r1")
+        await client._ack_loop(BoomReader(), w)
+        assert client._writer is None       # cleared under the lock
+        assert w.closed
+        err = q.get_nowait()
+        assert err["conn_lost"] and not err["ok"]
+
+    run_async(go())
+
+
+# ----------------------------------------------- stop() double-release races
+
+
+def test_controller_concurrent_stop_unsubscribes_once(run_async):
+    """Two stop() calls racing at the unsubscribe await must release the
+    subscription exactly once (claim-before-await)."""
+    from dynamo_tpu.fleet.controller import FleetController
+
+    calls = []
+
+    async def unsubscribe(sid):
+        calls.append(sid)
+        await asyncio.sleep(0)
+
+    ctl = FleetController.__new__(FleetController)
+    ctl._sid = 42
+    ctl.drt = SimpleNamespace(dcp=SimpleNamespace(unsubscribe=unsubscribe))
+
+    async def go():
+        await asyncio.gather(ctl.stop(), ctl.stop())
+
+    run_async(go())
+    assert calls == [42]
+    assert ctl._sid is None
+
+
+def test_publisher_concurrent_stop_cancels_once(run_async):
+    """KvEventPublisher.stop() claims the task before awaiting the join:
+    concurrent stops see None and skip, and a task handle is never
+    cancelled twice through the publisher."""
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+
+    class PM:
+        def drain_events(self):
+            return []
+
+    pub = KvEventPublisher.__new__(KvEventPublisher)
+    pub.engine = SimpleNamespace(pm=PM())
+    pub.dcp = SimpleNamespace()
+    pub.subject = "s"
+    pub.worker_id = 1
+
+    async def go():
+        pub._task = asyncio.get_running_loop().create_task(
+            asyncio.sleep(30))
+        await asyncio.gather(pub.stop(), pub.stop())
+
+    run_async(go())
+    assert pub._task is None

@@ -10,9 +10,14 @@ spans. The **dynaflow** whole-program layer (callgraph.py + dynaflow.py)
 adds what no single file can show: blocking calls reachable from async
 defs through chains of sync helpers (DL008), and conformance of every
 encoded/decoded wire frame against the declared schema registry in
-``dynamo_tpu/runtime/wire.py`` (DL009/DL010).
+``dynamo_tpu/runtime/wire.py`` (DL009/DL010). The **dynarace** layer
+(dynarace.py) infers concurrency roots and shared state over the same
+call graph and enforces await-atomicity (DL012), the ``# guarded-by:``
+lock/loop discipline (DL013), lock-order consistency (DL014), and the
+interprocedural extension of the DL005 hot-path host-sync rule.
 
 Usage:
+    python -m tools.dynalint --all          # every pass, one parse
     python -m tools.dynalint [--baseline FILE] [--json] paths...
     python -m tools.dynalint --callgraph-dot graph.dot
     python -m tools.dynalint --wire-schemas docs/wire_schemas.md
@@ -31,11 +36,15 @@ from .baseline import apply_baseline, format_entry, load_baseline
 from .callgraph import DEFAULT_DL008_DEPTH, CallGraph, module_name
 from .dynaflow import (FrameSchema, analyze_project, analyze_tree,
                        load_wire_schemas)
+from .dynarace import (RaceModel, analyze_races, build_race_model,
+                       check_transitive_host_sync, scan_modules)
 
 __all__ = [
     "RULES", "CallGraph", "DEFAULT_DL008_DEPTH", "FrameSchema",
-    "ModuleSource", "Violation", "analyze_paths", "analyze_project",
-    "analyze_source", "analyze_tree", "apply_baseline", "format_entry",
-    "iter_py_files", "load_source", "load_sources", "load_wire_schemas",
-    "load_baseline", "module_name", "parse_module",
+    "ModuleSource", "RaceModel", "Violation", "analyze_paths",
+    "analyze_project", "analyze_races", "analyze_source", "analyze_tree",
+    "apply_baseline", "build_race_model", "check_transitive_host_sync",
+    "format_entry", "iter_py_files", "load_source", "load_sources",
+    "load_wire_schemas", "load_baseline", "module_name", "parse_module",
+    "scan_modules",
 ]

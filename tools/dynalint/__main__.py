@@ -1,18 +1,22 @@
 """dynalint CLI.
 
+    python -m tools.dynalint --all
     python -m tools.dynalint [--baseline FILE] [--json] paths...
 
-Runs the per-file rules (DL001-DL007) AND the whole-program dynaflow
-passes (DL008 call-graph blocking propagation, DL009/DL010 wire-schema
-conformance) over one shared parse of the tree.
+Runs the per-file rules (DL001-DL007, DL011) AND the whole-program
+passes — dynaflow (DL008 call-graph blocking propagation, DL009/DL010
+wire-schema conformance) and dynarace (DL012-DL014 concurrency rules +
+interprocedural DL005) — over one shared parse of the tree. ``--all``
+is the CI spelling: the default tree, every pass.
 
 Exit status: 0 when every violation is baselined (stale baseline
 entries still warn on stderr), 1 when new violations exist.
 
 Tooling extras:
     --callgraph-dot graph.dot   Graphviz export of the project call
-                                graph, async defs and blocking reach
-                                annotated
+                                graph: async defs, blocking reach,
+                                concurrency roots and shared-state
+                                touchers annotated
     --wire-schemas FILE         regenerate docs/wire_schemas.md from the
                                 runtime/wire.py registry
     --write-env-docs FILE       regenerate docs/env_vars.md
@@ -44,6 +48,10 @@ def main(argv=None) -> int:
         description="project-native async/JAX static analysis")
     ap.add_argument("paths", nargs="*", default=None,
                     help=f"files/dirs to scan (default: {DEFAULT_PATHS})")
+    ap.add_argument("--all", action="store_true", dest="run_all",
+                    help="run every pass (per-file + dynaflow + dynarace) "
+                         "over the default tree off one shared AST parse "
+                         "cache — the CI entry point")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="grandfathered-violations file "
                          "(default: tools/dynalint/baseline.txt)")
@@ -95,20 +103,32 @@ def main(argv=None) -> int:
         print(f"wrote {args.wire_schemas}")
         return 0
 
-    paths = args.paths or [os.path.join(REPO_ROOT, p)
-                           for p in DEFAULT_PATHS]
+    if args.run_all:
+        paths = [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    else:
+        paths = args.paths or [os.path.join(REPO_ROOT, p)
+                               for p in DEFAULT_PATHS]
 
     if args.callgraph_dot:
-        graph = CallGraph.build(load_sources(paths, root=REPO_ROOT))
+        from .dynarace import analyze_races
+
+        sources = load_sources(paths, root=REPO_ROOT)
+        graph = CallGraph.build(sources)
+        # concurrency coloring: roots bold orange, shared-state-touching
+        # functions double-bordered (see dynarace.build_race_model)
+        model_out: dict = {}
+        analyze_races(sources, graph=graph, model_out=model_out)
         with open(args.callgraph_dot, "w", encoding="utf-8") as f:
-            f.write(graph.to_dot())
+            f.write(graph.to_dot(race=model_out.get("model")))
         print(f"wrote {args.callgraph_dot} "
               f"({len(graph.functions)} functions)")
         return 0
 
     t0 = time.perf_counter()
+    timings: dict = {}
     violations = analyze_tree(paths, root=REPO_ROOT,
-                              dl008_depth=args.dl008_depth)
+                              dl008_depth=args.dl008_depth,
+                              timings=timings)
     wall = time.perf_counter() - t0
 
     if args.write_baseline:
@@ -128,9 +148,14 @@ def main(argv=None) -> int:
         violations, stale = apply_baseline(violations, allowed)
 
     if args.as_json:
+        rule_counts: dict = {}
+        for v in violations:
+            rule_counts[v.code] = rule_counts.get(v.code, 0) + 1
         print(json.dumps({"violations": [v.to_dict() for v in violations],
                           "stale_baseline": stale,
-                          "wall_seconds": round(wall, 3)}, indent=2))
+                          "wall_seconds": round(wall, 3),
+                          "rule_counts": dict(sorted(rule_counts.items())),
+                          "passes": timings}, indent=2))
     else:
         for v in violations:
             print(v.render())

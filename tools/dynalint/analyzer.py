@@ -56,6 +56,22 @@ RULES: Dict[str, Tuple[str, str]] = {
               "await on a network primitive (stream read/drain/connect, "
               "queue get, codec decode) with no asyncio.wait_for/"
               "deadline bound: a dead peer wedges this task forever"),
+    # DL012-DL014 are the interprocedural dynarace rules (dynarace.py):
+    # they need concurrency-root inference over the whole-program call
+    # graph, so analyze_source never emits them — analyze_tree does.
+    "DL012": ("atomicity-across-await",
+              "shared attribute read before an await and written after "
+              "it with no re-check and no common lock: a concurrent "
+              "task can interleave at the await, so the write clobbers "
+              "its update or acts on a stale check (lost update)"),
+    "DL013": ("unguarded-concurrent-mutation",
+              "shared attribute mutated outside its declared/observed "
+              "lock discipline: annotate it `# guarded-by: ...` and "
+              "hold the lock, or take the lock at this site"),
+    "DL014": ("lock-order-inversion",
+              "locks acquired in opposite nesting orders on different "
+              "paths: two tasks taking them concurrently can deadlock "
+              "the event loop forever"),
 }
 
 NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}
@@ -108,10 +124,25 @@ HOST_SYNC_CALLS = frozenset({"jax.block_until_ready", "np.asarray",
 # spec-decode arm verifies on-host; the single-step fallback is the
 # pre-async engine). New step functions do NOT belong here — overlap
 # device work instead, or carry an inline disable with a justification.
+# Entries are excluded both as hot-path origins (per-file rule) and as
+# sanctioned callees/sinks of the interprocedural pass (dynarace
+# check_transitive_host_sync), which otherwise reports any host sync a
+# *step* function reaches through sync helpers at its call site.
 HOT_SYNC_ALLOWLIST = frozenset({
     "JaxEngine._step_spec",
     "JaxEngine._decode_step_spec",
     "JaxEngine._decode_step_single",
+    # pipelined-scheduler readback/staging arms (the ROADMAP item 3
+    # overhaul targets): _process_window/_process_prefill materialize
+    # sampled tokens on host, _dispatch_prefill stages host token
+    # buffers for device dispatch, _land_inflight_offloads copies
+    # offloaded KV into the host pool. Each is the designed sync point
+    # of the dispatch pipeline; any NEW helper a step function reaches
+    # still fires at the call site.
+    "JaxEngine._process_window",
+    "JaxEngine._process_prefill",
+    "JaxEngine._dispatch_prefill",
+    "JaxEngine._land_inflight_offloads",
 })
 
 # DL006: modules allowed to touch os.environ directly (the registry itself).
@@ -502,14 +533,9 @@ class _Analyzer(ast.NodeVisitor):
 
     def _check_host_sync(self, node: ast.Call, d: Optional[str],
                          attr: Optional[str]) -> None:
-        if d in HOST_SYNC_CALLS or attr == "block_until_ready":
-            self.emit(node, "DL005", f"`{d or attr}`")
-        elif attr == "item" and not node.args:
-            self.emit(node, "DL005", "`.item()`")
-        elif isinstance(node.func, ast.Name) and node.func.id == "float" \
-                and node.args and isinstance(
-                    node.args[0], (ast.Call, ast.Subscript)):
-            self.emit(node, "DL005", "`float()` on a computed value")
+        what = host_sync_what(node, d, attr)
+        if what is not None:
+            self.emit(node, "DL005", what)
 
     # --------------------------------------------------------- DL006 env read
 
@@ -570,6 +596,22 @@ def _handler_surfaces_error(handler: ast.ExceptHandler) -> bool:
             if d in ("time.sleep", "asyncio.sleep"):
                 return True
     return False
+
+
+def host_sync_what(call: ast.Call, d: Optional[str],
+                   attr: Optional[str]) -> Optional[str]:
+    """Host-sync primitive detection shared by the per-file DL005 rule
+    and the interprocedural (callgraph) DL005 pass. Returns a display
+    string for the primitive, or None."""
+    if d in HOST_SYNC_CALLS or attr == "block_until_ready":
+        return f"`{d or attr}`"
+    if attr == "item" and not call.args:
+        return "`.item()`"
+    if isinstance(call.func, ast.Name) and call.func.id == "float" \
+            and call.args and isinstance(
+                call.args[0], (ast.Call, ast.Subscript)):
+        return "`float()` on a computed value"
+    return None
 
 
 def _is_lock_expr(expr: ast.AST) -> bool:

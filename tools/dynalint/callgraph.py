@@ -33,12 +33,25 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .analyzer import (BLOCKING_BUILTINS, BLOCKING_CALLS, BLOCKING_PREFIXES,
-                       ModuleSource, dotted)
+                       ModuleSource, call_attr, dotted, host_sync_what)
 
 # suppression tags that quiet DL008 at a call site or at the blocking sink
 _DL008_TAGS = frozenset({"DL008", "transitive-blocking-in-async", "all"})
+# ... and DL005 at a host-sync sink (interprocedural hot-path pass)
+_DL005_TAGS = frozenset({"DL005", "jax-host-sync-in-hot-path", "all"})
 
 DEFAULT_DL008_DEPTH = 4  # max sync frames between the async def and the sink
+
+# task-spawning wrappers: their first argument is a coroutine CALL whose
+# target becomes a concurrency root (dynarace root inference)
+SPAWN_TAILS = frozenset({"spawn_tracked", "create_task", "ensure_future"})
+# registration calls whose function-reference arguments become handler
+# roots: pub/sub subscriptions fire per message
+HANDLER_REG_TAILS = frozenset({"subscribe"})
+# aiohttp-style route registrations: handler refs next to a "/path" arg
+ROUTE_REG_TAILS = frozenset({"get", "post", "put", "delete", "patch",
+                             "add_get", "add_post", "add_put", "add_delete",
+                             "add_route"})
 
 
 def module_name(rel_path: str) -> str:
@@ -61,6 +74,29 @@ class CallSite:
 
 
 @dataclass
+class SpawnSite:
+    """``spawn_tracked(self._loop(), ...)``-style site: the spawned
+    coroutine's target function becomes a concurrency root."""
+
+    line: int
+    raw: str                      # spawned callee as written
+    in_loop: bool                 # spawned per loop iteration → reentrant
+    target: Optional[str] = None
+
+
+@dataclass
+class HandlerRef:
+    """A function REFERENCE (not call) registered as a handler —
+    ``dcp.subscribe(subject, self._on_events)``, aiohttp route handlers.
+    Handlers fire per message/request, so their targets are reentrant
+    concurrency roots."""
+
+    line: int
+    raw: str
+    target: Optional[str] = None
+
+
+@dataclass
 class FuncInfo:
     key: str          # '<module>:<qualname>'
     module: str
@@ -69,9 +105,14 @@ class FuncInfo:
     is_async: bool
     lineno: int
     path: str
+    is_async_gen: bool = False    # async def containing yield
     calls: List[CallSite] = field(default_factory=list)
     # direct blocking primitives: (line, what) — suppressed ones excluded
     blocking: List[Tuple[int, str]] = field(default_factory=list)
+    # direct host-sync primitives (DL005 sinks) — suppressed ones excluded
+    host_sync: List[Tuple[int, str]] = field(default_factory=list)
+    spawns: List[SpawnSite] = field(default_factory=list)
+    handler_refs: List[HandlerRef] = field(default_factory=list)
 
 
 @dataclass
@@ -123,6 +164,7 @@ class _Collector(ast.NodeVisitor):
         self.mod = mod
         self._classes: List[str] = []
         self._funcs: List[FuncInfo] = []
+        self._loops: List[int] = [0]  # per-function loop depth
 
     # ------------------------------------------------------------- imports
 
@@ -174,7 +216,9 @@ class _Collector(ast.NodeVisitor):
                 self._classes[0] in self.mod.classes:
             self.mod.classes[self._classes[0]].methods.add(node.name)
         self._funcs.append(fi)
+        self._loops.append(0)
         self.generic_visit(node)
+        self._loops.pop()
         self._funcs.pop()
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
@@ -183,14 +227,62 @@ class _Collector(ast.NodeVisitor):
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
         self._visit_func(node, True)
 
+    def _visit_loop(self, node) -> None:
+        self._loops[-1] += 1
+        self.generic_visit(node)
+        self._loops[-1] -= 1
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    def _mark_async_gen(self, node) -> None:
+        if self._funcs and self._funcs[-1].is_async:
+            self._funcs[-1].is_async_gen = True
+        self.generic_visit(node)
+
+    visit_Yield = _mark_async_gen
+    visit_YieldFrom = _mark_async_gen
+
     # --------------------------------------------------------------- calls
 
-    def _suppressed(self, line: int) -> bool:
+    def _suppressed(self, line: int,
+                    tags: frozenset = _DL008_TAGS) -> bool:
         for probe in (line, line - 1):
-            tags = self.mod.suppressed.get(probe)
-            if tags and tags & _DL008_TAGS:
+            have = self.mod.suppressed.get(probe)
+            if have and have & tags:
                 return True
         return False
+
+    def _note_spawns_and_handlers(self, node: ast.Call, d: Optional[str],
+                                  fn: FuncInfo) -> None:
+        tail = (d.rsplit(".", 1)[-1] if d is not None
+                else call_attr(node))
+        if tail in SPAWN_TAILS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Call):
+                raw = dotted(arg.func)
+                if raw is not None:
+                    fn.spawns.append(SpawnSite(node.lineno, raw,
+                                               self._loops[-1] > 0))
+            return
+        if tail in HANDLER_REG_TAILS:
+            for arg in node.args:
+                raw = dotted(arg)
+                if raw is not None and not isinstance(arg, ast.Name):
+                    fn.handler_refs.append(HandlerRef(node.lineno, raw))
+                elif isinstance(arg, ast.Name):
+                    fn.handler_refs.append(HandlerRef(node.lineno, arg.id))
+            return
+        if tail in ROUTE_REG_TAILS:
+            # only when some string arg looks like a URL path — this is
+            # what keeps dict.get("key", fallback) out of the root set
+            if any(isinstance(a, ast.Constant) and isinstance(a.value, str)
+                   and a.value.startswith("/") for a in node.args):
+                for arg in node.args:
+                    raw = dotted(arg)
+                    if raw is not None:
+                        fn.handler_refs.append(HandlerRef(node.lineno, raw))
 
     def visit_Call(self, node: ast.Call) -> None:
         if self._funcs:
@@ -206,6 +298,11 @@ class _Collector(ast.NodeVisitor):
                 what = d
             if what is not None and not self._suppressed(node.lineno):
                 fn.blocking.append((node.lineno, what))
+            sync = host_sync_what(node, d, call_attr(node))
+            if sync is not None and \
+                    not self._suppressed(node.lineno, _DL005_TAGS):
+                fn.host_sync.append((node.lineno, sync))
+            self._note_spawns_and_handlers(node, d, fn)
         if _is_offload_call(node):
             # visit only the callee expr: function-object args escape to a
             # thread, so neither their edges nor their blocking count here
@@ -241,6 +338,10 @@ class CallGraph:
                 cls_name = first if first in mod.classes else None
                 for cs in fi.calls:
                     cs.target = g._resolve(mod, cs.raw, cls_name, fi)
+                for sp in fi.spawns:
+                    sp.target = g._resolve(mod, sp.raw, cls_name, fi)
+                for hr in fi.handler_refs:
+                    hr.target = g._resolve(mod, hr.raw, cls_name, fi)
         return g
 
     # ---------------------------------------------------------- resolution
@@ -405,10 +506,14 @@ class CallGraph:
 
     # ------------------------------------------------------------- export
 
-    def to_dot(self, reach: Optional[Dict[str, BlockPath]] = None) -> str:
+    def to_dot(self, reach: Optional[Dict[str, BlockPath]] = None,
+               race=None) -> str:
         """Graphviz export of the project-resolved graph: async defs are
         filled blue, functions that (transitively) reach a blocking
-        primitive get a red outline, direct blockers a bold red outline."""
+        primitive get a red outline, direct blockers a bold red outline.
+        With a dynarace ``RaceModel``, concurrency roots get a bold
+        orange outline and shared-state-touching functions a double
+        border (peripheries=2)."""
         reach = reach if reach is not None else self.blocking_reachability()
         lines = ["digraph dynaflow {",
                  '  rankdir=LR; node [shape=box, fontsize=10];']
@@ -420,6 +525,11 @@ class CallGraph:
             if bp is not None:
                 attrs.append('color=red' + (', penwidth=2'
                                             if bp.depth == 0 else ''))
+            if race is not None:
+                if key in race.roots:
+                    attrs.append('color="#e06c00", penwidth=2.5')
+                if key in race.shared_funcs:
+                    attrs.append('peripheries=2')
             label = key.replace(":", "\\n")
             lines.append(f'  "{key}" [label="{label}"'
                          + (", " + ", ".join(attrs) if attrs else "") + "];")

@@ -575,18 +575,21 @@ def _check_required_never_read(
 def analyze_project(sources: Sequence[ModuleSource],
                     schemas: Optional[Dict[str, FrameSchema]] = None,
                     const_map: Optional[Dict[str, str]] = None,
-                    dl008_depth: int = DEFAULT_DL008_DEPTH
+                    dl008_depth: int = DEFAULT_DL008_DEPTH,
+                    graph: Optional[CallGraph] = None
                     ) -> List[Violation]:
     """Run the whole-program passes over already-loaded modules. The wire
     registry defaults to the scanned module whose path is
     ``dynamo_tpu/runtime/wire.py``; pass ``schemas``/``const_map``
-    explicitly for fixture trees."""
+    explicitly for fixture trees, ``graph`` to reuse an already-built
+    call graph (the --all driver shares one with dynarace)."""
     out: List[Violation] = []
     wire_ms = next((m for m in sources if m.path == WIRE_MODULE_REL), None)
     if schemas is None and wire_ms is not None:
         schemas, const_map, bad = load_wire_schemas(wire_ms)
         out.extend(bad)
-    graph = CallGraph.build(sources)
+    if graph is None:
+        graph = CallGraph.build(sources)
     out.extend(check_transitive_blocking(graph, dl008_depth))
     if schemas:
         decode_reads: Set[Tuple[str, str]] = set()
@@ -607,12 +610,18 @@ def analyze_project(sources: Sequence[ModuleSource],
 
 
 def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
-                 dl008_depth: int = DEFAULT_DL008_DEPTH) -> List[Violation]:
-    """Per-file rules + whole-program dynaflow rules over one tree; the
-    shared parse cache means each file is read and parsed exactly once
-    per run."""
+                 dl008_depth: int = DEFAULT_DL008_DEPTH,
+                 timings: Optional[dict] = None) -> List[Violation]:
+    """Per-file rules + whole-program dynaflow rules + the dynarace
+    concurrency passes over one tree; the shared parse cache means each
+    file is read and parsed exactly once per run. Pass ``timings={}``
+    to receive per-pass wall seconds (``per_file``/``dynaflow``/
+    ``dynarace``)."""
+    import time as _time
+
     from .analyzer import analyze_module
 
+    t0 = _time.perf_counter()
     sources = load_sources(paths, root=root)
     out: List[Violation] = []
     for ms in sources:
@@ -638,6 +647,18 @@ def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
             out.append(Violation(rel.replace(os.sep, "/"), e.lineno or 0, 0,
                                  "DL000", "syntax-error", str(e),
                                  "<module>"))
-    out.extend(analyze_project(sources, dl008_depth=dl008_depth))
+    t1 = _time.perf_counter()
+    graph = CallGraph.build(sources)
+    out.extend(analyze_project(sources, dl008_depth=dl008_depth,
+                               graph=graph))
+    t2 = _time.perf_counter()
+    from .dynarace import analyze_races
+
+    out.extend(analyze_races(sources, graph=graph))
+    t3 = _time.perf_counter()
+    if timings is not None:
+        timings["per_file"] = round(t1 - t0, 3)
+        timings["dynaflow"] = round(t2 - t1, 3)
+        timings["dynarace"] = round(t3 - t2, 3)
     out.sort(key=lambda v: (v.path, v.line, v.code))
     return out

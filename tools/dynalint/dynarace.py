@@ -1,0 +1,825 @@
+"""dynarace: whole-program async race & atomicity analysis (DL012-DL014).
+
+Dynamo's Rust runtime is data-race-free by construction — ``Send``/``Sync``
+bounds and ``Mutex<T>`` make unguarded sharing unrepresentable. This
+Python port's cooperative concurrency has a subtler failure mode: nothing
+ever runs in parallel, but **every ``await`` is a preemption point**, so
+any read-check-act sequence over shared state that straddles an await can
+interleave with another task and act on a stale view. No per-file rule
+can see it, because "shared" is a whole-program property.
+
+Built on :mod:`callgraph`, this pass:
+
+1. infers **concurrency roots** — every ``spawn_tracked`` /
+   ``create_task`` / ``ensure_future`` site (spawned-in-a-loop roots are
+   reentrant), every handler reference registered via ``subscribe(...)``
+   or an aiohttp route table (reentrant: they fire per message/request),
+   and every async def nothing in the project calls (an API entry point
+   servers/tests invoke — reentrant, conservatively);
+2. computes which functions each root reaches, and upgrades spawns made
+   from already-concurrent code to reentrant (fixpoint);
+3. models **shared state** as ``self.<attr>`` object attributes whose
+   accesses span ≥2 roots (or any reentrant root); and
+4. checks three interprocedural rules plus the ``# guarded-by:``
+   annotation discipline:
+
+- **DL012 atomicity-across-await** — a shared attribute loaded at one
+  await-epoch and plain-stored at a later epoch in the same (async)
+  function, with no re-read after the last await and no lock common to
+  both accesses. This is the lost-update / stale-check shape:
+  ``v = self.x`` … ``await`` … ``self.x = f(v)``, or
+  ``if not self.x:`` … ``await`` … ``self.x = y``. Single-statement
+  mutations (``+=``, ``d[k] = v``, ``.append``) are atomic under the
+  event loop and never fire on their own; the sanctioned fix is to
+  re-check after the await (double-checked update) or hold one lock
+  across the whole sequence.
+- **DL013 unguarded-concurrent-mutation** — (a) an access to a field
+  annotated ``# guarded-by: self.<lock>`` from an async frame in a
+  concurrent context without that lock held; (b) a ``guarded-by``
+  annotation naming a lock the class never assigns; (c) an unannotated
+  shared field mutated under some lock at one site and without it at
+  another async-frame site (inconsistent discipline, RacerD-style).
+- **DL014 lock-order-inversion** — two locks acquired in opposite
+  nesting orders anywhere in the program (lexical nesting plus calls
+  made while holding a lock into functions that acquire others): two
+  tasks taking them concurrently deadlock the loop forever.
+
+**The guarded-by grammar** (attach to the attribute's assignment line,
+or the line above):
+
+- ``# guarded-by: self.<lock_attr>`` — lock discipline: every access
+  from an *async* frame of the class must be lexically under
+  ``with``/``async with self.<lock_attr>``. Sync frames are exempt — a
+  sync call cannot be preempted by the event loop, so it is atomic; the
+  lock is required exactly where control can yield.
+- ``# guarded-by: loop`` — event-loop affinity: the field relies on
+  single-threaded atomicity, so DL012 is enforced on it
+  *unconditionally* (any async frame, shared or not). This is the
+  right annotation for demux tables and bookkeeping dicts that only
+  ever see single-statement accesses.
+
+Like every dynalint rule, ``# dynalint: disable=<rule>`` suppresses at
+the reported line; DL012 additionally honors a suppression at the
+pre-await read line (both ends, like DL008's call-site/sink pair).
+
+The same callgraph drives the interprocedural extension of **DL005**:
+a host-sync primitive (``np.asarray``, ``.item()``, ``block_until_ready``)
+reached from an engine hot-path function through a chain of sync helpers
+fires at the hot function's call site, with ``HOT_SYNC_ALLOWLIST``
+members excluded both as origins and as sanctioned sinks.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .analyzer import (HOT_RE, HOT_SYNC_ALLOWLIST, LOCK_NAME_RE, RULES,
+                       ModuleSource, Violation, call_attr, dotted)
+from .callgraph import DEFAULT_DL008_DEPTH, CallGraph, module_name
+
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*|loop)")
+
+# receiver methods that mutate the container in place: `self.A.pop(...)`
+# is a MUTATION of A for the discipline rules (but a single synchronous
+# statement, so atomic — it never fires DL012 by itself)
+MUTATOR_ATTRS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "sort", "reverse", "move_to_end", "put_nowait",
+})
+
+_HOT_PATH_MARKER = "engine/"
+
+
+# ------------------------------------------------------------------ scanning
+
+@dataclass
+class Access:
+    attr: str
+    kind: str                 # 'load' | 'store' | 'mut'
+    line: int
+    col: int
+    epoch: int                # awaits/yields seen before this access
+    locks: FrozenSet[str]     # normalized lock ids held
+
+
+@dataclass
+class FuncScan:
+    key: str                  # '<module>:<qualname>' (matches callgraph)
+    cls: Optional[str]        # owner class name, None for free functions
+    is_async: bool
+    accesses: List[Access] = field(default_factory=list)
+    # locks this function acquires anywhere in its body
+    acquires: Set[str] = field(default_factory=set)
+    # (held_lock, acquired_lock, line) lexical nesting orders
+    orders: List[Tuple[str, str, int]] = field(default_factory=list)
+    # (callee_raw, held_locks, line) calls made while holding ≥1 lock
+    calls_under_lock: List[Tuple[str, FrozenSet[str], int]] = \
+        field(default_factory=list)
+
+
+@dataclass
+class ModuleScan:
+    ms: ModuleSource
+    modname: str
+    funcs: Dict[str, FuncScan] = field(default_factory=dict)
+    # (class, attr) -> (spec, line); spec is 'loop' or 'self.<lock_attr>'
+    guarded: Dict[Tuple[str, str], Tuple[str, int]] = \
+        field(default_factory=dict)
+    # class -> attrs ever assigned through self (lock existence check)
+    class_attrs: Dict[str, Set[str]] = field(default_factory=dict)
+
+
+class _RaceScan(ast.NodeVisitor):
+    """One pass per module: attribute accesses with await-epoch and
+    held-lock context, lock acquisition orders, guarded-by annotations."""
+
+    def __init__(self, ms: ModuleSource):
+        self.out = ModuleScan(ms, module_name(ms.path))
+        # line -> (spec, standalone): a trailing comment binds only to
+        # its own line; a standalone comment line binds to the next
+        self._annot: Dict[int, Tuple[str, bool]] = {}
+        for i, line in enumerate(ms.src.splitlines(), start=1):
+            m = GUARDED_BY_RE.search(line)
+            if m:
+                standalone = not line.split("#", 1)[0].strip()
+                self._annot[i] = (m.group(1), standalone)
+        self._classes: List[str] = []
+        self._frames: List[FuncScan] = []
+        self._epochs: List[int] = []
+        self._locks: List[List[str]] = []
+
+    # ------------------------------------------------------------- scoping
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._classes.append(node.name)
+        self.generic_visit(node)
+        self._classes.pop()
+
+    def _visit_func(self, node, is_async: bool) -> None:
+        # qualname: classes + enclosing function names + this name, the
+        # same construction as callgraph._Collector so keys line up
+        names = self._classes + self._func_names() + [node.name]
+        fs = FuncScan(key=f"{self.out.modname}:{'.'.join(names)}",
+                      cls=self._classes[0] if self._classes else None,
+                      is_async=is_async)
+        self.out.funcs[fs.key] = fs
+        self._frames.append(fs)
+        self._epochs.append(0)
+        self._locks.append([])
+        self.generic_visit(node)
+        self._locks.pop()
+        self._epochs.pop()
+        self._frames.pop()
+
+    def _func_names(self) -> List[str]:
+        return [f.key.split(":", 1)[1].split(".")[-1] for f in self._frames]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node, False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node, True)
+
+    # -------------------------------------------------------------- epochs
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self.generic_visit(node)       # the awaited expr runs pre-suspend
+        if self._epochs:
+            self._epochs[-1] += 1
+
+    def _visit_yield(self, node) -> None:
+        self.generic_visit(node)
+        if self._epochs:
+            self._epochs[-1] += 1      # generators interleave at yields
+
+    visit_Yield = _visit_yield
+    visit_YieldFrom = _visit_yield
+
+    # --------------------------------------------------------------- locks
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        d = dotted(expr)
+        if d is None or not LOCK_NAME_RE.search(d.rsplit(".", 1)[-1]):
+            return None
+        if d.startswith("self.") and self._classes:
+            return f"{self.out.modname}:{self._classes[0]}.{d[5:]}"
+        return f"{self.out.modname}:{d}"
+
+    def _visit_with(self, node) -> None:
+        acquired: List[str] = []
+        for item in node.items:
+            lid = self._lock_id(item.context_expr)
+            if lid is not None:
+                acquired.append(lid)
+        frame = self._frames[-1] if self._frames else None
+        stack = self._locks[-1] if self._locks else []
+        for lid in acquired:
+            if frame is not None:
+                frame.acquires.add(lid)
+                for held in stack:
+                    if held != lid:
+                        frame.orders.append((held, lid, node.lineno))
+            stack.append(lid)
+        self.generic_visit(node)
+        for _ in acquired:
+            stack.pop()
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # ------------------------------------------------------------ accesses
+
+    def _held(self) -> FrozenSet[str]:
+        return frozenset(self._locks[-1]) if self._locks else frozenset()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # calls made while holding a lock: the DL014 interprocedural
+        # edge (the callee may acquire other locks)
+        if self._frames:
+            held = self._held()
+            if held:
+                d = dotted(node.func)
+                if d is not None:
+                    self._frames[-1].calls_under_lock.append(
+                        (d, held, node.lineno))
+        self.generic_visit(node)
+
+    def _record(self, attr: str, kind: str, node: ast.AST) -> None:
+        if not self._frames:
+            return
+        self._frames[-1].accesses.append(Access(
+            attr, kind, node.lineno, getattr(node, "col_offset", 0),
+            self._epochs[-1], self._held()))
+
+    def _note_guarded(self, attr: str, line: int) -> None:
+        """Bind a guarded-by annotation (trailing on the assignment
+        line, or a standalone comment on the line above) to
+        (class, attr)."""
+        if not self._classes:
+            return
+        hit = self._annot.get(line)
+        if hit is None:
+            above = self._annot.get(line - 1)
+            hit = above if above is not None and above[1] else None
+        if hit is not None:
+            self.out.guarded[(self._classes[0], attr)] = (hit[0], line)
+
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            return node.attr
+        return None
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self._self_attr(node)
+        if attr is None:
+            self.generic_visit(node)
+            return
+        if self._classes:
+            self.out.class_attrs.setdefault(self._classes[0], set())
+        parent = getattr(node, "_dl_parent", None)
+        if isinstance(node.ctx, ast.Store):
+            # reached via tuple targets / for-targets / withitems; plain
+            # `self.x = ...` goes through _visit_store_target instead.
+            # Either way the Assign visitors ran the value first, so the
+            # store lands at the post-await epoch.
+            if self._classes:
+                self.out.class_attrs[self._classes[0]].add(attr)
+            self._note_guarded(attr, node.lineno)
+            self._record(attr, "store", node)
+            self.generic_visit(node)
+            return
+        if isinstance(node.ctx, ast.Del):
+            self._record(attr, "mut", node)
+            self.generic_visit(node)
+            return
+        # Load context: classify by what encloses the attribute
+        if isinstance(parent, ast.Call) and parent.func is node:
+            pass  # `self.meth(...)`: a call edge, not a state access
+        elif isinstance(parent, ast.Attribute) and parent.value is node:
+            gp = getattr(parent, "_dl_parent", None)
+            if isinstance(parent.ctx, ast.Store):
+                self._record(attr, "mut", node)   # self.a.b = v
+            elif isinstance(gp, ast.Call) and gp.func is parent:
+                self._record(attr, "mut" if parent.attr in MUTATOR_ATTRS
+                             else "load", node)   # self.a.meth(...)
+            else:
+                self._record(attr, "load", node)
+        elif isinstance(parent, ast.Subscript) and parent.value is node:
+            if isinstance(parent.ctx, (ast.Store, ast.Del)):
+                self._record(attr, "mut", node)   # self.a[k] = v / del
+            else:
+                self._record(attr, "load", node)
+        else:
+            self._record(attr, "load", node)
+        self.generic_visit(node)
+
+    # value-before-targets visit order so stores land at the POST-await
+    # epoch for `self.x = await f()` (the suspension happens before the
+    # store, which is exactly when another task can interleave)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        for t in node.targets:
+            self._visit_store_target(t)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+        self._visit_store_target(node.target)
+
+    def visit_NamedExpr(self, node: ast.NamedExpr) -> None:
+        self.visit(node.value)
+        self._visit_store_target(node.target)
+
+    def _visit_store_target(self, t: ast.AST) -> None:
+        attr = self._self_attr(t)
+        if attr is not None:
+            if self._classes:
+                self.out.class_attrs.setdefault(
+                    self._classes[0], set()).add(attr)
+            self._note_guarded(attr, t.lineno)
+            self._record(attr, "store", t)
+            return
+        self.visit(t)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = self._self_attr(node.target)
+        if attr is not None:
+            # `self.x += v`: load first, evaluate v (awaits bump the
+            # epoch), then store — `self.x += await f()` IS a lost update
+            self._record(attr, "load", node.target)
+            self.visit(node.value)
+            if self._classes:
+                self.out.class_attrs.setdefault(
+                    self._classes[0], set()).add(attr)
+            self._record(attr, "store", node.target)
+            return
+        self.visit(node.target)
+        self.visit(node.value)
+
+
+def scan_modules(sources: Sequence[ModuleSource]) -> List[ModuleScan]:
+    out = []
+    for ms in sources:
+        scan = _RaceScan(ms)
+        scan.visit(ms.tree)
+        out.append(scan.out)
+    return out
+
+
+# --------------------------------------------------------------- race model
+
+@dataclass
+class RootInfo:
+    key: str
+    kind: str            # 'task' | 'handler' | 'entry'
+    reentrant: bool
+
+
+@dataclass
+class RaceModel:
+    roots: Dict[str, RootInfo]
+    func_roots: Dict[str, Set[str]]          # function key -> root keys
+    shared_attrs: Set[Tuple[str, str, str]]  # (module, class, attr)
+    shared_funcs: Set[str]                   # functions touching shared state
+
+    def concurrent(self, key: str) -> bool:
+        roots = self.func_roots.get(key, set())
+        if len(roots) >= 2:
+            return True
+        return any(self.roots[r].reentrant for r in roots)
+
+
+def _reach_from(graph: CallGraph, root: str) -> Set[str]:
+    seen = {root}
+    stack = [root]
+    while stack:
+        fi = graph.functions.get(stack.pop())
+        if fi is None:
+            continue
+        for cs in fi.calls:
+            if cs.target and cs.target in graph.functions \
+                    and cs.target not in seen:
+                seen.add(cs.target)
+                stack.append(cs.target)
+    return seen
+
+
+def build_race_model(graph: CallGraph,
+                     scans: Sequence[ModuleScan]) -> RaceModel:
+    roots: Dict[str, RootInfo] = {}
+    spawned: Set[str] = set()
+    registered: Set[str] = set()
+    for fi in graph.functions.values():
+        for sp in fi.spawns:
+            if sp.target and sp.target in graph.functions:
+                spawned.add(sp.target)
+                prev = roots.get(sp.target)
+                roots[sp.target] = RootInfo(
+                    sp.target, "task",
+                    sp.in_loop or (prev.reentrant if prev else False))
+        for hr in fi.handler_refs:
+            if hr.target and hr.target in graph.functions:
+                registered.add(hr.target)
+                roots[hr.target] = RootInfo(hr.target, "handler", True)
+    called: Set[str] = set()
+    for fi in graph.functions.values():
+        for cs in fi.calls:
+            if cs.target:
+                called.add(cs.target)
+    for key, fi in graph.functions.items():
+        if fi.is_async and key not in called and key not in spawned \
+                and key not in registered:
+            # an async def nothing in the project calls: an entry point
+            # that servers/tests/users drive — conservatively reentrant
+            roots.setdefault(key, RootInfo(key, "entry", True))
+
+    func_roots: Dict[str, Set[str]] = {}
+    reach_cache: Dict[str, Set[str]] = {}
+    for rk in roots:
+        reach_cache[rk] = _reach_from(graph, rk)
+    for _round in range(8):  # reentrancy fixpoint (converges in 2-3)
+        func_roots = {}
+        for rk in roots:
+            for f in reach_cache[rk]:
+                func_roots.setdefault(f, set()).add(rk)
+        changed = False
+        for fi in graph.functions.values():
+            frs = func_roots.get(fi.key, set())
+            concurrent = len(frs) >= 2 or \
+                any(roots[r].reentrant for r in frs)
+            if not concurrent:
+                continue
+            for sp in fi.spawns:
+                r = roots.get(sp.target) if sp.target else None
+                if r is not None and not r.reentrant:
+                    # spawned from already-concurrent code: many copies
+                    # of this task can exist at once
+                    roots[sp.target] = RootInfo(r.key, r.kind, True)
+                    changed = True
+        if not changed:
+            break
+
+    shared_attrs: Set[Tuple[str, str, str]] = set()
+    shared_funcs: Set[str] = set()
+    attr_roots: Dict[Tuple[str, str, str], Set[str]] = {}
+    for scan in scans:
+        for fs in scan.funcs.values():
+            if fs.cls is None:
+                continue
+            for acc in fs.accesses:
+                key = (scan.modname, fs.cls, acc.attr)
+                attr_roots.setdefault(key, set()).update(
+                    func_roots.get(fs.key, set()))
+    for key, rset in attr_roots.items():
+        if len(rset) >= 2 or any(roots[r].reentrant for r in rset):
+            shared_attrs.add(key)
+    for scan in scans:
+        for fs in scan.funcs.values():
+            if fs.cls is None:
+                continue
+            if any((scan.modname, fs.cls, a.attr) in shared_attrs
+                   for a in fs.accesses):
+                shared_funcs.add(fs.key)
+    return RaceModel(roots, func_roots, shared_attrs, shared_funcs)
+
+
+# ------------------------------------------------------------------- checks
+
+def _suppressed(ms: ModuleSource, line: int, code: str) -> bool:
+    name = RULES[code][0]
+    for probe in (line, line - 1):
+        tags = ms.suppressed.get(probe)
+        if tags and (code in tags or name in tags or "all" in tags):
+            return True
+    return False
+
+
+def _scope_of(key: str) -> str:
+    return key.split(":", 1)[1]
+
+
+def _norm_spec(scan: ModuleScan, cls: str, spec: str) -> Optional[str]:
+    """'self._conn_lock' -> the normalized lock id used on accesses."""
+    if spec.startswith("self."):
+        return f"{scan.modname}:{cls}.{spec[5:]}"
+    return None
+
+
+def _check_atomicity(scan: ModuleScan, fs: FuncScan, attrs: Set[str],
+                     out: List[Violation]) -> None:
+    """DL012 over one function: plain store at epoch e2 with a load at an
+    earlier epoch, no re-read after the last await, no common lock."""
+    name, summary = RULES["DL012"]
+    by_attr: Dict[str, List[Access]] = {}
+    for acc in fs.accesses:
+        if acc.attr in attrs:
+            by_attr.setdefault(acc.attr, []).append(acc)
+    for attr, accs in sorted(by_attr.items()):
+        loads = [a for a in accs if a.kind == "load"]
+        for st in accs:
+            if st.kind != "store" or st.epoch == 0:
+                continue
+            if any(l.epoch == st.epoch and l.line <= st.line
+                   for l in loads):
+                continue  # re-validated after the last await
+            stale = [l for l in loads if l.epoch < st.epoch
+                     and not (l.locks & st.locks)]
+            if not stale:
+                continue
+            first = min(stale, key=lambda l: (l.epoch, l.line))
+            if _suppressed(scan.ms, st.line, "DL012") or \
+                    _suppressed(scan.ms, first.line, "DL012"):
+                continue
+            out.append(Violation(
+                scan.ms.path, st.line, st.col, "DL012", name,
+                f"{summary}: `self.{attr}` read at line {first.line}, "
+                f"then written here after ≥1 await with no re-check "
+                f"and no common lock", _scope_of(fs.key)))
+
+
+def check_races(scans: Sequence[ModuleScan],
+                model: RaceModel) -> List[Violation]:
+    """DL012 + DL013 over the scanned modules."""
+    out: List[Violation] = []
+    # global guarded-by table: (module, class, attr) -> (spec, line, scan)
+    guarded: Dict[Tuple[str, str, str], Tuple[str, int, ModuleScan]] = {}
+    for scan in scans:
+        for (cls, attr), (spec, line) in scan.guarded.items():
+            guarded[(scan.modname, cls, attr)] = (spec, line, scan)
+
+    d13_name, d13_summary = RULES["DL013"]
+
+    # DL013(b): the named lock must exist on the class
+    for (mod, cls, attr), (spec, line, scan) in sorted(guarded.items()):
+        if spec == "loop":
+            continue
+        lock_attr = spec[5:] if spec.startswith("self.") else None
+        if lock_attr is None or \
+                lock_attr not in scan.class_attrs.get(cls, set()):
+            if not _suppressed(scan.ms, line, "DL013"):
+                out.append(Violation(
+                    scan.ms.path, line, 0, "DL013", d13_name,
+                    f"{d13_summary}: `# guarded-by: {spec}` on "
+                    f"`{cls}.{attr}` names a lock the class never "
+                    f"assigns", cls))
+
+    # per-mutation lock observations for the inconsistent-discipline check
+    mut_locks: Dict[Tuple[str, str, str], Set[str]] = {}
+    for scan in scans:
+        for fs in scan.funcs.values():
+            if fs.cls is None:
+                continue
+            for acc in fs.accesses:
+                if acc.kind in ("store", "mut") and acc.locks:
+                    mut_locks.setdefault(
+                        (scan.modname, fs.cls, acc.attr),
+                        set()).update(acc.locks)
+
+    for scan in scans:
+        for fs in sorted(scan.funcs.values(), key=lambda f: f.key):
+            if fs.cls is None:
+                continue
+            # attrs DL012 applies to in this function: shared ones when
+            # the function is concurrent, plus loop-annotated ones always
+            d12_attrs: Set[str] = set()
+            concurrent = model.concurrent(fs.key)
+            for acc in fs.accesses:
+                key = (scan.modname, fs.cls, acc.attr)
+                spec = guarded.get(key)
+                if spec is not None and spec[0] == "loop" and fs.is_async:
+                    d12_attrs.add(acc.attr)
+                elif concurrent and key in model.shared_attrs \
+                        and fs.is_async:
+                    d12_attrs.add(acc.attr)
+            if d12_attrs:
+                _check_atomicity(scan, fs, d12_attrs, out)
+
+            if not fs.is_async or not concurrent:
+                continue  # sync frames are event-loop atomic
+            seen_lines: Set[Tuple[str, int]] = set()
+            for acc in fs.accesses:
+                key = (scan.modname, fs.cls, acc.attr)
+                spec = guarded.get(key)
+                if spec is not None and spec[0] != "loop":
+                    want = _norm_spec(spec[2], fs.cls, spec[0])
+                    if want is not None and want not in acc.locks:
+                        if (acc.attr, acc.line) in seen_lines or \
+                                _suppressed(scan.ms, acc.line, "DL013"):
+                            continue
+                        seen_lines.add((acc.attr, acc.line))
+                        out.append(Violation(
+                            scan.ms.path, acc.line, acc.col, "DL013",
+                            d13_name,
+                            f"{d13_summary}: `self.{acc.attr}` is "
+                            f"`# guarded-by: {spec[0]}` but this async "
+                            f"frame touches it without the lock",
+                            _scope_of(fs.key)))
+                elif spec is None and acc.kind in ("store", "mut") \
+                        and key in model.shared_attrs:
+                    want_any = mut_locks.get(key, set())
+                    if want_any and not (acc.locks & want_any):
+                        if (acc.attr, acc.line) in seen_lines or \
+                                _suppressed(scan.ms, acc.line, "DL013"):
+                            continue
+                        seen_lines.add((acc.attr, acc.line))
+                        locks = "/".join(sorted(
+                            w.split(":", 1)[1] for w in want_any))
+                        out.append(Violation(
+                            scan.ms.path, acc.line, acc.col, "DL013",
+                            d13_name,
+                            f"{d13_summary}: `self.{acc.attr}` is "
+                            f"mutated under `{locks}` elsewhere but "
+                            f"without it here — annotate it "
+                            f"`# guarded-by:` and pick one discipline",
+                            _scope_of(fs.key)))
+    return out
+
+
+# ----------------------------------------------------------------- DL014
+
+def check_lock_order(scans: Sequence[ModuleScan],
+                     graph: CallGraph) -> List[Violation]:
+    """Collect lock acquisition orders (lexical nesting + one call level
+    deep while holding a lock) and flag inverted pairs."""
+    # transitive acquires, bounded: direct + callees' direct
+    direct: Dict[str, Set[str]] = {}
+    fscans: Dict[str, FuncScan] = {}
+    for scan in scans:
+        for fs in scan.funcs.values():
+            fscans[fs.key] = fs
+            direct[fs.key] = set(fs.acquires)
+    trans: Dict[str, Set[str]] = {k: set(v) for k, v in direct.items()}
+    for _ in range(3):
+        changed = False
+        for fi in graph.functions.values():
+            acc = trans.get(fi.key)
+            if acc is None:
+                continue
+            for cs in fi.calls:
+                sub = trans.get(cs.target) if cs.target else None
+                if sub and not sub <= acc:
+                    acc |= sub
+                    changed = True
+        if not changed:
+            break
+
+    # ordered pairs with one representative site each
+    pairs: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for scan in scans:
+        for fs in sorted(scan.funcs.values(), key=lambda f: f.key):
+            for held, got, line in fs.orders:
+                pairs.setdefault((held, got),
+                                 (scan.ms.path, line, _scope_of(fs.key)))
+            fi = graph.functions.get(fs.key)
+            if fi is None:
+                continue
+            # calls made under a lock into functions acquiring others:
+            # lexical nesting can't see these, the callgraph can. Join
+            # the scan's held-lock context to the resolved call edge by
+            # (line, raw callee).
+            targets = {(cs.line, cs.raw): cs.target for cs in fi.calls
+                       if cs.target}
+            for raw, locks, line in fs.calls_under_lock:
+                target = targets.get((line, raw))
+                sub = trans.get(target) if target else None
+                if not sub:
+                    continue
+                for held in sorted(locks):
+                    for got in sorted(sub):
+                        if held != got:
+                            pairs.setdefault(
+                                (held, got),
+                                (scan.ms.path, line, _scope_of(fs.key)))
+
+    name, summary = RULES["DL014"]
+    out: List[Violation] = []
+    scan_by_path = {scan.ms.path: scan for scan in scans}
+    for (a, b), (path, line, scope) in sorted(pairs.items()):
+        if a >= b or (b, a) not in pairs:
+            continue  # report each inverted pair once per direction
+        rpath, rline, rscope = pairs[(b, a)]
+        for p, ln, sc, first, second, op, ol in (
+                (path, line, scope, a, b, rpath, rline),
+                (rpath, rline, rscope, b, a, path, line)):
+            scan = scan_by_path.get(p)
+            if scan is not None and _suppressed(scan.ms, ln, "DL014"):
+                continue
+            out.append(Violation(
+                p, ln, 0, "DL014", name,
+                f"{summary}: `{first.split(':', 1)[1]}` then "
+                f"`{second.split(':', 1)[1]}` here, but the opposite "
+                f"order at {op}:{ol}", sc))
+    return out
+
+
+# --------------------------------------------------- DL005 interprocedural
+
+@dataclass
+class _SyncPath:
+    depth: int
+    chain: List[str]
+    sink_path: str
+    sink_line: int
+    what: str
+
+
+def check_transitive_host_sync(graph: CallGraph,
+                               max_depth: int = DEFAULT_DL008_DEPTH
+                               ) -> List[Violation]:
+    """Interprocedural DL005: a host-sync primitive reached from an
+    engine hot-path function through sync helpers fires at the hot
+    function's call site. ``HOT_SYNC_ALLOWLIST`` qualnames are excluded
+    both as hot origins and as sanctioned callees/sinks."""
+    reach: Dict[str, _SyncPath] = {}
+    for fi in graph.functions.values():
+        if fi.is_async or not fi.host_sync \
+                or fi.qualname in HOT_SYNC_ALLOWLIST:
+            continue
+        line, what = fi.host_sync[0]
+        reach[fi.key] = _SyncPath(0, [fi.key], fi.path, line, what)
+    changed = True
+    while changed:
+        changed = False
+        for fi in graph.functions.values():
+            if fi.is_async or fi.qualname in HOT_SYNC_ALLOWLIST:
+                continue
+            for cs in fi.calls:
+                sub = reach.get(cs.target) if cs.target else None
+                if sub is None:
+                    continue
+                callee = graph.functions.get(cs.target)
+                if callee is None or callee.is_async \
+                        or callee.qualname in HOT_SYNC_ALLOWLIST:
+                    continue
+                depth = sub.depth + 1
+                cur = reach.get(fi.key)
+                if depth <= max_depth and \
+                        (cur is None or depth < cur.depth):
+                    reach[fi.key] = _SyncPath(
+                        depth, [fi.key] + sub.chain,
+                        sub.sink_path, sub.sink_line, sub.what)
+                    changed = True
+
+    name, summary = RULES["DL005"]
+    out: List[Violation] = []
+    seen: Set[Tuple[str, str]] = set()
+    for fi in graph.functions.values():
+        if _HOT_PATH_MARKER not in fi.path.replace("\\", "/"):
+            continue
+        if not HOT_RE.search(fi.name) \
+                or fi.qualname in HOT_SYNC_ALLOWLIST:
+            continue
+        mod = graph.modules[fi.module]
+        for cs in fi.calls:
+            sub = reach.get(cs.target) if cs.target else None
+            if sub is None or cs.target == fi.key:
+                continue
+            callee = graph.functions.get(cs.target)
+            if callee is not None and HOT_RE.search(callee.name):
+                continue  # hot callees carry their own per-file duty
+            if (fi.key, cs.target) in seen:
+                continue
+            seen.add((fi.key, cs.target))
+            suppressed = False
+            for probe in (cs.line, cs.line - 1):
+                tags = mod.suppressed.get(probe)
+                if tags and ({"DL005", name, "all"} & tags):
+                    suppressed = True
+            if suppressed:
+                continue
+            chain = " -> ".join(
+                k.split(":", 1)[1] for k in sub.chain)
+            out.append(Violation(
+                fi.path, cs.line, cs.col, "DL005", name,
+                f"{summary}: `{cs.raw}` reaches host sync {sub.what} via "
+                f"{chain} ({sub.sink_path}:{sub.sink_line})",
+                fi.qualname))
+    return out
+
+
+# ------------------------------------------------------------------ driver
+
+def analyze_races(sources: Sequence[ModuleSource],
+                  graph: Optional[CallGraph] = None,
+                  model_out: Optional[dict] = None) -> List[Violation]:
+    """Run the dynarace passes (DL012/DL013/DL014 + interprocedural
+    DL005) over already-loaded modules. Pass ``model_out={}`` to receive
+    the built :class:`RaceModel` under key ``"model"`` (dot export)."""
+    if graph is None:
+        graph = CallGraph.build(sources)
+    scans = scan_modules(sources)
+    model = build_race_model(graph, scans)
+    if model_out is not None:
+        model_out["model"] = model
+    out: List[Violation] = []
+    out.extend(check_races(scans, model))
+    out.extend(check_lock_order(scans, graph))
+    out.extend(check_transitive_host_sync(graph))
+    out.sort(key=lambda v: (v.path, v.line, v.code))
+    return out
