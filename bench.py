@@ -399,6 +399,7 @@ async def run_multiturn(args):
         "prefix_hit_rate": round(stats["gpu_prefix_cache_hit_rate"], 4),
         "host_restores": stats["host_restore_pages_total"],
         "host_offloads": stats["host_offload_pages_total"],
+        "post_warmup_compiles": stats["post_warmup_compiles_total"],
     }
     print(json.dumps(report), file=sys.stderr)
     return report
@@ -566,6 +567,12 @@ async def run_bench(args):
                            trace=getattr(args, "trace", False))
     st = engine.stats()
     report["prefix_hit_rate"] = round(st["gpu_prefix_cache_hit_rate"], 4)
+    # compile-regression gate for hot-path work (ROADMAP item 3): any
+    # nonzero value means a serve-time XLA compile stalled the run
+    report["post_warmup_compiles"] = st["post_warmup_compiles_total"]
+    if getattr(args, "trace", False):
+        print(f"trace compile fence: {st['post_warmup_compiles_total']} "
+              f"post-warmup XLA compile(s)", file=sys.stderr)
     if engine.ecfg.spec_decode:
         report["spec_steps"] = st["spec_decode_steps"]
         report["spec_acceptance_rate"] = round(
@@ -599,6 +606,8 @@ async def run_disagg(args):
     reqs = synth_requests(args, cfg.vocab_size, engine.cap_tokens)
     agg = await measure(engine, reqs, args.concurrency,
                         trace=getattr(args, "trace", False))
+    agg["post_warmup_compiles"] = \
+        engine.stats()["post_warmup_compiles_total"]
     await engine.stop()
     base_ecfg = engine.ecfg
     del engine
@@ -649,6 +658,9 @@ async def run_disagg(args):
         st = disagg.stats()
         send = {k: v - before_send[k] for k, v in pw.xfer.__dict__.items()}
         dis["kv_chunk_pages"] = cp
+        dis["post_warmup_compiles"] = (
+            decode_eng.fence.post_warmup_compiles
+            + prefill_eng.fence.post_warmup_compiles)
         dis["remote_prefills"] = (st["remote_prefills"]
                                   - before_st["remote_prefills"])
         dis["local_prefills"] = (st["local_prefills"]

@@ -47,6 +47,7 @@ from ..models.registry import get_model_module
 from ..runtime import tracing
 from ..runtime.config import env_int
 from ..runtime.engine import Context
+from .jit_fence import CompileFence
 from .kv_manager import PageManager
 from .sampling import (SamplingBatch, logprob_aux, sample_tokens,
                        update_penalty_state, verify_greedy_draft)
@@ -196,6 +197,32 @@ class EngineConfig:
 
     def bucket_pages(self, n: int) -> int:
         return self._pick(self.page_buckets, n)
+
+    def warmed_grid(self) -> dict:
+        """The EXACT images of the bucket helpers over every admissible
+        serving input — the shape set warmup() must compile so no jitted
+        engine entry point ever compiles mid-serving. Computed by
+        enumeration rather than from the bucket tuples directly because
+        ``_pick`` doubles past its last bucket: with exotic configs
+        (``prefill_chunk`` above the largest prefill bucket,
+        ``max_batch`` outside ``batch_buckets``) the reachable shapes are
+        a strict superset of the declared buckets. The compile-fence
+        grid-coverage test pins warmup() to this set."""
+        cap_pages = min(self.page_buckets[-1], max(self.num_pages - 1, 1))
+        return {
+            "prefill_lens": sorted({
+                self.bucket_len(n)
+                for n in range(1, self.prefill_chunk + 1)}),
+            "decode_batches": sorted({
+                self.bucket_batch(n)
+                for n in range(1, self.max_batch + 1)}),
+            "prefill_batches": sorted({
+                self.prefill_bucket_batch(n)
+                for n in range(1, max(self.max_prefill_batch,
+                                      self.max_batch) + 1)}),
+            "page_buckets": sorted({
+                self.bucket_pages(n) for n in range(1, cap_pages + 1)}),
+        }
 
 
 @dataclass(eq=False)  # identity semantics: `in`/`==` must never deep-compare
@@ -368,7 +395,11 @@ class JaxEngine:
                 self.host_k_s = np.zeros(hk[:-1] + (1,), np.float32)
                 self.host_v_s = np.zeros(hv[:-1] + (1,), np.float32)
             else:
-                hdtype = np.asarray(jnp.zeros((), self.kv_k.dtype)).dtype
+                # the pool's .dtype is already a numpy dtype (ml_dtypes
+                # registers bf16) — resolving it through a device
+                # round-trip (np.asarray(jnp.zeros(...))) was dynajit
+                # DL017's first true positive
+                hdtype = np.dtype(self.kv_k.dtype)
                 self.host_k = np.zeros(hk, hdtype)
                 self.host_v = np.zeros(hv, hdtype)
         self.offload_pages_total = 0
@@ -416,6 +447,12 @@ class JaxEngine:
             env_int("DYN_STEP_TIMELINE") or 0)
         tracing.register_timeline(f"jax-engine-{id(self):x}",
                                   self.step_timeline)
+        # runtime compile fence (engine/jit_fence.py): armed by warmup(),
+        # counts every post-warmup XLA compile; DYN_JIT_FENCE=warn|raise
+        # escalates. The counter rides stats() → ForwardPassMetrics →
+        # dyn_engine_post_warmup_compiles_total.
+        self.fence = CompileFence(f"jax-engine-{id(self):x}",
+                                  timeline=self.step_timeline)
         self.queue_wait_seconds_total = 0.0
         self.prefill_tokens_total = 0
         # iterations where a decode window dispatched WHILE prompts were
@@ -435,13 +472,17 @@ class JaxEngine:
         ``decode=False`` skips the decode-window grid — for prefill-only
         workers (disagg), whose engine never runs a decode step."""
         ecfg = self.ecfg
-        page_buckets = [p for p in ecfg.page_buckets] or [8]
+        # the EXACT reachable shape images (not the declared bucket
+        # tuples): _pick doubles past its last bucket, so exotic configs
+        # reach shapes the tuples alone would miss — compiling them
+        # mid-serving (the compile fence below counts such misses)
+        grid = ecfg.warmed_grid()
+        page_buckets = grid["page_buckets"] or [8]
         t0 = time.monotonic()
         n = 0
-        prefill_bs = {ecfg.bucket_batch(1),
-                      ecfg.bucket_batch(ecfg.max_prefill_batch)}
+        prefill_bs = grid["prefill_batches"]
         for P in page_buckets:
-            for T in {ecfg.bucket_len(t) for t in ecfg.prefill_buckets}:
+            for T in grid["prefill_lens"]:
                 for PB in prefill_bs:
                     # warm exactly the serving variant: page-granular
                     # commit for ps-aligned buckets, row scatter otherwise
@@ -454,14 +495,19 @@ class JaxEngine:
                         self.kv_k, self.kv_v, jnp.zeros((PB, P), jnp.int32),
                         jnp.full((PB, T), DROP_SLOT, jnp.int32),
                         jnp.zeros((PB,), jnp.int32), pslots)
+                    # penalties=None EXPLICITLY: the jit cache keys on the
+                    # call's (args, kwargs) treedef, so an explicit-None
+                    # kwarg and an omitted default are DIFFERENT entries —
+                    # _sample_device always passes penalties=, and warming
+                    # the omitted form left every serving bucket one
+                    # compile short (found by the compile fence)
                     sample_tokens(logits, jnp.zeros(PB),
                                   jnp.zeros(PB, jnp.int32), jnp.ones(PB),
                                   jnp.zeros(PB, jnp.uint32),
                                   jnp.zeros(PB, jnp.int32),
-                                  max_top_k=ecfg.max_top_k)
+                                  max_top_k=ecfg.max_top_k, penalties=None)
                     n += 1
-            for B in ({ecfg.bucket_batch(b) for b in ecfg.batch_buckets}
-                      if decode else set()):
+            for B in (grid["decode_batches"] if decode else []):
                 tableB = jnp.zeros((B, P), jnp.int32)
                 if ecfg.decode_steps > 1:
                     # warm the penalty-free variant always; the penalized
@@ -477,6 +523,11 @@ class JaxEngine:
                             jnp.zeros((B, V), jnp.int8),
                             jnp.ones(B), jnp.zeros(B), jnp.zeros(B)))
                     for pv in pen_variants:
+                        # logprobs_topn=0 explicitly, matching the serving
+                        # call form in _dispatch_decode_window — the jit
+                        # cache distinguishes explicit static kwargs from
+                        # omitted defaults (compile-fence finding, same
+                        # class as the penalties=None note above)
                         (toks, _carry, self.kv_k,
                          self.kv_v) = self.decode_multi_fn(
                             self.params, jnp.zeros(B, jnp.int32),
@@ -486,7 +537,8 @@ class JaxEngine:
                             tableB, jnp.zeros(B), jnp.zeros(B, jnp.int32),
                             jnp.ones(B), jnp.zeros(B, jnp.uint32),
                             jnp.full((B, ecfg.max_eos_ids), -1, jnp.int32),
-                            pv, k_steps=ecfg.decode_steps)
+                            pv, k_steps=ecfg.decode_steps,
+                            logprobs_topn=0)
                 else:
                     logits, self.kv_k, self.kv_v = self.decode_fn(
                         self.params, jnp.zeros(B, jnp.int32),
@@ -496,7 +548,7 @@ class JaxEngine:
                                   jnp.zeros(B, jnp.int32),
                                   jnp.ones(B), jnp.zeros(B, jnp.uint32),
                                   jnp.zeros(B, jnp.int32),
-                                  max_top_k=ecfg.max_top_k)
+                                  max_top_k=ecfg.max_top_k, penalties=None)
                 if self.verify_fn is not None:
                     # speculative verify grid: one [B, K+1] program per
                     # (B, P) bucket + the accept-mask program per B
@@ -531,7 +583,7 @@ class JaxEngine:
                 sample_tokens(logits, jnp.zeros(1), jnp.zeros(1, jnp.int32),
                               jnp.ones(1), jnp.zeros(1, jnp.uint32),
                               jnp.zeros(1, jnp.int32),
-                              max_top_k=ecfg.max_top_k)
+                              max_top_k=ecfg.max_top_k, penalties=None)
                 n += 1
                 if t >= self.cap_tokens:
                     break
@@ -540,7 +592,7 @@ class JaxEngine:
         # the previous window's device carry with host rows for newly
         # admitted sequences — one compile per (B_prev, B_new) pair
         if decode and ecfg.decode_steps > 1 and ecfg.pipeline_decode:
-            bset = sorted({ecfg.bucket_batch(b) for b in ecfg.batch_buckets})
+            bset = grid["decode_batches"]
             for Bp in bset:
                 carry = (jnp.zeros(Bp, jnp.int32), jnp.zeros(Bp, jnp.int32),
                          jnp.zeros(Bp, bool), jnp.zeros(Bp, jnp.int32),
@@ -553,7 +605,39 @@ class JaxEngine:
                                  jnp.zeros(Bn, jnp.int32),
                                  jnp.ones(Bn, jnp.int32))
                     n += 1
+        # host-tier copy programs: offload gathers / restore scatters run
+        # MID-SERVING on pow2-padded page batches (engine._drain_kv_tier)
+        # — warm every reachable pow2 size so the first eviction/restore
+        # under load never compiles (the dynajit warmup-coverage check
+        # pins these entries to this loop)
+        if self.host_k is not None:
+            size = 1
+            while True:
+                idx = jnp.zeros(size, jnp.int32)
+                # both pools: their page shapes differ per model family
+                # (MLA latent vs rope), so each is its own program set
+                for pool_attr in ("kv_k", "kv_v"):
+                    g = _gather_pages(getattr(self, pool_attr), idx)
+                    if self.ecfg.host_tier_int8:
+                        from .kv_compress import (dequantize_pages,
+                                                  quantize_pages)
+
+                        q, s = quantize_pages(g)
+                        rows = dequantize_pages(q, s)
+                    else:
+                        rows = g
+                    setattr(self, pool_attr, _inject_pages(
+                        getattr(self, pool_attr),
+                        jnp.full((size,), ecfg.num_pages, jnp.int32),
+                        rows))
+                    n += 1
+                if size >= self.ecfg.num_pages:
+                    break
+                size *= 2
         jax.block_until_ready(self.kv_k)
+        # arm the runtime compile fence: from here on, ANY XLA compile is
+        # a serving stall — counted always, warn/raise per DYN_JIT_FENCE
+        self.fence.arm()
         log.info("warmup compiled %d programs in %.1fs", n,
                  time.monotonic() - t0)
         return n
@@ -612,6 +696,9 @@ class JaxEngine:
             "host_offload_pages_total": self.offload_pages_total,
             "host_restore_pages_total": self.restore_pages_total,
             "long_prefills_total": self.long_prefills_total,
+            # compile fence: XLA compiles observed after warmup() armed
+            # the fence (0 = the zero-compile serving invariant holds)
+            "post_warmup_compiles_total": self.fence.post_warmup_compiles,
             # speculative decode observability: acceptance rate is
             # accepted/drafted (drafter quality); mean accepted length is
             # accepted drafts per verify step (tokens-per-dispatch gain —
@@ -1626,10 +1713,6 @@ class JaxEngine:
     def _wants_logprobs(self, seqs: List[Sequence]) -> bool:
         return any(s.req.output.logprobs is not None for s in seqs)
 
-    def _sample(self, seqs: List[Sequence], logits) -> np.ndarray:
-        toks, _ = self._sample_device(seqs, logits)
-        return np.asarray(toks)[:len(seqs)]  # host sync (executor thread)
-
     def _lp_entry(self, seq: Sequence, aux, i: int, j: Optional[int] = None):
         """(logprob, {token_id: logprob, ...}) for row i (step j in a
         window) — None unless this sequence asked for logprobs."""
@@ -1792,9 +1875,14 @@ class JaxEngine:
             # drain could leave some queued)
             if drain:
                 self._drain_kv_tier(full=True)
-            idx = jnp.asarray(page_ids, jnp.int32)
-            return (np.asarray(self.kv_k[:, idx]),
-                    np.asarray(self.kv_v[:, idx]))
+            # pow2-pad the gather so extracts compile O(log n) programs
+            # instead of one per distinct page count (dynajit DL015);
+            # the D2H readback below is the extract's whole purpose
+            npages = len(page_ids)
+            idx = jnp.asarray(_pad_pow2(list(page_ids), 0), jnp.int32)
+            k = np.asarray(_gather_pages(self.kv_k, idx))  # dynalint: disable=implicit-host-transfer
+            v = np.asarray(_gather_pages(self.kv_v, idx))  # dynalint: disable=implicit-host-transfer
+            return k[:, :npages], v[:, :npages]
 
         return await loop.run_in_executor(self._exec, _do)
 
@@ -1815,15 +1903,23 @@ class JaxEngine:
         def _gather(ids, first):
             if first:
                 self._drain_kv_tier(full=True)
-            idx = jnp.asarray(ids, jnp.int32)
-            kg, vg = self.kv_k[:, idx], self.kv_v[:, idx]
+            # pad the (only-ever-shorter) final slice to the chunk size:
+            # every chunk of a stream then shares ONE gather program per
+            # chunk_pages value instead of compiling the remainder length
+            # mid-serving (dynajit DL015)
+            idx = jnp.asarray(list(ids) + [0] * (cp - len(ids)), jnp.int32)
+            kg = _gather_pages(self.kv_k, idx)
+            vg = _gather_pages(self.kv_v, idx)
             for a in (kg, vg):
                 if hasattr(a, "copy_to_host_async"):
                     a.copy_to_host_async()
-            return kg, vg
+            return kg, vg, len(ids)
 
-        def _host(kg, vg):
-            return np.asarray(kg), np.asarray(vg)
+        def _host(kg, vg, real):
+            # the D2H sync IS the extract stage
+            k = np.asarray(kg)  # dynalint: disable=implicit-host-transfer
+            v = np.asarray(vg)  # dynalint: disable=implicit-host-transfer
+            return k[:, :real], v[:, :real]
 
         if not slices:
             return
@@ -1852,9 +1948,21 @@ class JaxEngine:
             # evictions queued when these pages were reserved must capture
             # their OLD content before this injection overwrites it
             self._drain_kv_tier(full=True)
-            idx = jnp.asarray(page_ids, jnp.int32)
-            self.kv_k = _inject_pages(self.kv_k, idx, jnp.asarray(k))
-            self.kv_v = _inject_pages(self.kv_v, idx, jnp.asarray(v))
+            # pow2-pad the scatter (pad target = num_pages → dropped by
+            # the donated .at[...].set(mode="drop")) so injects compile
+            # O(log n) programs, not one per page count (dynajit DL015)
+            pad = _pad_pow2(list(page_ids), self.ecfg.num_pages)
+            idx = jnp.asarray(pad, jnp.int32)
+            kp = np.zeros((k.shape[0], len(pad) - k.shape[1],
+                           *k.shape[2:]), k.dtype)
+            vp = np.zeros((v.shape[0], len(pad) - v.shape[1],
+                           *v.shape[2:]), v.dtype)
+            self.kv_k = _inject_pages(
+                self.kv_k, idx,
+                jnp.asarray(np.concatenate([k, kp], axis=1)))
+            self.kv_v = _inject_pages(
+                self.kv_v, idx,
+                jnp.asarray(np.concatenate([v, vp], axis=1)))
             jax.block_until_ready(self.kv_k)
 
         await loop.run_in_executor(self._exec, _do)

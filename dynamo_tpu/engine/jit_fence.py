@@ -1,0 +1,124 @@
+"""Runtime compile fence: detect XLA compilation after warmup.
+
+``JaxEngine.warmup()`` pre-compiles the full bucket grid so no compile
+ever happens mid-serving — a mid-flight compile stalls every in-flight
+request for the compile latency (seconds on TPU). The static side of
+that invariant is dynajit (tools/dynalint, DL015-DL017); this module is
+the runtime side: a fence armed at the end of ``warmup()`` that counts
+every XLA compilation afterwards via JAX's monitoring hook
+(``/jax/core/compile/backend_compile_duration`` fires once per real
+backend compile and never on cache hits).
+
+``DYN_JIT_FENCE`` picks the reaction:
+
+- unset/empty — count only: the counter is exported through
+  ``stats()`` → ``ForwardPassMetrics`` →
+  ``dyn_engine_post_warmup_compiles_total`` so the fleet metrics
+  aggregator sees a mid-serving compile on any worker;
+- ``warn`` — additionally log a warning with the compile duration;
+- ``raise`` — raise ``PostWarmupCompileError`` from the compile path
+  (the CI/test mode: the offending jit call fails loudly).
+
+Every compile also lands a ``compile`` event in the engine's dyntrace
+step timeline, so ``/v1/traces`` shows exactly where in the serving
+schedule the stall happened.
+
+The JAX monitoring API has no unregister, so ONE process-wide listener
+is installed lazily and dispatches to live fences (weakly referenced —
+a dropped engine stops counting). Compiles are process-global: with two
+engines in one process (disagg smoke tests) a compile triggered by
+either increments both armed fences, which is the honest reading — the
+process stalled.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import weakref
+from typing import Optional
+
+from ..runtime.config import env_str
+
+log = logging.getLogger("dynamo_tpu.engine.fence")
+
+# the per-compile duration event (fires on real backend compiles only;
+# cache hits and device_put do not record it)
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_fences: "weakref.WeakSet[CompileFence]" = weakref.WeakSet()
+_install_lock = threading.Lock()
+_installed = False
+
+
+class PostWarmupCompileError(RuntimeError):
+    """An XLA compile happened after warmup with DYN_JIT_FENCE=raise."""
+
+
+def _dispatch(event: str, duration_secs: float, **_kw) -> None:
+    if event != COMPILE_EVENT:
+        return
+    for fence in list(_fences):
+        fence.on_compile(duration_secs)
+
+
+def _install_listener() -> None:
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_dispatch)
+        _installed = True
+
+
+class CompileFence:
+    """Per-engine post-warmup compile counter + warn/raise tripwire."""
+
+    def __init__(self, name: str, timeline=None,
+                 mode: Optional[str] = None):
+        self.name = name
+        self.timeline = timeline
+        self._mode_override = mode
+        self.armed = False
+        self.post_warmup_compiles = 0
+
+    @property
+    def mode(self) -> str:
+        if self._mode_override is not None:
+            return self._mode_override
+        return (env_str("DYN_JIT_FENCE") or "").strip().lower()
+
+    def arm(self) -> None:
+        """Called at the end of warmup(): from here on, every backend
+        compile counts against the zero-compile serving invariant."""
+        _install_listener()
+        _fences.add(self)
+        self.armed = True
+
+    def disarm(self) -> None:
+        self.armed = False
+
+    def on_compile(self, duration_secs: float) -> None:
+        if not self.armed:
+            return
+        self.post_warmup_compiles += 1
+        if self.timeline is not None:
+            self.timeline.add("compile",
+                              duration_ms=round(duration_secs * 1e3, 3),
+                              post_warmup_total=self.post_warmup_compiles)
+        mode = self.mode
+        if mode == "raise":
+            raise PostWarmupCompileError(
+                f"XLA compile after warmup on {self.name} "
+                f"({duration_secs * 1e3:.1f} ms, "
+                f"{self.post_warmup_compiles} total): an unbucketed "
+                f"shape or request-varying static arg reached a jitted "
+                f"call — see dynajit (docs/static_analysis.md)")
+        if mode == "warn":
+            log.warning(
+                "XLA compile after warmup on %s (%.1f ms, %d total): "
+                "an unbucketed shape or request-varying static arg "
+                "reached a jitted call", self.name, duration_secs * 1e3,
+                self.post_warmup_compiles)

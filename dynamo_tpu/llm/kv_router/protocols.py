@@ -29,6 +29,10 @@ class ForwardPassMetrics:
     # accepted/drafted tokens, and accepted drafts per verify step
     spec_decode_acceptance_rate: float = 0.0
     spec_decode_mean_accepted_len: float = 0.0
+    # compile fence (engine/jit_fence.py): XLA compiles observed after
+    # warmup() — any nonzero value means a worker broke the zero-compile
+    # serving invariant and stalled its in-flight requests
+    post_warmup_compiles_total: int = 0
     # disaggregation transfer plane (llm/disagg/transfer.py streaming
     # chunk pipeline): decode-side ingest volume/time + the remote-prefill
     # wait the decode engine accumulates (enqueue → KV committed)

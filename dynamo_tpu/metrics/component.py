@@ -147,6 +147,10 @@ class MetricsAggregator:
             ("dyn_worker_spec_decode_mean_accepted_len",
              "mean accepted draft length per verify step",
              lambda m: m.spec_decode_mean_accepted_len),
+            ("dyn_engine_post_warmup_compiles_total",
+             "XLA compiles after warmup (compile-fence counter; nonzero "
+             "= a mid-serving compile stalled this worker)",
+             lambda m: m.post_warmup_compiles_total),
             ("dyn_worker_kv_transfer_bytes_total",
              "disagg KV bytes ingested over the transfer plane",
              lambda m: m.kv_transfer_bytes_total),

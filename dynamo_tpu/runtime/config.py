@@ -130,6 +130,14 @@ register_env("DYN_REDISPATCH_MAX", "2", "llm/disagg",
              "re-enqueues after a fast transfer-plane failure, e.g. a "
              "prefill worker dying mid-transfer). 1 disables hedging.")
 
+register_env("DYN_JIT_FENCE", None, "engine",
+             "Runtime compile fence: reaction to an XLA compile AFTER "
+             "JaxEngine.warmup() (the zero-compile serving invariant). "
+             "Unset = count only (always exported as "
+             "dyn_engine_post_warmup_compiles_total); 'warn' logs each "
+             "compile; 'raise' fails the offending jit call with "
+             "PostWarmupCompileError (the CI mode).")
+
 register_env("DYN_FLEET_DISCOVERY_TIMEOUT", "10.0", "fleet",
              "Fleet simulator: wall-clock seconds to wait for spawned/"
              "stopped workers to propagate through discovery watches "

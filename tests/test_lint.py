@@ -1237,6 +1237,378 @@ def test_dynarace_deterministic_output():
     assert first and first == second
 
 
+# --------------------------------------------------- dynajit (DL015-DL017)
+
+
+def jit_pass(*mods):
+    """Run the dynajit passes (DL015-DL017 + warmup coverage) over
+    in-memory fixture modules given as (path, src) pairs."""
+    from tools.dynalint import analyze_jit
+
+    return analyze_jit([parse_module(src, path) for path, src in mods])
+
+
+def jit_codes(src, path="dynamo_tpu/engine/fixture.py"):
+    return [v.code for v in jit_pass((path, src))]
+
+
+DL015_BAD_SHAPE = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def fwd(x, *, k=1):
+    return x
+
+class Eng:
+    def _step(self, batch):
+        toks = np.zeros((len(batch), 8), np.int32)   # raw batch dim
+        fwd(jnp.asarray(toks))
+"""
+
+DL015_BAD_STATIC = """
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def fwd(x, *, k=1):
+    return x
+
+class Eng:
+    def _step(self, batch):
+        fwd(jnp.zeros((4, 8)), k=len(batch))   # per-value recompile
+"""
+
+DL015_GOOD_BUCKETED = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def fwd(x, *, k=1):
+    return x
+
+class Eng:
+    def _step(self, batch):
+        B = self.ecfg.bucket_batch(len(batch))   # laundered
+        toks = np.zeros((B, 8), np.int32)
+        fwd(jnp.asarray(toks), k=self.ecfg.decode_steps)
+"""
+
+DL015_BAD_GATHER = """
+import jax.numpy as jnp
+import numpy as np
+from typing import List
+
+class Eng:
+    def extract(self, page_ids: List[int]):
+        idx = jnp.asarray(page_ids, jnp.int32)
+        return np.asarray(self.kv_k[:, idx])
+"""
+
+DL015_GOOD_GATHER = """
+import jax.numpy as jnp
+import numpy as np
+from typing import List
+
+def _pad_pow2(lst, fill):
+    return lst
+
+class Eng:
+    def extract(self, page_ids: List[int]):
+        idx = jnp.asarray(_pad_pow2(list(page_ids), 0), jnp.int32)
+        k = np.asarray(self.kv_k[:, idx])  # dynalint: disable=implicit-host-transfer
+        return k[:, :len(page_ids)]
+"""
+
+DL015_UNWARMED_ENTRY = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def fwd(x):
+    return x
+
+@jax.jit
+def other(x):
+    return x
+
+class Eng:
+    def warmup(self):
+        fwd(jnp.zeros((4,)))
+    def _step(self):
+        fwd(jnp.zeros((4,)))
+        other(jnp.zeros((4,)))   # dispatched at serving time, never warmed
+"""
+
+DL015_SUPPRESSED = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def fwd(x, *, k=1):
+    return x
+
+class Eng:
+    def _step(self, batch):
+        toks = np.zeros((len(batch), 8), np.int32)
+        # one-shot admin path, documented
+        fwd(jnp.asarray(toks))  # dynalint: disable=recompile-hazard
+"""
+
+
+def test_dl015_fires_on_raw_shape():
+    vs = [v for v in jit_pass(("dynamo_tpu/engine/fixture.py",
+                               DL015_BAD_SHAPE)) if v.code == "DL015"]
+    assert len(vs) == 1 and "request-varying shape" in vs[0].message
+    assert vs[0].scope == "Eng._step"
+
+
+def test_dl015_fires_on_raw_static_value():
+    vs = [v for v in jit_pass(("dynamo_tpu/engine/fixture.py",
+                               DL015_BAD_STATIC)) if v.code == "DL015"]
+    assert len(vs) == 1 and "static arg" in vs[0].message
+
+
+def test_dl015_quiet_on_bucketed():
+    assert "DL015" not in jit_codes(DL015_GOOD_BUCKETED)
+
+
+def test_dl015_fires_on_raw_device_gather():
+    vs = [v for v in jit_pass(("dynamo_tpu/engine/fixture.py",
+                               DL015_BAD_GATHER)) if v.code == "DL015"]
+    assert len(vs) == 1 and "device gather" in vs[0].message
+    # the same fixture's np.asarray over the gather is the DL017 shape
+    assert "DL017" in jit_codes(DL015_BAD_GATHER)
+
+
+def test_dl015_quiet_on_padded_gather():
+    codes = jit_codes(DL015_GOOD_GATHER)
+    assert "DL015" not in codes and "DL017" not in codes
+
+
+def test_dl015_warmup_coverage():
+    vs = [v for v in jit_pass(("dynamo_tpu/engine/fixture.py",
+                               DL015_UNWARMED_ENTRY))
+          if v.code == "DL015"]
+    assert len(vs) == 1
+    assert "`other`" in vs[0].message and "warmup" in vs[0].message
+
+
+def test_dl015_suppression():
+    assert "DL015" not in jit_codes(DL015_SUPPRESSED)
+
+
+def test_dl015_scoped_to_engine_modules():
+    # same source under llm/ produces nothing: the serving-layer scope
+    assert jit_codes(DL015_BAD_SHAPE, path="dynamo_tpu/llm/fixture.py") \
+        == []
+
+
+# ------------------------------------------------ DL016 donation-discipline
+
+
+DL016_BAD_USE_AFTER = """
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, donate_argnames=("pool",))
+def upd(pool, x):
+    return pool.at[0].set(x)
+
+class Eng:
+    def _step(self):
+        out = upd(self.pool_arr, 1)
+        return self.pool_arr.sum()      # donated buffer used afterwards
+"""
+
+DL016_GOOD_REBIND = """
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, donate_argnames=("pool",))
+def upd(pool, x):
+    return pool.at[0].set(x)
+
+class Eng:
+    def _step(self):
+        self.pool_arr = upd(self.pool_arr, 1)
+        return self.pool_arr.sum()      # rebound first: fine
+"""
+
+DL016_BAD_CONVENTION = """
+import jax.numpy as jnp
+
+class Eng:
+    def _step(self):
+        logits = self.decode_fn(self.kv_k, jnp.zeros((4,)))
+        return self.kv_k.sum()          # pool donated by convention
+"""
+
+DL016_GOOD_CONVENTION = """
+import jax.numpy as jnp
+
+class Eng:
+    def _step(self):
+        logits, self.kv_k, self.kv_v = self.decode_fn(
+            self.kv_k, self.kv_v, jnp.zeros((4,)))
+        return self.kv_k.sum()
+"""
+
+DL016_BAD_UNDONATED_WRITE = """
+import jax
+
+@jax.jit
+def scatter(pool, rows):
+    return pool.at[:4].set(rows)    # written + returned, not donated
+"""
+
+DL016_GOOD_DONATED_WRITE = """
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnames=("pool",))
+def scatter(pool, rows):
+    return pool.at[:4].set(rows)
+"""
+
+DL016_SUPPRESSED = """
+import jax.numpy as jnp
+
+class Eng:
+    def _step(self):
+        logits = self.decode_fn(self.kv_k, jnp.zeros((4,)))
+        # double-buffered pools: the read targets the standby copy
+        return self.kv_k.sum()  # dynalint: disable=donation-discipline
+"""
+
+
+def test_dl016_fires_on_donated_use_after():
+    vs = [v for v in jit_pass(("dynamo_tpu/engine/fixture.py",
+                               DL016_BAD_USE_AFTER))
+          if v.code == "DL016"]
+    assert len(vs) == 1 and "self.pool_arr" in vs[0].message
+
+
+def test_dl016_quiet_on_rebind():
+    assert "DL016" not in jit_codes(DL016_GOOD_REBIND)
+
+
+def test_dl016_pool_convention():
+    assert "DL016" in jit_codes(DL016_BAD_CONVENTION)
+    assert "DL016" not in jit_codes(DL016_GOOD_CONVENTION)
+
+
+def test_dl016_fires_on_undonated_inplace_write():
+    vs = [v for v in jit_pass(("dynamo_tpu/engine/fixture.py",
+                               DL016_BAD_UNDONATED_WRITE))
+          if v.code == "DL016"]
+    assert len(vs) == 1 and "without donating" in vs[0].message
+    assert "DL016" not in jit_codes(DL016_GOOD_DONATED_WRITE)
+
+
+def test_dl016_suppression():
+    assert "DL016" not in jit_codes(DL016_SUPPRESSED)
+
+
+# --------------------------------------------- DL017 implicit-host-transfer
+
+
+DL017_BAD_FLOW = """
+import jax.numpy as jnp
+import numpy as np
+
+class Eng:
+    def report(self):
+        acc = jnp.zeros((4,)) + 1       # device value through a variable
+        vals = acc.tolist()             # sink 1
+        n = int(jnp.sum(acc))           # sink 2
+        return vals, n
+"""
+
+DL017_GOOD_HOST = """
+import numpy as np
+
+class Eng:
+    def _helper(self):
+        xs = [1, 2, 3]
+        return np.asarray(xs)    # host list: NOT a device sync (DL005's
+                                 # callsite pattern cannot tell these apart)
+"""
+
+DL017_CHAIN_MODELS = """
+import jax.numpy as jnp
+import numpy as np
+
+def land(x):
+    t = jnp.zeros((4,))
+    return np.asarray(t)        # device sink in a models module
+"""
+
+DL017_CHAIN_ENGINE = """
+from dynamo_tpu.models.fixmod import land
+
+class Eng:
+    def _step(self):
+        land(1)
+"""
+
+DL017_SUPPRESSED = """
+import jax.numpy as jnp
+import numpy as np
+
+class Eng:
+    def report(self):
+        acc = jnp.zeros((4,)) + 1
+        # the export IS the D2H, documented
+        return np.asarray(acc)  # dynalint: disable=implicit-host-transfer
+"""
+
+
+def test_dl017_fires_on_device_value_flow():
+    vs = [v for v in jit_pass(("dynamo_tpu/engine/fixture.py",
+                               DL017_BAD_FLOW)) if v.code == "DL017"]
+    assert len(vs) == 2
+    assert any(".tolist()" in v.message for v in vs)
+    assert any("`int()`" in v.message for v in vs)
+
+
+def test_dl017_quiet_on_host_asarray():
+    assert "DL017" not in jit_codes(DL017_GOOD_HOST)
+
+
+def test_dl017_chain_reports_at_hot_call_site():
+    vs = [v for v in jit_pass(
+        ("dynamo_tpu/models/fixmod.py", DL017_CHAIN_MODELS),
+        ("dynamo_tpu/engine/fixture.py", DL017_CHAIN_ENGINE))
+        if v.code == "DL017"]
+    assert len(vs) == 1
+    assert vs[0].path == "dynamo_tpu/engine/fixture.py"
+    assert vs[0].scope == "Eng._step" and "land" in vs[0].message
+
+
+def test_dl017_suppression():
+    assert "DL017" not in jit_codes(DL017_SUPPRESSED)
+
+
+def test_dynajit_deterministic_output():
+    mods = (("dynamo_tpu/engine/a.py", DL015_BAD_SHAPE),
+            ("dynamo_tpu/engine/b.py", DL016_BAD_USE_AFTER),
+            ("dynamo_tpu/models/fixmod.py", DL017_CHAIN_MODELS),
+            ("dynamo_tpu/engine/c.py", DL017_CHAIN_ENGINE))
+    first = [v.render() for v in jit_pass(*mods)]
+    second = [v.render() for v in jit_pass(*mods)]
+    assert first and first == second
+
+
 # ------------------------------------------------------- generated artifacts
 
 
@@ -1337,7 +1709,7 @@ def test_cli_all_entry():
     out = json.loads(proc.stdout)
     assert out["violations"] == []
     assert "rule_counts" in out
-    for p in ("per_file", "dynaflow", "dynarace"):
+    for p in ("per_file", "dynaflow", "dynarace", "dynajit"):
         assert out["passes"][p] >= 0
 
 

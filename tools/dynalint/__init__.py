@@ -14,7 +14,13 @@ encoded/decoded wire frame against the declared schema registry in
 (dynarace.py) infers concurrency roots and shared state over the same
 call graph and enforces await-atomicity (DL012), the ``# guarded-by:``
 lock/loop discipline (DL013), lock-order consistency (DL014), and the
-interprocedural extension of the DL005 hot-path host-sync rule.
+interprocedural extension of the DL005 hot-path host-sync rule. The
+**dynajit** layer (dynajit.py) guards the engine's zero-compile
+serving invariant with a device-residency + shape-provenance dataflow
+pass: recompile hazards at jitted call sites plus warmup coverage
+(DL015), donation discipline (DL016), and implicit host transfers
+(DL017) — the static twin of the runtime compile fence in
+``dynamo_tpu/engine/jit_fence.py``.
 
 Usage:
     python -m tools.dynalint --all          # every pass, one parse
@@ -36,15 +42,16 @@ from .baseline import apply_baseline, format_entry, load_baseline
 from .callgraph import DEFAULT_DL008_DEPTH, CallGraph, module_name
 from .dynaflow import (FrameSchema, analyze_project, analyze_tree,
                        load_wire_schemas)
+from .dynajit import JitInfo, analyze_jit, collect_jits
 from .dynarace import (RaceModel, analyze_races, build_race_model,
                        check_transitive_host_sync, scan_modules)
 
 __all__ = [
     "RULES", "CallGraph", "DEFAULT_DL008_DEPTH", "FrameSchema",
-    "ModuleSource", "RaceModel", "Violation", "analyze_paths",
-    "analyze_project", "analyze_races", "analyze_source", "analyze_tree",
-    "apply_baseline", "build_race_model", "check_transitive_host_sync",
-    "format_entry", "iter_py_files", "load_source", "load_sources",
-    "load_wire_schemas", "load_baseline", "module_name", "parse_module",
-    "scan_modules",
+    "JitInfo", "ModuleSource", "RaceModel", "Violation", "analyze_jit",
+    "analyze_paths", "analyze_project", "analyze_races", "analyze_source",
+    "analyze_tree", "apply_baseline", "build_race_model",
+    "check_transitive_host_sync", "collect_jits", "format_entry",
+    "iter_py_files", "load_source", "load_sources", "load_wire_schemas",
+    "load_baseline", "module_name", "parse_module", "scan_modules",
 ]

@@ -5,9 +5,11 @@
 
 Runs the per-file rules (DL001-DL007, DL011) AND the whole-program
 passes — dynaflow (DL008 call-graph blocking propagation, DL009/DL010
-wire-schema conformance) and dynarace (DL012-DL014 concurrency rules +
-interprocedural DL005) — over one shared parse of the tree. ``--all``
-is the CI spelling: the default tree, every pass.
+wire-schema conformance), dynarace (DL012-DL014 concurrency rules +
+interprocedural DL005) and dynajit (DL015-DL017 compilation-stability /
+device-residency rules + the warmup-coverage check) — over one shared
+parse of the tree. ``--all`` is the CI spelling: the default tree,
+every pass.
 
 Exit status: 0 when every violation is baselined (stale baseline
 entries still warn on stderr), 1 when new violations exist.

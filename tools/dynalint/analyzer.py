@@ -72,6 +72,26 @@ RULES: Dict[str, Tuple[str, str]] = {
               "locks acquired in opposite nesting orders on different "
               "paths: two tasks taking them concurrently can deadlock "
               "the event loop forever"),
+    # DL015-DL017 are the dynajit compilation-stability rules
+    # (dynajit.py): device-residency + shape-provenance dataflow over the
+    # shared call graph, so analyze_source never emits them —
+    # analyze_tree does.
+    "DL015": ("recompile-hazard",
+              "jitted call site whose argument shape or static-arg value "
+              "derives from request-varying data without passing through "
+              "a bucket helper: each distinct shape/value is one "
+              "serve-time XLA compile that stalls every in-flight "
+              "request"),
+    "DL016": ("donation-discipline",
+              "donated buffer used after the donating jit call (invalid "
+              "the moment the call dispatches), or a jitted function "
+              "overwriting a buffer param in place without donating it "
+              "(XLA keeps a second pool-sized copy in HBM)"),
+    "DL017": ("implicit-host-transfer",
+              "device-resident value flows into a host-transfer sink "
+              "(np.asarray / .item() / .tolist() / float / int / bool / "
+              "iteration): a hidden device sync the callsite-pattern "
+              "DL005 cannot see"),
 }
 
 NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}

@@ -656,9 +656,14 @@ def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
 
     out.extend(analyze_races(sources, graph=graph))
     t3 = _time.perf_counter()
+    from .dynajit import analyze_jit
+
+    out.extend(analyze_jit(sources, graph=graph))
+    t4 = _time.perf_counter()
     if timings is not None:
         timings["per_file"] = round(t1 - t0, 3)
         timings["dynaflow"] = round(t2 - t1, 3)
         timings["dynarace"] = round(t3 - t2, 3)
+        timings["dynajit"] = round(t4 - t3, 3)
     out.sort(key=lambda v: (v.path, v.line, v.code))
     return out
