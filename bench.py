@@ -210,6 +210,12 @@ def parse_args():
                          "chip is available")
     ap.add_argument("--spec-tokens", type=int, default=4,
                     help="max draft tokens verified per step (K)")
+    ap.add_argument("--prof-sample", type=int, default=0,
+                    help="dynaprof: profile every Nth engine step with a "
+                         "timed dispatch (device/host split + per-bucket "
+                         "cost table in the report). 0 = off: the hot "
+                         "path stays sync-free and the report's "
+                         "device_time_fraction/bucket_cost stay empty")
     ap.add_argument("--trace", action="store_true",
                     help="dyntrace: record a trace per benched request "
                          "(sampling forced to 1.0) and dump a per-request "
@@ -276,6 +282,8 @@ def build_engine(args):
     if args.max_batch:
         ecfg.max_batch = args.max_batch
         ecfg.batch_buckets = (8, args.max_batch)
+    if getattr(args, "prof_sample", 0):
+        ecfg.prof_sample = args.prof_sample
     if getattr(args, "_spec_on", False):
         ecfg.spec_decode = True
         ecfg.spec_tokens = args.spec_tokens
@@ -400,6 +408,10 @@ async def run_multiturn(args):
         "host_restores": stats["host_restore_pages_total"],
         "host_offloads": stats["host_offload_pages_total"],
         "post_warmup_compiles": stats["post_warmup_compiles_total"],
+        "loop_lag_p99_ms": round(
+            stats["loop_lag_p99_seconds"] * 1000, 2),
+        "device_time_fraction": stats["device_time_fraction"],
+        "bucket_cost": stats["bucket_cost"],
     }
     print(json.dumps(report), file=sys.stderr)
     return report
@@ -417,6 +429,11 @@ async def measure(engine, reqs, concurrency, trace=False):
     from dynamo_tpu.runtime import tracing
     from dynamo_tpu.runtime.engine import Context
 
+    from dynamo_tpu.runtime import profiling
+
+    # dynaprof: lag-monitor the bench loop for the run's duration so
+    # every report carries loop_lag_p99_ms (released before returning)
+    profiling.acquire_loop_profiler()
     sem = asyncio.Semaphore(concurrency)
     results = []
     trace_rids = []
@@ -499,6 +516,8 @@ async def measure(engine, reqs, concurrency, trace=False):
     bench_t0 = time.monotonic()
     await asyncio.gather(*(one(i, t, o) for i, (t, o) in enumerate(reqs)))
     wall = time.monotonic() - bench_t0
+    lag = profiling.loop_lag_snapshot()
+    await profiling.release_loop_profiler()
 
     errors = sum(1 for r in results if r["error"])
     results = [r for r in results if not r["error"]]
@@ -527,6 +546,9 @@ async def measure(engine, reqs, concurrency, trace=False):
                                  if gaps else None),
         "itl_raw_chunk_p99_ms": (round(pct(gaps, 99) * 1000, 2)
                                  if gaps else None),
+        # dynaprof: event-loop callback-overrun p99 during the run —
+        # the scheduler-overhead companion to the latency percentiles
+        "loop_lag_p99_ms": round(lag["p99_s"] * 1000, 2),
     }
     if trace:
         report["trace_stages"] = _trace_breakdown(trace_rids)
@@ -570,6 +592,10 @@ async def run_bench(args):
     # compile-regression gate for hot-path work (ROADMAP item 3): any
     # nonzero value means a serve-time XLA compile stalled the run
     report["post_warmup_compiles"] = st["post_warmup_compiles_total"]
+    # dynaprof: sampled device/host split + per-bucket program costs
+    # (empty/0.0 unless --prof-sample > 0)
+    report["device_time_fraction"] = st["device_time_fraction"]
+    report["bucket_cost"] = st["bucket_cost"]
     if getattr(args, "trace", False):
         print(f"trace compile fence: {st['post_warmup_compiles_total']} "
               f"post-warmup XLA compile(s)", file=sys.stderr)
@@ -606,8 +632,10 @@ async def run_disagg(args):
     reqs = synth_requests(args, cfg.vocab_size, engine.cap_tokens)
     agg = await measure(engine, reqs, args.concurrency,
                         trace=getattr(args, "trace", False))
-    agg["post_warmup_compiles"] = \
-        engine.stats()["post_warmup_compiles_total"]
+    agg_st = engine.stats()
+    agg["post_warmup_compiles"] = agg_st["post_warmup_compiles_total"]
+    agg["device_time_fraction"] = agg_st["device_time_fraction"]
+    agg["bucket_cost"] = agg_st["bucket_cost"]
     await engine.stop()
     base_ecfg = engine.ecfg
     del engine
@@ -661,6 +689,12 @@ async def run_disagg(args):
         dis["post_warmup_compiles"] = (
             decode_eng.fence.post_warmup_compiles
             + prefill_eng.fence.post_warmup_compiles)
+        # dynaprof per-leg: decode-engine device/host split + program
+        # cost table (the prefill engine's table rides under a suffix)
+        dis["device_time_fraction"] = round(
+            decode_eng.profiler.device_time_fraction(), 4)
+        dis["bucket_cost"] = decode_eng.profiler.cost_table()
+        dis["prefill_bucket_cost"] = prefill_eng.profiler.cost_table()
         dis["remote_prefills"] = (st["remote_prefills"]
                                   - before_st["remote_prefills"])
         dis["local_prefills"] = (st["local_prefills"]

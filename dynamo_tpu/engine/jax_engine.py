@@ -44,11 +44,12 @@ from ..llm.protocols.common import (FINISH_CANCELLED, FINISH_EOS,
 from ..models.config import ModelConfig
 from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
-from ..runtime import tracing
+from ..runtime import profiling, tracing
 from ..runtime.config import env_int
 from ..runtime.engine import Context
 from .jit_fence import CompileFence
 from .kv_manager import PageManager
+from .profiler import EngineProfiler, memory_snapshot
 from .sampling import (SamplingBatch, logprob_aux, sample_tokens,
                        update_penalty_state, verify_greedy_draft)
 from .spec_decode import propose_ngram_draft
@@ -141,6 +142,11 @@ class EngineConfig:
     spec_tokens: int = 4      # K: max draft tokens verified per step
     spec_ngram_max: int = 4   # longest suffix n-gram the drafter matches
     spec_ngram_min: int = 1   # shortest n-gram worth matching
+    # dynaprof sampling cadence: profile every Nth scheduler iteration
+    # with a timed dispatch (device/host split + per-bucket cost table;
+    # engine/profiler.py). The sampled iteration pays one deliberate
+    # device sync. None reads DYN_PROF_SAMPLE; 0 disables (default).
+    prof_sample: Optional[int] = None
     # on-device stop table width (eos_token_ids + stop_token_ids rows,
     # padded with -1); requests with more ids fall back to the (lagging
     # but correct) host-side check
@@ -242,6 +248,15 @@ class Sequence:
     # disaggregation: keep pages alive after finish so the prefill worker
     # can extract them (caller must release_pages() afterwards)
     hold_pages: bool = False
+    # dynaprof cost attribution (host-side counters, no device work):
+    # queue wait stamped at admission; occupancy-weighted device-step
+    # share (each dispatch distributes exactly 1.0 across its batch, so
+    # fleet-wide shares sum to the dispatch count); peak page footprint
+    queue_wait_s: float = 0.0
+    prefix_hit: int = 0
+    dispatch_share: float = 0.0
+    dispatches: int = 0
+    max_pages: int = 0
 
     def max_new(self) -> int:
         mt = self.req.stop.max_tokens
@@ -453,6 +468,19 @@ class JaxEngine:
         # dyn_engine_post_warmup_compiles_total.
         self.fence = CompileFence(f"jax-engine-{id(self):x}",
                                   timeline=self.step_timeline)
+        # dynaprof: sampled device/host dispatch timing + per-bucket cost
+        # (engine/profiler.py; sample=0 keeps the hot path sync-free)
+        self.profiler = EngineProfiler(f"jax-engine-{id(self):x}",
+                                       timeline=self.step_timeline,
+                                       sample=self.ecfg.prof_sample)
+        # per-page KV bytes (both pools) for attribution/occupancy
+        # accounting — .nbytes is shape metadata, not a device sync
+        self._page_bytes = int(
+            (self.kv_k.nbytes + self.kv_v.nbytes)
+            // max(self.ecfg.num_pages, 1))
+        # dispatches that distributed a step share (the attribution
+        # conservation invariant: sum of per-request shares == this)
+        self.batch_dispatches_total = 0
         self.queue_wait_seconds_total = 0.0
         self.prefill_tokens_total = 0
         # iterations where a decode window dispatched WHILE prompts were
@@ -645,6 +673,10 @@ class JaxEngine:
     def start(self) -> None:
         if self._loop_task is None:
             self._aio_loop = asyncio.get_running_loop()
+            # dynaprof: the serving loop gets a lag monitor + stall
+            # watchdog for as long as an engine runs on it (refcounted;
+            # stop() releases)
+            profiling.acquire_loop_profiler()
             self._loop_task = asyncio.ensure_future(self._loop())
 
     async def stop(self) -> None:
@@ -652,6 +684,7 @@ class JaxEngine:
         self._wake.set()
         if self._loop_task:
             await self._loop_task
+            await profiling.release_loop_profiler()
         self._exec.shutdown(wait=False)
 
     # ------------------------------------------------------ AsyncEngine API
@@ -679,8 +712,24 @@ class JaxEngine:
 
     def stats(self) -> dict:
         """ForwardPassMetrics analog for the KV router
-        (reference kv_router/protocols.rs:18-30)."""
+        (reference kv_router/protocols.rs:18-30). Keys here that match
+        ForwardPassMetrics field names ride the stats plane into the
+        metrics aggregator's dyn_worker_*/dyn_engine_* gauges."""
+        lag = profiling.loop_lag_snapshot()
         return {
+            # dynaprof: loop health + sampled device/host split +
+            # per-bucket program costs + page-pool occupancy
+            "loop_lag_p50_seconds": lag["p50_s"],
+            "loop_lag_p99_seconds": lag["p99_s"],
+            "device_time_fraction":
+                round(self.profiler.device_time_fraction(), 4),
+            "profiled_steps_total": self.profiler.profiled_steps,
+            "bucket_cost": self.profiler.cost_table(),
+            "batch_dispatches_total": self.batch_dispatches_total,
+            "kv_free_blocks": len(self.pm.free),
+            "kv_cached_blocks": len(self.pm.reusable),
+            "host_free_blocks": len(self.pm.host_free),
+            "memory": memory_snapshot(self.pm, self._page_bytes),
             "request_active_slots": len(self.running) + len(self.prefilling),
             "request_total_slots": self.ecfg.max_batch,
             "kv_active_blocks": self.pm.active,
@@ -748,6 +797,7 @@ class JaxEngine:
         (the dominant cost on dispatch-latency-bound setups) overlaps
         device compute. Unpipelined modes keep the reference-equivalent
         prefill-priority ordering."""
+        self.profiler.tick()  # dynaprof: one compare at sample=0
         self._drain_kv_tier()
         if self.verify_fn is not None:
             self._step_spec()
@@ -891,6 +941,8 @@ class JaxEngine:
             if seq.generated == 0:  # don't double-count resumed sequences
                 wait = time.monotonic() - seq.arrival
                 self.queue_wait_seconds_total += wait
+                seq.queue_wait_s = wait
+                seq.prefix_hit = seq.computed
                 self.step_timeline.add(
                     "admit", queue_wait_ms=round(wait * 1000.0, 3),
                     request_id=seq.context.id,
@@ -1111,11 +1163,15 @@ class JaxEngine:
                 npg = (chunk + ps - 1) // ps
                 pslots[i, :npg] = pages[first:first + npg]
 
+        pt0 = self.profiler.begin()
         logits, self.kv_k, self.kv_v = self.prefill_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots),
             jnp.asarray(last_idx),
             jnp.asarray(pslots) if use_paged else None)
+        self.profiler.end(pt0, "prefill", (B, T, P),
+                          tokens=int(sum(chunks)), sync_ref=logits)
+        self._account_dispatch(batch)
         self.steps += 1
         self.step_timeline.add(
             "prefill", batch=len(batch), tokens=int(sum(chunks)),
@@ -1156,8 +1212,12 @@ class JaxEngine:
         positions = np.full((1, T), -1, np.int32)
         tokens[0, :extent] = seq.tokens[:extent]
         positions[0, :extent] = np.arange(extent)
+        pt0 = self.profiler.begin()
         logits, k_all, v_all = self.long_prefill_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions))
+        self.profiler.end(pt0, "long_prefill", (T,),
+                          tokens=extent - seq.computed, sync_ref=logits)
+        self._account_dispatch([seq])
         pages = np.asarray(seq.pages, np.int64)
         pos = np.arange(T)
         # positions below seq.computed are prefix-cache hits living in
@@ -1298,10 +1358,14 @@ class JaxEngine:
             page = seq.pages[pos // self.ecfg.page_size]
             slots[i] = (page * self.ecfg.page_size
                         + pos % self.ecfg.page_size)
+        pt0 = self.profiler.begin()
         logits, self.kv_k, self.kv_v = self.decode_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots))
         toks_d, aux_d = self._sample_device(batch, logits)
+        self.profiler.end(pt0, "decode", (B, P), tokens=len(batch),
+                          sync_ref=toks_d)
+        self._account_dispatch(batch)
         sampled = np.asarray(toks_d)[:len(batch)]
         aux = (tuple(np.asarray(a) for a in aux_d)
                if aux_d is not None else None)
@@ -1434,12 +1498,17 @@ class JaxEngine:
             slots[i, :n + 1] = pages[pr // ps] * ps + pr % ps
             draft_arr[i, :n] = d
             draft_len[i] = n
+        pt0 = self.profiler.begin()
         logits, self.kv_k, self.kv_v = self.verify_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             self.kv_k, self.kv_v, jnp.asarray(table), jnp.asarray(slots))
         out_d, acc_d = verify_greedy_draft(
             logits, jnp.asarray(draft_arr), jnp.asarray(draft_len),
             max_top_k=self.ecfg.max_top_k)
+        self.profiler.end(pt0, "spec_verify", (B, P),
+                          tokens=int(draft_len.sum()) + len(batch),
+                          sync_ref=out_d)
+        self._account_dispatch(batch)
         out = np.asarray(out_d)  # host sync — the spec arm is synchronous
         acc = np.asarray(acc_d)
         self.steps += 1
@@ -1550,6 +1619,7 @@ class JaxEngine:
         pen = self._penalty_args(batch, sb, B)
         topn = (self.ecfg.max_top_logprobs
                 if self._wants_logprobs(batch) else 0)
+        pt0 = self.profiler.begin()
         out = self.decode_multi_fn(
             self.params, tok, pos, done, steps, rem, self.kv_k, self.kv_v,
             jnp.asarray(table), jnp.asarray(sb.temperature),
@@ -1561,6 +1631,12 @@ class JaxEngine:
         else:
             toks, carry, self.kv_k, self.kv_v = out
             aux = None
+        # sampled window timing serializes THIS window's pipeline (the
+        # drain waits out the in-flight overlap) — the documented
+        # sampling overhead; absent entirely at sample=0
+        self.profiler.end(pt0, "decode_window", (B, P, K),
+                          tokens=len(batch) * K, sync_ref=toks)
+        self._account_dispatch(batch)
         self.steps += 1
         pend = _PendingWindow(batch=list(batch), toks=toks, carry=carry,
                               aux=aux,
@@ -1789,13 +1865,50 @@ class JaxEngine:
             seq.finished = reason
         self._emit_finish(seq)
 
+    def _account_dispatch(self, batch: List[Sequence]) -> None:
+        """dynaprof attribution: each dispatch distributes exactly 1.0
+        step share across its batch (occupancy weighting), so the sum of
+        per-request shares equals batch_dispatches_total — the
+        conservation invariant tests/test_profiling.py pins. Host-side
+        counter updates only."""
+        share = 1.0 / len(batch)
+        for seq in batch:
+            seq.dispatch_share += share
+            seq.dispatches += 1
+            if len(seq.pages) > seq.max_pages:
+                seq.max_pages = len(seq.pages)
+        self.batch_dispatches_total += 1
+
+    def _attribution(self, seq: Sequence) -> dict:
+        """Per-request cost block: where this request's share of the
+        engine's time and memory went. ``device_ms_est`` scales the
+        occupancy-weighted step share by the sampled mean device time
+        per dispatch (None until something has been sampled)."""
+        est = self.profiler.mean_device_ms_per_step()
+        return {
+            "queue_wait_ms": round(seq.queue_wait_s * 1000.0, 3),
+            "device_step_share": round(seq.dispatch_share, 6),
+            "dispatches": seq.dispatches,
+            "prompt_tokens": seq.num_prompt,
+            "prefix_hit_tokens": seq.prefix_hit,
+            "decode_tokens": seq.generated,
+            "kv_pages_peak": seq.max_pages,
+            "kv_bytes_peak": seq.max_pages * self._page_bytes,
+            "device_ms_est": (round(seq.dispatch_share * est, 3)
+                              if est is not None else None),
+            "finish_reason": seq.finished,
+        }
+
     def _emit_finish(self, seq: Sequence) -> None:
         if seq.finish_emitted or seq.finished is None:
             return
         seq.finish_emitted = True
+        cost = self._attribution(seq)
+        profiling.record_attribution(seq.context.id, cost)
         self._emit(seq, EngineOutput(token_ids=[], finish_reason=seq.finished,
                                      prompt_tokens=seq.num_prompt,
-                                     completion_tokens=seq.generated))
+                                     completion_tokens=seq.generated,
+                                     cost=cost))
 
     def _emit(self, seq: Sequence, out: EngineOutput) -> None:
         # steps run in the executor thread; asyncio.Queue is not thread-safe,

@@ -1,0 +1,178 @@
+"""dynaprof engine layer: sampled device/host split + per-bucket cost.
+
+The serving loop's time goes three places: device compute, host dispatch
+(Python building arrays + enqueueing the jitted call), and event-loop /
+queue stalls. The runtime layer (runtime/profiling.py) measures the
+third; this module measures the first two — *per compiled program* — so
+"383 vs 1129 tok/s is scheduler overhead, not FLOPs" becomes a table,
+not an inference.
+
+Mechanism: every ``DYN_PROF_SAMPLE``-th scheduler iteration is a
+*sampled* iteration. On a sampled iteration each dispatch is bracketed —
+``t0 → dispatch returns (host cost) → block_until_ready (device
+queue+compute drain)`` — and the figures accumulate into a per-bucket
+cost table keyed by ``kind:B..xP..[xT/K..]``, i.e. exactly the compiled
+program the warmed grid provides. The ``block_until_ready`` is a
+DELIBERATE host sync: it serializes that one iteration's pipeline (the
+documented sampling overhead), which is why it is
+
+- gated behind ``self.sampling`` (dynalint DL018 fails an unguarded
+  sync in profiler code paths), and
+- completely absent at ``DYN_PROF_SAMPLE=0`` (default): the per-dispatch
+  cost is one integer compare — the compile fence + step timeline stay
+  byte-identical (tests/test_profiling.py pins this).
+
+The table exposes which ``(bucket_len, bucket_batch)`` programs the
+ROADMAP item-3 hot-path overhaul must attack: dispatch-µs per program is
+the scheduler-overhead term, tokens/s per program the FLOPs term.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+
+from ..runtime import profiling
+from ..runtime.config import env_int
+
+
+class EngineProfiler:
+    """Per-engine sampled dispatch timer + cost table. All mutation
+    happens on the engine's single-worker executor thread (the same
+    serialization the scheduler itself relies on); ``summary()`` reads
+    are snapshot-style dict builds."""
+
+    def __init__(self, name: str, timeline=None,
+                 sample: Optional[int] = None):
+        if sample is None:
+            sample = env_int("DYN_PROF_SAMPLE") or 0
+        self.name = name
+        self.sample = max(int(sample), 0)
+        self.timeline = timeline
+        self.sampling = False      # True while the CURRENT iteration samples
+        self._iter = 0
+        self.profiled_steps = 0
+        self.device_seconds_total = 0.0
+        self.dispatch_seconds_total = 0.0
+        # "kind:B8xP64[xT512|xK4]" -> {samples, device_us, dispatch_us, tokens}
+        self.buckets: Dict[str, dict] = {}
+        profiling.register_profile(name, self)
+
+    # ------------------------------------------------------------ sampling
+
+    def tick(self) -> None:
+        """Once per scheduler iteration. At sample=0 this is the whole
+        hot-path cost: one compare, no syncs, no timeline writes."""
+        if self.sample <= 0:
+            self.sampling = False
+            return
+        self._iter += 1
+        self.sampling = (self._iter % self.sample) == 0
+
+    def begin(self) -> Optional[float]:
+        """Dispatch-bracket start, or None when this iteration is not
+        sampled (so ``end`` is a no-op and not even perf_counter runs)."""
+        return time.perf_counter() if self.sampling else None
+
+    def end(self, t0: Optional[float], kind: str, key: Tuple[int, ...],
+            tokens: int = 0, sync_ref=None) -> None:
+        """Dispatch-bracket end: host cost = return-from-dispatch − t0;
+        device cost = the drain until ``sync_ref`` is ready (queue +
+        compute — under pipelining this includes previously enqueued
+        work, which is the honest figure for "what the device is doing
+        while the host dispatches")."""
+        if self.sampling and t0 is not None:
+            t1 = time.perf_counter()
+            # the deliberate sampled sync (see module docstring)
+            jax.block_until_ready(sync_ref)
+            t2 = time.perf_counter()
+            self._record(kind, key, t1 - t0, t2 - t1, tokens)
+
+    def _record(self, kind: str, key: Tuple[int, ...], dispatch_s: float,
+                device_s: float, tokens: int) -> None:
+        label = f"{kind}:" + "x".join(str(k) for k in key)
+        row = self.buckets.setdefault(label, {
+            "samples": 0, "device_us": 0.0, "dispatch_us": 0.0,
+            "tokens": 0})
+        row["samples"] += 1
+        row["device_us"] += device_s * 1e6
+        row["dispatch_us"] += dispatch_s * 1e6
+        row["tokens"] += int(tokens)
+        self.profiled_steps += 1
+        self.device_seconds_total += device_s
+        self.dispatch_seconds_total += dispatch_s
+        if self.timeline is not None:
+            self.timeline.add(
+                "prof_sample", bucket=label,
+                dispatch_us=round(dispatch_s * 1e6, 1),
+                device_us=round(device_s * 1e6, 1), tokens=int(tokens))
+
+    # ------------------------------------------------------------- exports
+
+    def device_time_fraction(self) -> float:
+        total = self.device_seconds_total + self.dispatch_seconds_total
+        return self.device_seconds_total / total if total > 0 else 0.0
+
+    def mean_device_ms_per_step(self) -> Optional[float]:
+        """Mean sampled device-drain per dispatch — the scale factor the
+        per-request attribution uses to turn occupancy-weighted step
+        shares into an estimated device-ms figure. None when nothing has
+        been sampled (sample=0)."""
+        if self.profiled_steps == 0:
+            return None
+        return self.device_seconds_total / self.profiled_steps * 1000.0
+
+    def cost_table(self) -> Dict[str, dict]:
+        """Per-bucket means: dispatch/device µs per dispatch plus
+        device-side tokens/s — the regression surface for scheduler
+        overhead per compiled program."""
+        out: Dict[str, dict] = {}
+        for label, row in sorted(self.buckets.items()):
+            n = max(row["samples"], 1)
+            dev_s = row["device_us"] / 1e6
+            out[label] = {
+                "samples": row["samples"],
+                "dispatch_us": round(row["dispatch_us"] / n, 1),
+                "device_us": round(row["device_us"] / n, 1),
+                "tokens_per_s": (round(row["tokens"] / dev_s, 1)
+                                 if dev_s > 0 and row["tokens"] else 0.0),
+            }
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "sample_every": self.sample,
+            "profiled_steps": self.profiled_steps,
+            "device_time_fraction": round(self.device_time_fraction(), 4),
+            "device_seconds_total": round(self.device_seconds_total, 6),
+            "dispatch_seconds_total": round(self.dispatch_seconds_total, 6),
+            "buckets": self.cost_table(),
+        }
+
+
+def memory_snapshot(pm, page_bytes: int) -> dict:
+    """HBM/page occupancy accounting from a PageManager: live (allocated,
+    refcounted), cached (reusable prefix pages), free — in pages and KV
+    bytes — plus the host tier when configured. Host-side reads only."""
+    free = len(pm.free)
+    cached = len(pm.reusable)
+    live = pm.num_pages - 1 - free - cached
+    out = {
+        "page_bytes": page_bytes,
+        "hbm": {
+            "live_pages": live, "cached_pages": cached, "free_pages": free,
+            "live_bytes": live * page_bytes,
+            "cached_bytes": cached * page_bytes,
+            "free_bytes": free * page_bytes,
+        },
+    }
+    if pm.host_pages > 0:
+        host_free = len(pm.host_free)
+        host_used = pm.host_pages - host_free
+        out["host"] = {
+            "used_pages": host_used, "free_pages": host_free,
+            "used_bytes": host_used * page_bytes,
+        }
+    return out

@@ -397,6 +397,11 @@ class FleetSim:
                 },
             },
             "advisories_in_kv": len(stored),
+            # dynaprof plane: the new dyn_engine_*/dyn_runtime_* gauges
+            # as scraped from worker ForwardPassMetrics at run end, so
+            # fleet scenarios regression-gate scheduler overhead next to
+            # the SLO verdicts (virtual-state values only: deterministic)
+            "engine_gauges": self._engine_gauges(),
         }
         if self.k8s is not None:
             extra["k8s_dry_run"] = {
@@ -408,6 +413,27 @@ class FleetSim:
             scenario=self.scenario.name, seed=self.seed,
             steps=self.scenario.steps, advisories=advisories,
             disturb_end_step=self.scenario.disturb_end_step, extra=extra)
+
+    def _engine_gauges(self) -> dict:
+        """Fleet-level rollup of the dynaprof ForwardPassMetrics gauges
+        from the final aggregator scrape (sorted per-worker rows keep the
+        JSON byte-stable across runs)."""
+        wm = [m for _, m in sorted(self.agg.worker_metrics.items())]
+        n = max(len(wm), 1)
+        return {
+            "workers_scraped": len(wm),
+            "inflight_sequences": sum(m.request_active_slots for m in wm),
+            "admission_queue_depth": sum(m.num_requests_waiting
+                                         for m in wm),
+            "kv_free_blocks_min": min((m.kv_free_blocks for m in wm),
+                                      default=0),
+            "device_time_fraction_avg": round(
+                sum(m.device_time_fraction for m in wm) / n, 6),
+            "loop_lag_p99_seconds_max": max(
+                (m.loop_lag_p99_seconds for m in wm), default=0.0),
+            "queue_wait_seconds_total": round(
+                sum(m.queue_wait_seconds_total for m in wm), 6),
+        }
 
     async def teardown(self) -> None:
         if self._http is not None:

@@ -197,6 +197,13 @@ class SimEngineModel:
             kv_total_blocks=p.kv_total_blocks,
             num_requests_waiting=len(self.queue),
             gpu_cache_usage_perc=blocks / max(p.kv_total_blocks, 1),
+            # dynaprof gauges, modeled from virtual state only (so seeded
+            # reports stay byte-identical): slot utilization stands in
+            # for the sampled device fraction; free pages from the block
+            # model
+            kv_free_blocks=p.kv_total_blocks - blocks,
+            device_time_fraction=round(
+                len(self.active) / max(p.slots, 1), 4),
         ).to_dict()
 
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import AsyncIterator, List, Optional
 
+from ..runtime import profiling
 from ..runtime.engine import Context
 from .protocols.common import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP,
                                EngineOutput, PreprocessedRequest)
@@ -101,6 +102,12 @@ class Backend:
 
         async for raw in _aiter(self.engine.generate(request, context)):
             out = raw if isinstance(raw, EngineOutput) else EngineOutput.from_dict(raw)
+            if out.cost is not None:
+                # remote workers attach dynaprof cost attribution to the
+                # finish chunk; registering it here makes the FRONTEND
+                # process's /v1/traces/{rid} and usage extension work even
+                # when the engine ran in another process
+                profiling.record_attribution(context.id, out.cost)
             emit_ids: List[int] = []
             text_parts: List[str] = []
             for tid in out.token_ids:

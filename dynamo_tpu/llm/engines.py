@@ -24,6 +24,19 @@ from .protocols.openai import (ChatCompletionRequest, CompletionRequest,
 log = logging.getLogger("dynamo_tpu.engines")
 
 
+def usage_cost(context: Context):
+    """dynaprof usage extension: the request's cost-attribution block,
+    when DYN_PROF_USAGE is on and the engine (local or remote via the
+    Backend relay) recorded one — else None, and the usage payload
+    stays byte-for-byte OpenAI-shaped."""
+    from ..runtime.config import env_bool
+    from ..runtime import profiling
+
+    if not env_bool("DYN_PROF_USAGE"):
+        return None
+    return profiling.request_attribution(context.id)
+
+
 class LocalChatChain:
     """preprocessor → backend → core engine, in-process (reference
     EngineConfig::StaticCore pipeline: ServiceFrontend → OpenAIPreprocessor →
@@ -102,14 +115,17 @@ class LocalCompletionChain:
                        "choices": [choice]}
             if out.finish_reason:
                 if request.stream_options and request.stream_options.include_usage:
+                    usage = {
+                        "prompt_tokens": len(pre.token_ids),
+                        "completion_tokens": completion_tokens,
+                        "total_tokens":
+                            len(pre.token_ids) + completion_tokens}
+                    cost = usage_cost(context)
+                    if cost is not None:
+                        usage["cost"] = cost
                     yield {"id": rid, "object": "text_completion",
                            "created": created, "model": request.model,
-                           "choices": [],
-                           "usage": {
-                               "prompt_tokens": len(pre.token_ids),
-                               "completion_tokens": completion_tokens,
-                               "total_tokens":
-                                   len(pre.token_ids) + completion_tokens}}
+                           "choices": [], "usage": usage}
                 return
 
 

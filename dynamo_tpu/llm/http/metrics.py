@@ -157,9 +157,11 @@ class Metrics:
         self.stage.render(lines, f"{PREFIX}_stage_duration_seconds", "stage")
         # dynaguard plane: route-fallback/hedge/deadline counters + per-
         # endpoint circuit-breaker state gauges (guard.render_prom_lines)
-        from ...runtime import guard
+        from ...runtime import guard, profiling
 
         lines.extend(guard.render_prom_lines())
+        # dynaprof plane: this process's event-loop lag + stall captures
+        lines.extend(profiling.render_prom_lines())
         return "\n".join(lines) + "\n"
 
 

@@ -19,7 +19,7 @@ from typing import AsyncIterator, Callable, Dict, Optional
 
 from aiohttp import web
 
-from ...runtime import guard, tracing
+from ...runtime import guard, profiling, tracing
 from ...runtime.dcp_client import NoRespondersError
 from ...runtime.engine import Annotated, Context
 from ...runtime.tasks import spawn_tracked
@@ -74,12 +74,18 @@ class HttpService:
             web.get("/v1/models", self._models),
             web.get("/v1/traces", self._traces),
             web.get("/v1/traces/{request_id}", self._trace_one),
+            web.get("/debug/profile", self._debug_profile),
+            web.get("/debug/profile/stacks", self._debug_stacks),
+            web.post("/debug/profile/start", self._profile_start),
+            web.post("/debug/profile/stop", self._profile_stop),
             web.get("/metrics", self._metrics),
             web.get("/health", self._health),
             web.get("/live", self._health),
         ])
         self._runner: Optional[web.AppRunner] = None
         self.port = 0
+        # on-demand jax.profiler capture state (/debug/profile/start)
+        self._jax_trace_dir: Optional[str] = None
         # summarize finished dyntrace spans into the per-stage duration
         # histograms (dyn_llm_http_service_stage_duration_seconds)
         tracing.get_tracer().add_listener(self._on_span_end)
@@ -91,6 +97,9 @@ class HttpService:
     # ------------------------------------------------------------ lifecycle
 
     async def start(self, host: str = "0.0.0.0", port: int = 8080) -> None:
+        # dynaprof: always-on loop-lag monitor + stall watchdog for the
+        # frontend's event loop (refcounted; released in stop())
+        profiling.acquire_loop_profiler()
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -99,8 +108,12 @@ class HttpService:
         log.info("OpenAI HTTP service on %s:%d", host, self.port)
 
     async def stop(self) -> None:
-        if self._runner:
-            await self._runner.cleanup()
+        # claim before the await: concurrent stop() calls must not
+        # double-cleanup or double-release the loop profiler
+        runner, self._runner = self._runner, None
+        if runner:
+            await runner.cleanup()
+            await profiling.release_loop_profiler()
 
     # ------------------------------------------------------------- handlers
 
@@ -118,20 +131,91 @@ class HttpService:
 
     async def _traces(self, request: web.Request) -> web.Response:
         """Debug listing: recent traces (newest first) + the registered
-        engine step timelines."""
+        engine step timelines (with their wall/monotonic anchor pairs,
+        so cross-worker rollups can put every ring on one time axis)."""
         tracer = tracing.get_tracer()
         return web.json_response({
             "traces": tracer.traces_summary(),
             "engine_steps": tracing.timelines_snapshot(),
+            "engine_step_anchors": tracing.timeline_anchors(),
         })
 
     async def _trace_one(self, request: web.Request) -> web.Response:
         rid = request.match_info["request_id"]
         data = tracing.get_tracer().get_request_trace(rid)
-        if data is None:
+        # dynaprof cost attribution joins the trace payload; it is also
+        # served alone when tracing was sampled out (attribution is
+        # always-on, spans are not)
+        cost = profiling.request_attribution(rid)
+        if data is None and cost is None:
             return _error_response(404, f"no trace for request {rid!r}",
                                    {"X-Request-Id": rid})
+        if data is None:
+            data = {"request_id": rid, "trace_id": None, "spans": [],
+                    "stages": {}}
+        if cost is not None:
+            data["cost"] = cost
         return web.json_response(data, headers={"X-Request-Id": rid})
+
+    # ------------------------------------------------- dynaprof debug hooks
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """One-stop profiling snapshot: loop lag + stall-watchdog stats,
+        every live engine's sampled cost table, and the attribution ring
+        depth."""
+        prof = profiling.current_loop_profiler()
+        return web.json_response({
+            "loop": prof.snapshot() if prof is not None else None,
+            "engines": profiling.profiles_snapshot(),
+            "attributions": len(profiling.attributions_snapshot(10 ** 9)),
+            "jax_trace_dir": self._jax_trace_dir,
+        })
+
+    async def _debug_stacks(self, request: web.Request) -> web.Response:
+        """Flamegraph-ready collapsed-stack dump of event-loop stalls
+        (pipe straight into flamegraph.pl)."""
+        return web.Response(text=profiling.stall_stacks_folded(),
+                            content_type="text/plain", charset="utf-8")
+
+    async def _profile_start(self, request: web.Request) -> web.Response:
+        """Start an on-demand jax.profiler trace capture. Body may carry
+        {"dir": path}; defaults to DYN_PROFILE_DIR or a temp dir."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — empty body is fine
+            body = {}
+        # busy-check AFTER the await: everything from here to the state
+        # write is sync, so a concurrent start cannot interleave
+        if self._jax_trace_dir is not None:
+            return _error_response(409, "profiler trace already running "
+                                        f"({self._jax_trace_dir})")
+        from ...runtime.config import env_str
+
+        trace_dir = (body or {}).get("dir") or env_str("DYN_PROFILE_DIR")
+        if not trace_dir:
+            import tempfile
+
+            trace_dir = tempfile.mkdtemp(prefix="dynaprof-jax-")
+        try:
+            import jax.profiler
+
+            jax.profiler.start_trace(trace_dir)
+        except Exception as e:  # noqa: BLE001 — capture is best-effort
+            return _error_response(501, f"jax profiler unavailable: {e!r}")
+        self._jax_trace_dir = trace_dir
+        return web.json_response({"started": True, "dir": trace_dir})
+
+    async def _profile_stop(self, request: web.Request) -> web.Response:
+        if self._jax_trace_dir is None:
+            return _error_response(409, "no profiler trace running")
+        trace_dir, self._jax_trace_dir = self._jax_trace_dir, None
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            return _error_response(500, f"stop_trace failed: {e!r}")
+        return web.json_response({"stopped": True, "dir": trace_dir})
 
     async def _chat(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, ChatCompletionRequest,

@@ -41,6 +41,27 @@ class ForwardPassMetrics:
     kv_transfer_inject_seconds_total: float = 0.0
     kv_transfer_streams_failed_total: int = 0
     remote_prefill_wait_seconds_total: float = 0.0
+    # engine internals that existed in stats() but never reached
+    # Prometheus before dynaprof: admission-queue wait, free/cached HBM
+    # pages, the host offload tier, long-context prefills
+    queue_wait_seconds_total: float = 0.0
+    kv_free_blocks: int = 0
+    kv_cached_blocks: int = 0
+    host_free_blocks: int = 0
+    host_cache_usage_perc: float = 0.0
+    host_offload_pages_total: int = 0
+    host_restore_pages_total: int = 0
+    long_prefills_total: int = 0
+    # dynaprof (engine/profiler.py + runtime/profiling.py): event-loop
+    # lag percentiles, sampled device/host split, per-bucket program
+    # cost table ("kind:BxP..." -> {samples, dispatch_us, device_us,
+    # tokens_per_s}), and the attribution conservation counter
+    loop_lag_p50_seconds: float = 0.0
+    loop_lag_p99_seconds: float = 0.0
+    device_time_fraction: float = 0.0
+    profiled_steps_total: int = 0
+    batch_dispatches_total: int = 0
+    bucket_cost: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)

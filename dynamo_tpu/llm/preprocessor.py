@@ -176,10 +176,13 @@ class OpenAIPreprocessor:
         if finish is None:
             yield gen.content_chunk("", "stop")
         if request.stream_options and request.stream_options.include_usage:
+            from .engines import usage_cost
+
             yield gen.usage_chunk(Usage(
                 prompt_tokens=prompt_tokens,
                 completion_tokens=completion_tokens,
-                total_tokens=prompt_tokens + completion_tokens))
+                total_tokens=prompt_tokens + completion_tokens,
+                cost=usage_cost(context)))
 
 def chat_logprobs_content(out, tokenizer) -> Optional[dict]:
     """EngineOutput logprob fields → the OpenAI chat ``logprobs`` object

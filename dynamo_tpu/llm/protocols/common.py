@@ -148,6 +148,11 @@ class EngineOutput:
     completion_tokens: Optional[int] = None
     # KV routing side-channel: overlap blocks seen by the engine
     kv_overlap_blocks: Optional[int] = None
+    # dynaprof: per-request cost attribution (queue wait, device-step
+    # share, KV footprint) attached to the finish chunk by the engine;
+    # absent on every other chunk and on legacy peers (optional field =
+    # wire-compatible)
+    cost: Optional[dict] = None
 
     @property
     def finished(self) -> bool:
@@ -157,7 +162,7 @@ class EngineOutput:
         d: dict = {"token_ids": list(self.token_ids)}
         for k in ("text", "cum_log_prob", "logprobs", "top_logprobs",
                   "finish_reason", "prompt_tokens", "completion_tokens",
-                  "kv_overlap_blocks"):
+                  "kv_overlap_blocks", "cost"):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -175,4 +180,5 @@ class EngineOutput:
             prompt_tokens=d.get("prompt_tokens"),
             completion_tokens=d.get("completion_tokens"),
             kv_overlap_blocks=d.get("kv_overlap_blocks"),
+            cost=d.get("cost"),
         )

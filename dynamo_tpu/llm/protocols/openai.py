@@ -128,6 +128,10 @@ class Usage(BaseModel):
     prompt_tokens: int = 0
     completion_tokens: int = 0
     total_tokens: int = 0
+    # dynaprof extension (DYN_PROF_USAGE=1): per-request cost attribution
+    # (queue wait, device-step share, KV footprint). Non-OpenAI field,
+    # omitted from payloads when None (exclude_none serialization).
+    cost: Optional[dict] = None
 
 
 class ChatChoiceDelta(BaseModel):
@@ -334,4 +338,5 @@ def _merge_usage(cur: Optional["Usage"], new: "Usage") -> "Usage":
         prompt_tokens=max(cur.prompt_tokens, new.prompt_tokens),
         completion_tokens=cur.completion_tokens + new.completion_tokens,
         total_tokens=max(cur.prompt_tokens, new.prompt_tokens)
-        + cur.completion_tokens + new.completion_tokens)
+        + cur.completion_tokens + new.completion_tokens,
+        cost=cur.cost or new.cost)

@@ -166,12 +166,68 @@ class MetricsAggregator:
             ("dyn_worker_remote_prefill_wait_seconds_total",
              "decode-side wait for remote prefill (enqueue to KV commit)",
              lambda m: m.remote_prefill_wait_seconds_total),
+            # dynaprof: engine internals that previously never left
+            # stats() + the sampled device/host split
+            ("dyn_engine_inflight_sequences",
+             "sequences holding engine batch slots (prefilling+running)",
+             lambda m: m.request_active_slots),
+            ("dyn_engine_admission_queue_depth",
+             "requests waiting for engine admission",
+             lambda m: m.num_requests_waiting),
+            ("dyn_engine_queue_wait_seconds_total",
+             "cumulative seconds requests spent waiting for admission",
+             lambda m: m.queue_wait_seconds_total),
+            ("dyn_engine_kv_free_blocks",
+             "free HBM KV pages", lambda m: m.kv_free_blocks),
+            ("dyn_engine_kv_cached_blocks",
+             "reusable prefix-cache HBM KV pages",
+             lambda m: m.kv_cached_blocks),
+            ("dyn_engine_host_free_blocks",
+             "free host-tier KV pages", lambda m: m.host_free_blocks),
+            ("dyn_engine_host_cache_usage_perc",
+             "host offload-tier usage fraction",
+             lambda m: m.host_cache_usage_perc),
+            ("dyn_engine_host_offload_pages_total",
+             "pages evicted HBM->host tier",
+             lambda m: m.host_offload_pages_total),
+            ("dyn_engine_host_restore_pages_total",
+             "pages restored host tier->HBM",
+             lambda m: m.host_restore_pages_total),
+            ("dyn_engine_long_prefills_total",
+             "sequence-parallel ring prefills served",
+             lambda m: m.long_prefills_total),
+            ("dyn_engine_device_time_fraction",
+             "sampled device-drain fraction of (device + host dispatch) "
+             "time (dynaprof; 0 until DYN_PROF_SAMPLE>0 samples a step)",
+             lambda m: m.device_time_fraction),
+            ("dyn_engine_profiled_steps_total",
+             "scheduler iterations sampled by the dynaprof timed "
+             "dispatch", lambda m: m.profiled_steps_total),
         ]
         for name, help_, get in per_worker:
             rows = [
                 f'{name}{{namespace="{ns}",worker="{wid:x}"}} {get(m)}'
                 for wid, m in sorted(self.worker_metrics.items())]
             gauge(name, help_, rows)
+        # dynaprof labeled families: loop lag quantiles + per-bucket
+        # program cost (one row per compiled (kind, bucket) program —
+        # the ROADMAP item-3 regression surface)
+        gauge("dyn_runtime_loop_lag_seconds",
+              "per-worker event-loop sleep-drift percentiles (dynaprof)",
+              [f'dyn_runtime_loop_lag_seconds{{namespace="{ns}",'
+               f'worker="{wid:x}",quantile="{q}"}} {val}'
+               for wid, m in sorted(self.worker_metrics.items())
+               for q, val in (("p50", m.loop_lag_p50_seconds),
+                              ("p99", m.loop_lag_p99_seconds))])
+        gauge("dyn_engine_bucket_cost_us",
+              "mean sampled device-drain microseconds per dispatch, per "
+              "compiled (kind, bucket) program (dynaprof cost table)",
+              [f'dyn_engine_bucket_cost_us{{namespace="{ns}",'
+               f'worker="{wid:x}",bucket="{bucket}"}} '
+               f'{row.get("device_us", 0.0)}'
+               for wid, m in sorted(self.worker_metrics.items())
+               for bucket, row in sorted(
+                   (m.bucket_cost or {}).items())])
         usages = [m.gpu_cache_usage_perc
                   for m in self.worker_metrics.values()]
         if usages:

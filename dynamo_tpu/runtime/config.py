@@ -75,6 +75,20 @@ register_env("DYN_LOG", "INFO", "runtime",
              "Root log level (DEBUG/INFO/WARNING/...).")
 register_env("DYN_LOGGING_JSONL", "0", "runtime",
              "Emit JSONL structured logs instead of text (1/true).")
+register_env("DYN_PROF_ATTR_RING", "2048", "runtime",
+             "dynaprof: per-request cost-attribution ring capacity "
+             "(finished-request attribution dicts kept per process for "
+             "/v1/traces/{request_id} and the usage extension block).")
+register_env("DYN_PROF_LOOP_INTERVAL_MS", "100", "runtime",
+             "dynaprof: event-loop lag-monitor sampling interval in ms "
+             "(the sleep whose wakeup drift is measured).")
+register_env("DYN_PROF_STACKS", "256", "runtime",
+             "dynaprof: max distinct folded stacks the stall watchdog "
+             "keeps (new shapes past the cap are counted as dropped).")
+register_env("DYN_PROF_STALL_MS", "250", "runtime",
+             "dynaprof: loop-callback overrun (ms) past which the stall "
+             "watchdog captures the event-loop thread's Python stack "
+             "into the flamegraph ring; 0 disables the watchdog thread.")
 register_env("DYN_REQUEST_DEADLINE_MS", "0", "runtime",
              "Default end-to-end request deadline in milliseconds, "
              "applied at the HTTP frontend when the request carries "
@@ -137,6 +151,18 @@ register_env("DYN_JIT_FENCE", None, "engine",
              "dyn_engine_post_warmup_compiles_total); 'warn' logs each "
              "compile; 'raise' fails the offending jit call with "
              "PostWarmupCompileError (the CI mode).")
+
+register_env("DYN_PROF_SAMPLE", "0", "engine",
+             "dynaprof: profile every Nth engine scheduler iteration "
+             "with a timed dispatch (host-dispatch vs device-drain "
+             "split, per-bucket cost table). The sampled iteration pays "
+             "one deliberate device sync; 0 (default) disables sampling "
+             "entirely — the hot path stays sync-free.")
+
+register_env("DYN_PROF_USAGE", "0", "llm",
+             "dynaprof: attach the per-request cost-attribution block "
+             "to OpenAI usage payloads (stream_options.include_usage) "
+             "as a `cost` extension field (1/true).")
 
 register_env("DYN_FLEET_DISCOVERY_TIMEOUT", "10.0", "fleet",
              "Fleet simulator: wall-clock seconds to wait for spawned/"

@@ -410,11 +410,24 @@ def format_traceparent(span) -> Optional[str]:
 class StepTimeline:
     """Bounded ring of engine scheduler events (per-step queue-wait, batch
     occupancy, tokens/step, spec accepts). Appends are cheap dict pushes —
-    safe from the engine's executor thread; ``capacity=0`` disables."""
+    safe from the engine's executor thread; ``capacity=0`` disables.
+
+    Each record stores a MONOTONIC offset (``mono_ms``) from one
+    wall/monotonic anchor pair stamped once at ring construction; the
+    wall ``ts_ms`` is derived at export (``anchor_wall + mono_ms``).
+    Per-record ``time.time()`` stamps (the old scheme) drift under NTP
+    slew and carry no monotonic companion, so timelines from different
+    workers could not be ordered against each other in /v1/traces
+    rollups — the anchor pair makes cross-worker alignment a single
+    per-ring offset subtraction."""
 
     def __init__(self, capacity: int):
         self._q: Optional[deque] = (deque(maxlen=capacity)
                                     if capacity > 0 else None)
+        # the per-ring anchor pair: monotonic for intervals, wall for
+        # cross-worker alignment (stamped together, once)
+        self.anchor_monotonic = time.monotonic()
+        self.anchor_wall = time.time()
 
     @property
     def enabled(self) -> bool:
@@ -422,7 +435,8 @@ class StepTimeline:
 
     def add(self, kind: str, **fields: Any) -> None:
         if self._q is not None:
-            fields["ts_ms"] = round(time.time() * 1000.0, 3)
+            fields["mono_ms"] = round(
+                (time.monotonic() - self.anchor_monotonic) * 1000.0, 3)
             fields["kind"] = kind
             self._q.append(fields)
 
@@ -430,7 +444,16 @@ class StepTimeline:
         if self._q is None:
             return []
         items = list(self._q)
-        return items[-limit:] if limit else items
+        if limit:
+            items = items[-limit:]
+        base = self.anchor_wall * 1000.0
+        return [{**e, "ts_ms": round(base + e["mono_ms"], 3)}
+                for e in items]
+
+    def anchors(self) -> dict:
+        return {"anchor_wall_ms": round(self.anchor_wall * 1000.0, 3),
+                "anchor_monotonic_ms": round(
+                    self.anchor_monotonic * 1000.0, 3)}
 
 
 _timelines: Dict[str, "weakref.ref[StepTimeline]"] = {}
@@ -453,4 +476,16 @@ def timelines_snapshot(limit: int = 200) -> Dict[str, List[dict]]:
                 del _timelines[name]
             elif tl.enabled:
                 out[name] = tl.snapshot(limit)
+    return out
+
+
+def timeline_anchors() -> Dict[str, dict]:
+    """Each registered ring's wall/monotonic anchor pair — what a
+    cross-worker rollup subtracts to put every timeline on one axis."""
+    out: Dict[str, dict] = {}
+    with _timelines_lock:
+        for name, ref in list(_timelines.items()):
+            tl = ref()
+            if tl is not None and tl.enabled:
+                out[name] = tl.anchors()
     return out

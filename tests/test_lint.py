@@ -435,6 +435,80 @@ def test_dl011_suppression():
     assert "DL011" not in codes(DL011_SUPPRESSED)
 
 
+# --------------------------------------------- DL018 unsampled-profiler-sync
+
+
+PROFILER_PATH = "dynamo_tpu/engine/fix_profiler.py"
+
+DL018_BAD = """
+import time
+import jax
+import numpy as np
+class Prof:
+    def end(self, ref):
+        t0 = time.perf_counter()
+        jax.block_until_ready(ref)        # sync with no sample guard
+        host = np.asarray(ref)            # ditto
+        return time.perf_counter() - t0
+"""
+
+DL018_BAD_ELSE = """
+import jax
+class Prof:
+    def end(self, ref):
+        if self.sampling:
+            jax.block_until_ready(ref)    # guarded: fine
+        else:
+            jax.block_until_ready(ref)    # the NOT-sampling branch: fires
+"""
+
+DL018_GOOD = """
+import time
+import jax
+import numpy as np
+class Prof:
+    def end(self, t0, ref):
+        if self.sampling and t0 is not None:
+            t1 = time.perf_counter()
+            jax.block_until_ready(ref)
+            host = np.asarray(ref)
+        if self.enabled:
+            ref.block_until_ready()
+    def tick(self):
+        self._iter += 1                   # no sync: nothing to guard
+"""
+
+DL018_SUPPRESSED = """
+import jax
+class Prof:
+    def flush(self, ref):
+        # one-shot teardown drain, not a per-step path
+        jax.block_until_ready(ref)  # dynalint: disable=unsampled-profiler-sync
+"""
+
+
+def test_dl018_fires_on_unguarded_profiler_sync():
+    assert codes(DL018_BAD, PROFILER_PATH).count("DL018") == 2
+
+
+def test_dl018_fires_in_else_branch():
+    assert codes(DL018_BAD_ELSE, PROFILER_PATH).count("DL018") == 1
+
+
+def test_dl018_quiet_under_sample_guard():
+    assert "DL018" not in codes(DL018_GOOD, PROFILER_PATH)
+
+
+def test_dl018_only_applies_to_profiler_paths():
+    # the same unguarded sync outside profiler modules is DL005/DL017
+    # territory, not DL018
+    assert "DL018" not in codes(DL018_BAD, "dynamo_tpu/engine/other.py")
+
+
+def test_dl018_suppression():
+    assert "DL018" not in codes(DL018_SUPPRESSED, PROFILER_PATH)
+
+
 # ------------------------------------------------- dynaflow fixture plumbing
 
 

@@ -358,7 +358,15 @@ def test_sampling_zero_end_to_end(run_async):
                         if line.decode().strip() == "data: [DONE]":
                             break
                 assert disagg.remote_prefills == 1
+                # no SPANS at sample=0 — but dynaprof cost attribution is
+                # always-on, so /v1/traces/{rid} serves a cost-only
+                # payload with an empty span list instead of a 404
                 async with http.get(f"{base}/v1/traces/{rid}") as r:
+                    assert r.status == 200
+                    body = await r.json()
+                    assert body["spans"] == []
+                    assert body["cost"]["decode_tokens"] >= 1
+                async with http.get(f"{base}/v1/traces/never-seen") as r:
                     assert r.status == 404
         finally:
             await _teardown(*handles)

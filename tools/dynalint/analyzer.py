@@ -92,6 +92,12 @@ RULES: Dict[str, Tuple[str, str]] = {
               "(np.asarray / .item() / .tolist() / float / int / bool / "
               "iteration): a hidden device sync the callsite-pattern "
               "DL005 cannot see"),
+    "DL018": ("unsampled-profiler-sync",
+              "host sync in profiler code with no sample/flag guard: "
+              "dynaprof instrumentation must cost nothing when sampling "
+              "is off, so every deliberate sync (block_until_ready, "
+              "perf_counter-bracketed readback) must sit under an "
+              "`if <...sampl.../enabled/active...>:` guard"),
 }
 
 NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}
@@ -167,6 +173,15 @@ HOT_SYNC_ALLOWLIST = frozenset({
 
 # DL006: modules allowed to touch os.environ directly (the registry itself).
 ENV_ALLOWED_SUFFIXES = ("runtime/config.py",)
+
+# DL018: profiler code paths — any module whose basename names profiling
+# (runtime/profiling.py, engine/profiler.py, fixtures). In these files a
+# host-sync primitive is legitimate ONLY as the deliberate sampled
+# measurement, which must be lexically under an `if` whose condition
+# references a sampling/enabled flag — so sample=0 provably costs no
+# sync. The guard-name pattern accepts the obvious spellings.
+PROFILER_PATH_RE = re.compile(r"(^|/)[A-Za-z0-9_]*profil[A-Za-z0-9_]*\.py$")
+SAMPLE_GUARD_RE = re.compile(r"(?i)(sampl|enabled|active|armed)")
 
 # DL007: the span-starting call (method or bare name) and the attribute
 # accesses that count as "the span is closed somewhere".
@@ -278,6 +293,9 @@ class _Analyzer(ast.NodeVisitor):
         norm = path.replace(os.sep, "/")
         self._is_engine = any(m in norm for m in HOT_PATH_MARKERS)
         self._env_allowed = norm.endswith(ENV_ALLOWED_SUFFIXES)
+        # DL018 state: per-function sample-guard nesting depth
+        self._is_profiler = bool(PROFILER_PATH_RE.search(norm))
+        self._guard_depth: List[int] = [0]
 
     # ------------------------------------------------------------- reporting
 
@@ -311,12 +329,14 @@ class _Analyzer(ast.NodeVisitor):
         self._func_ids.append(id(node))
         self._loop_depth.append(0)
         self._lock_depth.append(0)
+        self._guard_depth.append(0)
 
     def _exit_func(self) -> None:
         self._funcs.pop()
         self._func_ids.pop()
         self._loop_depth.pop()
         self._lock_depth.pop()
+        self._guard_depth.pop()
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._enter_func(node, False)
@@ -360,6 +380,23 @@ class _Analyzer(ast.NodeVisitor):
     visit_While = _visit_loop
     visit_For = _visit_loop
     visit_AsyncFor = _visit_loop
+
+    # ------------------------------------------------------ DL018 guard scope
+
+    def visit_If(self, node: ast.If) -> None:
+        """Track sample-guard nesting in profiler modules: only the
+        guarded BODY is sanctioned for deliberate syncs — the orelse is
+        the not-sampling branch and stays unguarded."""
+        guarded = self._is_profiler and _is_sample_guard(node.test)
+        self.visit(node.test)
+        if guarded:
+            self._guard_depth[-1] += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if guarded:
+            self._guard_depth[-1] -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
 
     # ------------------------------------------------------ DL003 broad except
 
@@ -416,6 +453,11 @@ class _Analyzer(ast.NodeVisitor):
 
         if self._is_engine and self._in_hot_func():
             self._check_host_sync(node, d, attr)
+
+        if self._is_profiler and self._guard_depth[-1] == 0:
+            what = host_sync_what(node, d, attr)
+            if what is not None:
+                self.emit(node, "DL018", f"{what} outside a sample guard")
 
         if not self._env_allowed:
             self._check_env_read(node, d)
@@ -632,6 +674,18 @@ def host_sync_what(call: ast.Call, d: Optional[str],
                 call.args[0], (ast.Call, ast.Subscript)):
         return "`float()` on a computed value"
     return None
+
+
+def _is_sample_guard(test: ast.AST) -> bool:
+    """True when an `if` condition references a sampling/enabled flag
+    (any Name or attribute segment matching SAMPLE_GUARD_RE)."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Name) and SAMPLE_GUARD_RE.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and \
+                SAMPLE_GUARD_RE.search(sub.attr):
+            return True
+    return False
 
 
 def _is_lock_expr(expr: ast.AST) -> bool:
