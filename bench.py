@@ -60,8 +60,17 @@ def metric_name(args) -> str:
         x8 = ", kv-int8" if env_bool("DYN_KV_TRANSFER_INT8") else ""
         ch = (f", kv-chunks {args.kv_chunk_pages}"
               if getattr(args, "kv_chunk_pages", None) else "")
+        sp = (", shared-prefix A/B"
+              if getattr(args, "shared_prefix", False) else "")
         return (f"disagg/agg req/s ratio (1-chip time-shared, threshold "
-                f"{args.disagg_threshold}{x8}{ch})")
+                f"{args.disagg_threshold}{x8}{ch}{sp})")
+    if args.scenario == "shared":
+        smoke = "cpu smoke" if getattr(args, "cpu", False) else "1 chip"
+        return (f"prefix-cache hit rate, shared-prefix workloads "
+                f"({getattr(args, 'shared_shape', 'multi_tenant')}) through "
+                f"the real HTTP->KV-router->engine stack "
+                f"({args.users}u x {args.turns}w, {_model_tag(args)} "
+                f"llama, {smoke})")
     return ("output tokens/s, synthetic ShareGPT "
             f"(ISL~{args.isl}/OSL {args.osl}, {args.requests} reqs, "
             f"conc {args.concurrency}, {_model_tag(args)} llama, 1 chip)")
@@ -74,8 +83,8 @@ def metric_unit(args) -> str:
     paths all call this)."""
     if getattr(args, "spec", False) or getattr(args, "sweep", None):
         return "tok/s"
-    return {"multiturn": "ms", "disagg": "ratio"}.get(args.scenario,
-                                                      "tok/s")
+    return {"multiturn": "ms", "disagg": "ratio",
+            "shared": "rate"}.get(args.scenario, "tok/s")
 
 
 def emit_unavailable(args, reason: str) -> None:
@@ -172,13 +181,30 @@ def parse_args():
     ap.add_argument("--decode-steps", type=int, default=16,
                     help="fused decode window (amortizes dispatch latency)")
     ap.add_argument("--scenario", default="sharegpt",
-                    choices=["sharegpt", "multiturn", "disagg"],
+                    choices=["sharegpt", "multiturn", "disagg", "shared"],
                     help="multiturn = conversations with growing shared "
                          "prefixes (the KV-offload TTFT scenario, "
                          "reference docs/architecture.md:91-96); "
                          "disagg = A/B of disaggregated prefill/decode vs "
                          "aggregated on the same workload (the BASELINE.md "
-                         "north-star, reference docs/architecture.md:57-61)")
+                         "north-star, reference docs/architecture.md:57-61); "
+                         "shared = dynacache shared-prefix workloads "
+                         "driven through the REAL HTTP->KV-router->engine "
+                         "stack, share vs no-share A/B per shape with the "
+                         "router/engine/host-tier attribution breakdown")
+    ap.add_argument("--shared-shape", default="multi_tenant",
+                    choices=["multi_tenant", "rag", "agent", "all"],
+                    help="shared scenario workload shape: multi_tenant = "
+                         "per-tenant shared system prompts; rag = one long "
+                         "common context + distinct questions; agent = "
+                         "per-agent growing histories re-sent every turn; "
+                         "all = run each in sequence")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="disagg scenario: add a shared-prefix leg (same "
+                         "lengths, common 2/3-ISL prompt prefix) so the "
+                         "transfer-vs-reuse interaction is measurable — "
+                         "decode-side reservations prefix-hit and skip "
+                         "transferring the shared pages")
     ap.add_argument("--disagg-threshold", type=int, default=256,
                     help="max local prefill length for the disagg router")
     ap.add_argument("--kv-chunk-pages", default=None,
@@ -243,7 +269,8 @@ def build_engine(args):
         ecfg = EngineConfig(page_size=16, num_pages=256, max_batch=16,
                             prefill_chunk=128, prefill_buckets=(128,),
                             batch_buckets=(4, 16), page_buckets=(16,),
-                            decode_steps=args.decode_steps)
+                            decode_steps=args.decode_steps,
+                            host_pages=args.host_pages)
     elif args.model == "8b":
         # Llama-3-8B-shaped — the size BASELINE.md's north-star metric is
         # defined at. bf16 weights (16 GB) exceed a v5e's HBM, so this
@@ -415,6 +442,333 @@ async def run_multiturn(args):
     }
     print(json.dumps(report), file=sys.stderr)
     return report
+
+
+# ------------------------------------------------ dynacache shared-prefix
+
+
+def _word_text(rng, nchars: int) -> str:
+    """Deterministic filler text of ~nchars (byte tokenizer: 1 char =
+    1 token) — the fleet/traffic.py word-soup idiom."""
+    words = ("alpha bravo charlie delta echo foxtrot golf hotel india "
+             "juliet kilo lima mike november oscar papa quebec romeo "
+             "sierra tango uniform victor whiskey xray yankee zulu").split()
+    out = []
+    n = 0
+    while n < nchars:
+        w = words[rng.randint(0, len(words) - 1)]
+        out.append(w)
+        n += len(w) + 1
+    return " ".join(out)[:nchars]
+
+
+async def _shared_settle(publisher, kvr) -> None:
+    """Between waves: flush the engine's stored-block events onto the bus,
+    let the router's subscription drain them, refresh worker stats."""
+    await publisher.flush()
+    await asyncio.sleep(0.05)
+    await kvr.scrape_once()
+
+
+async def _shared_wave(http, port, reqs, osl: int, rows: list) -> dict:
+    """Issue one wave of completions concurrently over the REAL HTTP
+    frontend; returns {rid: completion_text} (agent histories grow by
+    it). Each request pins its X-Request-Id so /v1/traces/{rid} can be
+    joined afterwards."""
+    import json as _json
+
+    texts = {}
+
+    async def one(rid, prompt):
+        t0 = time.monotonic()
+        first = None
+        text = []
+        async with http.post(
+                f"http://127.0.0.1:{port}/v1/completions",
+                json={"model": "bench", "prompt": prompt,
+                      "stream": True, "max_tokens": osl},
+                headers={"X-Request-Id": rid}) as resp:
+            if resp.status != 200:
+                rows.append({"rid": rid, "ttft": None, "error": True})
+                return
+            async for raw in resp.content:
+                line = raw.strip()
+                if line == b"data: [DONE]":
+                    break
+                if not line.startswith(b"data: "):
+                    continue
+                chunk = _json.loads(line[len(b"data: "):])
+                for c in chunk.get("choices", []):
+                    piece = c.get("text") or ""
+                    if piece:
+                        if first is None:
+                            first = time.monotonic() - t0
+                        text.append(piece)
+        texts[rid] = "".join(text)
+        rows.append({"rid": rid, "ttft": first, "error": False})
+
+    await asyncio.gather(*(one(rid, p) for rid, p in reqs))
+    return texts
+
+
+async def _run_shared_leg(args, shape: str, share: bool, http, port,
+                          publisher, kvr, cap_tokens: int,
+                          leg_tag: str) -> list:
+    """One leg of a shape: waves of requests whose prompts share (or —
+    the A/B control — do not share) prefixes. Returns the per-request
+    rows; wave boundaries settle the event/stats planes so followers can
+    actually route onto and hit the blocks the leaders committed."""
+    import numpy as np
+
+    rng = np.random.RandomState(args.seed ^ (0xCA if share else 0x5E))
+    budget = max(cap_tokens - args.osl - 16, 96)
+    prefix_chars = min(max(int(args.isl * 2 // 3), 48), int(budget * 0.6))
+    suffix_chars = max(min(args.isl - prefix_chars, budget - prefix_chars
+                           - 16), 8)
+    rows: list = []
+    n_req = 0
+
+    def rid_for():
+        nonlocal n_req
+        n_req += 1
+        return f"{leg_tag}-{n_req:04d}"
+
+    if shape == "multi_tenant":
+        # per-tenant shared system prompt; wave 0 seeds each tenant's
+        # chain, later waves re-use it with unique question suffixes
+        prefixes = {t: _word_text(rng, prefix_chars)
+                    for t in range(args.users)}
+        for wave in range(max(args.turns, 2)):
+            reqs = []
+            for t in range(args.users):
+                prefix = (prefixes[t] if share
+                          else _word_text(rng, prefix_chars))
+                suffix = f" q{wave}: " + _word_text(rng, suffix_chars)
+                reqs.append((rid_for(), prefix + suffix))
+            await _shared_wave(http, port, reqs, args.osl, rows)
+            await _shared_settle(publisher, kvr)
+    elif shape == "rag":
+        # one long common context; wave 0 = a single seeding question,
+        # then concurrent distinct questions over the same context
+        context = _word_text(rng, prefix_chars)
+        seed_req = [(rid_for(),
+                     (context if share else _word_text(rng, prefix_chars))
+                     + " q0: " + _word_text(rng, suffix_chars))]
+        await _shared_wave(http, port, seed_req, args.osl, rows)
+        await _shared_settle(publisher, kvr)
+        for wave in range(1, max(args.turns, 2)):
+            reqs = []
+            for u in range(args.users):
+                ctx = context if share else _word_text(rng, prefix_chars)
+                reqs.append((rid_for(), ctx + f" q{wave}.{u}: "
+                             + _word_text(rng, suffix_chars)))
+            await _shared_wave(http, port, reqs, args.osl, rows)
+            await _shared_settle(publisher, kvr)
+    elif shape == "agent":
+        # agent loop: each turn re-sends the full growing history (prior
+        # prompt + the model's own answer + a new instruction)
+        histories = {a: _word_text(rng, prefix_chars)
+                     for a in range(args.users)}
+        for turn in range(max(args.turns, 2)):
+            reqs = []
+            rid_by_agent = {}
+            for a in range(args.users):
+                if not share:
+                    # control: same lengths, no reuse across turns
+                    histories[a] = _word_text(rng, len(histories[a]))
+                if len(histories[a]) + args.osl + 24 > budget:
+                    continue  # history hit the warmed-grid capacity
+                prompt = histories[a] + f" step{turn}: " \
+                    + _word_text(rng, 16)
+                rid = rid_for()
+                rid_by_agent[a] = (rid, prompt)
+                reqs.append((rid, prompt))
+            if not reqs:
+                break
+            texts = await _shared_wave(http, port, reqs, args.osl, rows)
+            for a, (rid, prompt) in rid_by_agent.items():
+                histories[a] = prompt + texts.get(rid, "")
+            await _shared_settle(publisher, kvr)
+    else:
+        raise ValueError(f"unknown shared shape {shape!r}")
+    return rows
+
+
+async def _shared_cost_split(http, port, rows) -> dict:
+    """Join the per-request cost blocks from /v1/traces/{rid}: the
+    router-predicted vs engine-realized vs host-tier attribution
+    breakdown summed over the leg."""
+    split = {"requests_with_cost": 0, "prompt_blocks": 0,
+             "router_overlap_blocks": 0, "device_hit_blocks": 0,
+             "host_restored_blocks": 0, "fresh_blocks": 0,
+             "restore_wait_ms": 0.0}
+    for row in rows:
+        if row.get("error"):
+            continue
+        async with http.get(
+                f"http://127.0.0.1:{port}/v1/traces/{row['rid']}") as resp:
+            if resp.status != 200:
+                continue
+            cost = (await resp.json()).get("cost")
+        if not cost or "device_hit_blocks" not in cost:
+            continue
+        split["requests_with_cost"] += 1
+        pb = int(cost.get("prompt_blocks", 0))
+        dh = int(cost.get("device_hit_blocks", 0))
+        hr = int(cost.get("host_restored_blocks", 0))
+        split["prompt_blocks"] += pb
+        split["router_overlap_blocks"] += int(
+            cost.get("router_overlap_blocks", 0))
+        split["device_hit_blocks"] += dh
+        split["host_restored_blocks"] += hr
+        split["fresh_blocks"] += pb - dh - hr
+        split["restore_wait_ms"] += float(cost.get("restore_wait_ms", 0.0))
+    split["restore_wait_ms"] = round(split["restore_wait_ms"], 3)
+    return split
+
+
+async def run_shared(args):
+    """dynacache tentpole workloads: shared-prefix traffic driven through
+    the REAL stack (aiohttp -> HttpService -> Processor -> KvRouter ->
+    token worker -> JaxEngine), each shape A/B'd against a no-sharing
+    control of identical lengths. The report quotes, per shape:
+    the engine prefix hit rate (windowed counters delta), the TTFT delta
+    vs no-sharing, and the router-predicted vs engine-realized vs
+    host-restored attribution breakdown from the per-request cost
+    blocks."""
+    import aiohttp
+
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.processor import Processor
+    from dynamo_tpu.llm.worker import serve_token_model
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    engine, cfg = build_engine(args)
+    print("warming up (compiling bucket grid)...", file=sys.stderr)
+    engine.warmup()
+
+    drt = await DistributedRuntime.detached()
+    service = None
+    kvr = None
+    token_client = None
+    publisher = None
+    try:
+        mdc = ModelDeploymentCard(name="bench", tokenizer_kind="byte",
+                                  kv_block_size=engine.ecfg.page_size,
+                                  model_type="completions")
+        _handle, publisher = await serve_token_model(
+            drt, mdc, engine, namespace="bench", component="w")
+        kvr = KvRouter(drt, "bench", "w",
+                       block_size=engine.ecfg.page_size, seed=args.seed)
+        await kvr.start(run_loop=False)
+        await kvr.scrape_once()
+        token_client = await drt.namespace("bench").component("w") \
+            .endpoint("generate_tokens").client()
+        processor = Processor(mdc, token_client, kvr)
+        service = HttpService()
+        service.manager.add_completions_model("bench",
+                                              processor.completion)
+        await service.start(host="127.0.0.1", port=0)
+
+        shapes = (["multi_tenant", "rag", "agent"]
+                  if args.shared_shape == "all" else [args.shared_shape])
+        report = {"scenario": "shared_prefix", "users": args.users,
+                  "waves": args.turns, "shapes": {}}
+        agg_hits = agg_prompts = 0
+        ttft_ratios = []
+        async with aiohttp.ClientSession() as http:
+            for shape in shapes:
+                legs = {}
+                # no-share control FIRST: its unique junk cannot be hit
+                # by the shared leg, the shared leg's blocks can
+                for share in (False, True):
+                    tag = f"{shape}-{'sh' if share else 'no'}"
+                    st0 = engine.stats()
+                    r0 = kvr.stats()
+                    rows = await _run_shared_leg(
+                        args, shape, share, http, service.port,
+                        publisher, kvr, engine.cap_tokens, tag)
+                    st1 = engine.stats()
+                    r1 = kvr.stats()
+                    hits = (st1["prefix_hit_tokens_total"]
+                            - st0["prefix_hit_tokens_total"])
+                    prompts = (st1["prompt_tokens_total"]
+                               - st0["prompt_tokens_total"])
+                    ttfts = sorted(r["ttft"] for r in rows
+                                   if r.get("ttft") is not None)
+                    leg = {
+                        "requests": len(rows),
+                        "errors": sum(1 for r in rows if r.get("error")),
+                        "ttft_p50_ms": (round(
+                            ttfts[len(ttfts) // 2] * 1000, 1)
+                            if ttfts else None),
+                        "prefix_hit_rate": round(hits / max(prompts, 1),
+                                                 4),
+                        "prefix_hit_tokens": hits,
+                        "prompt_tokens": prompts,
+                        "device_hit_blocks": (
+                            st1["cache_device_hit_blocks_total"]
+                            - st0["cache_device_hit_blocks_total"]),
+                        "host_restored_blocks": (
+                            st1["cache_host_restored_blocks_total"]
+                            - st0["cache_host_restored_blocks_total"]),
+                        "fresh_blocks": (
+                            st1["cache_fresh_blocks_total"]
+                            - st0["cache_fresh_blocks_total"]),
+                        "restore_wait_s": round(
+                            st1["cache_restore_wait_seconds_total"]
+                            - st0["cache_restore_wait_seconds_total"], 4),
+                        "router_predicted_blocks": (
+                            r1["calibration"]["predicted_blocks_total"]
+                            - r0["calibration"]["predicted_blocks_total"]),
+                        "router_realized_blocks": (
+                            r1["calibration"]["realized_blocks_total"]
+                            - r0["calibration"]["realized_blocks_total"]),
+                        "cost_split": await _shared_cost_split(
+                            http, service.port, rows),
+                    }
+                    legs["share" if share else "noshare"] = leg
+                    if share:
+                        agg_hits += hits
+                        agg_prompts += prompts
+                entry = dict(legs)
+                if (legs["share"]["ttft_p50_ms"]
+                        and legs["noshare"]["ttft_p50_ms"]):
+                    entry["ttft_delta_ms"] = round(
+                        legs["noshare"]["ttft_p50_ms"]
+                        - legs["share"]["ttft_p50_ms"], 1)
+                    ttft_ratios.append(legs["noshare"]["ttft_p50_ms"]
+                                       / max(legs["share"]["ttft_p50_ms"],
+                                             1e-9))
+                report["shapes"][shape] = entry
+                print(json.dumps({shape: entry}), file=sys.stderr)
+        st = engine.stats()
+        report["prefix_hit_rate"] = round(agg_hits / max(agg_prompts, 1),
+                                          4)
+        report["hit_rate_windowed"] = round(
+            st["gpu_prefix_cache_hit_rate"], 4)
+        report["calibration"] = kvr.stats()["calibration"]
+        report["post_warmup_compiles"] = st["post_warmup_compiles_total"]
+        report["host_restores"] = st["host_restore_pages_total"]
+        report["host_offloads"] = st["host_offload_pages_total"]
+        report["ttft_noshare_over_share"] = (
+            round(sum(ttft_ratios) / len(ttft_ratios), 3)
+            if ttft_ratios else None)
+        print(json.dumps(report), file=sys.stderr)
+        return report
+    finally:
+        if service is not None:
+            await service.stop()
+        if kvr is not None:
+            await kvr.stop()
+        if token_client is not None:
+            await token_client.close()
+        if publisher is not None:
+            await publisher.stop()
+        await engine.stop()
+        await drt.shutdown()
 
 
 async def measure(engine, reqs, concurrency, trace=False):
@@ -662,7 +1016,9 @@ async def run_disagg(args):
 
     # one disagg leg per chunk size (0 = legacy bulk frame): same engines,
     # fresh prompts per leg (a repeated workload would prefix-hit the
-    # decode pool and skip the transfer under test)
+    # decode pool and skip the transfer under test — --shared-prefix adds
+    # a deliberate A/B leg that does exactly that, measuring the
+    # transfer-vs-reuse interaction instead of dodging it)
     if args.kv_chunk_pages is not None:
         chunk_values = [int(x) for x in
                         str(args.kv_chunk_pages).split(",") if x != ""]
@@ -733,6 +1089,71 @@ async def run_disagg(args):
         print(json.dumps(dis), file=sys.stderr)
         legs.append(dis)
 
+    shared_ab = None
+    if getattr(args, "shared_prefix", False):
+        # transfer-vs-reuse A/B (dynacache): same length distribution,
+        # but every prompt shares one page-aligned 2/3-ISL prefix. After
+        # the first transfers commit the shared blocks, decode-side
+        # reservations prefix-hit and the prefill worker skips shipping
+        # those pages — measured as transfer pages per remote prefill
+        # next to the decode engine's realized hit split.
+        import copy as _copy
+
+        import numpy as np
+
+        pw.chunk_pages = chunk_values[0]
+        ps = decode_eng.ecfg.page_size
+        pl = max((int(args.isl * 2 // 3) // ps) * ps, ps)
+        a = _copy.copy(args)
+        a.seed = args.seed + 7777
+        base = synth_requests(a, cfg.vocab_size, decode_eng.cap_tokens)
+        motif_rng = np.random.RandomState(args.seed ^ 0xD1CE)
+        motif = motif_rng.randint(1, min(cfg.vocab_size - 10, 255),
+                                  size=pl).tolist()
+        shared_reqs = []
+        for toks, osl in base:
+            if len(toks) <= pl + 8:
+                toks = toks + motif[:pl + 8 - len(toks) + 1]
+            shared_reqs.append((motif + list(toks[pl:]), osl))
+        cs0 = decode_eng.pm.cache_stats()
+        before_st = disagg.stats()
+        print("--- disagg shared-prefix leg ---", file=sys.stderr)
+        shared_leg = await measure(disagg, shared_reqs, args.concurrency)
+        st = disagg.stats()
+        cs1 = decode_eng.pm.cache_stats()
+        hit_blocks = (cs1["device_hit_blocks_total"]
+                      - cs0["device_hit_blocks_total"]
+                      + cs1["host_restored_blocks_total"]
+                      - cs0["host_restored_blocks_total"])
+        alloc_blocks = hit_blocks + (cs1["fresh_blocks_total"]
+                                     - cs0["fresh_blocks_total"])
+        shared_leg["transfer_pages"] = (
+            st["kv_transfer_pages_total"]
+            - before_st["kv_transfer_pages_total"])
+        shared_leg["remote_prefills"] = (st["remote_prefills"]
+                                         - before_st["remote_prefills"])
+        shared_leg["decode_hit_blocks"] = hit_blocks
+        shared_leg["decode_hit_block_rate"] = round(
+            hit_blocks / max(alloc_blocks, 1), 4)
+        fresh_leg = legs[0]
+        shared_ab = {
+            "fresh": {k: fresh_leg[k] for k in
+                      ("req_per_s", "ttft_p50_ms", "transfer_pages",
+                       "remote_prefills")},
+            "shared": {k: shared_leg[k] for k in
+                       ("req_per_s", "ttft_p50_ms", "transfer_pages",
+                        "remote_prefills", "decode_hit_blocks",
+                        "decode_hit_block_rate")},
+            "transfer_pages_per_remote_fresh": round(
+                fresh_leg["transfer_pages"]
+                / max(fresh_leg["remote_prefills"], 1), 2),
+            "transfer_pages_per_remote_shared": round(
+                shared_leg["transfer_pages"]
+                / max(shared_leg["remote_prefills"], 1), 2),
+        }
+        print(json.dumps({"shared_prefix_ab": shared_ab}),
+              file=sys.stderr)
+
     await pw.stop()
     await disagg.transfer.stop()
     await prefill_eng.stop()
@@ -743,6 +1164,8 @@ async def run_disagg(args):
     report = {"scenario": "disagg_vs_agg", "agg": agg, "disagg": best,
               "disagg_over_agg_req_per_s":
                   round(best["req_per_s"] / agg["req_per_s"], 3)}
+    if shared_ab is not None:
+        report["shared_prefix_ab"] = shared_ab
     if len(legs) > 1:
         report["disagg_legs"] = legs
     print(json.dumps(report), file=sys.stderr)
@@ -903,6 +1326,13 @@ def _run_scenario(args) -> dict:
         return {"metric": metric_name(args),
                 "value": report["disagg_over_agg_req_per_s"],
                 "unit": metric_unit(args), "vs_baseline": 1.0,
+                "detail": report}
+    if args.scenario == "shared":
+        report = asyncio.run(run_shared(args))
+        return {"metric": metric_name(args),
+                "value": report["prefix_hit_rate"],
+                "unit": metric_unit(args),
+                "vs_baseline": report["ttft_noshare_over_share"] or 1.0,
                 "detail": report}
     report = asyncio.run(run_bench(args))
     # vs_baseline: reference publishes no absolute numbers —

@@ -28,6 +28,7 @@ import logging
 import math
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from functools import partial
@@ -257,6 +258,13 @@ class Sequence:
     dispatch_share: float = 0.0
     dispatches: int = 0
     max_pages: int = 0
+    # dynacache prefix split: how this request's prompt pages were
+    # sourced at first admission (device reuse vs host-tier restore vs
+    # fresh compute) + how long its queued restores waited to dispatch
+    device_hit_blocks: int = 0
+    host_restored_blocks: int = 0
+    restore_t0: Optional[float] = None
+    restore_wait_s: float = 0.0
 
     def max_new(self) -> int:
         mt = self.req.stop.max_tokens
@@ -489,6 +497,13 @@ class JaxEngine:
         self.decode_tokens_total = 0
         self.prefix_hit_tokens_total = 0
         self.prompt_tokens_total = 0
+        # dynacache: windowed hit rate over the last DYN_CACHE_WINDOW
+        # admissions — the lifetime ratio above goes flat after enough
+        # traffic, so the aggregator gauge reads this recent-traffic view
+        # instead (ISSUE 11 satellite; totals stay exported alongside)
+        self._hit_window: deque = deque(
+            maxlen=max(env_int("DYN_CACHE_WINDOW") or 256, 1))
+        profiling.register_cache(f"jax-engine-{id(self):x}", self)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -738,9 +753,18 @@ class JaxEngine:
             "queue_wait_seconds_total": round(self.queue_wait_seconds_total,
                                               4),
             "gpu_cache_usage_perc": self.pm.usage(),
-            "gpu_prefix_cache_hit_rate":
+            # dynacache: the headline rate is WINDOWED (last
+            # DYN_CACHE_WINDOW admissions) so the aggregator gauge tracks
+            # recent traffic instead of flattening into the lifetime mean;
+            # the cumulative counters ride alongside for totals/rates
+            "gpu_prefix_cache_hit_rate": self._windowed_hit_rate(),
+            "gpu_prefix_cache_hit_rate_lifetime":
                 (self.prefix_hit_tokens_total /
                  max(self.prompt_tokens_total, 1)),
+            "prefix_hit_tokens_total": self.prefix_hit_tokens_total,
+            "prompt_tokens_total": self.prompt_tokens_total,
+            **{f"cache_{k}": v
+               for k, v in self.pm.cache_stats().items()},
             "host_cache_usage_perc": self.pm.host_usage(),
             "host_offload_pages_total": self.offload_pages_total,
             "host_restore_pages_total": self.restore_pages_total,
@@ -763,6 +787,48 @@ class JaxEngine:
                 (self.spec_accepted_tokens_total /
                  max(self.spec_steps, 1)),
         }
+
+    def _windowed_hit_rate(self) -> float:
+        """Prefix-hit tokens / prompt tokens over the admission window
+        (0.0 while empty). One pass over a bounded deque — cheap enough
+        for every stats scrape."""
+        hit = total = 0
+        for h, p in self._hit_window:
+            hit += h
+            total += p
+        return hit / total if total else 0.0
+
+    def cache_snapshot(self) -> dict:
+        """dynacache /debug/cache view: pool + host-tier occupancy, the
+        allocation/eviction/restore counters, windowed vs lifetime hit
+        rate, and the bounded top-K hot prefix chains."""
+        topk = max(env_int("DYN_CACHE_TOPK") or 20, 0)
+        with self._pm_lock:
+            pm = self.pm
+            snap = {
+                "pool": {
+                    "total_blocks": self.ecfg.num_pages - 1,
+                    "active_blocks": pm.active,
+                    "cached_blocks": len(pm.reusable),
+                    "free_blocks": len(pm.free),
+                    "usage": round(pm.usage(), 4),
+                },
+                "host_tier": {
+                    "total_blocks": pm.host_pages,
+                    "used_blocks": len(pm.host_by_hash),
+                    "free_blocks": len(pm.host_free),
+                    "usage": round(pm.host_usage(), 4),
+                },
+                "hit_rate_windowed": round(self._windowed_hit_rate(), 4),
+                "hit_rate_lifetime": round(
+                    self.prefix_hit_tokens_total
+                    / max(self.prompt_tokens_total, 1), 4),
+                "prefix_hit_tokens_total": self.prefix_hit_tokens_total,
+                "prompt_tokens_total": self.prompt_tokens_total,
+                **pm.cache_stats(),
+                "top_prefixes": pm.top_prefixes(topk),
+            }
+        return snap
 
     # ------------------------------------------------------- scheduler loop
 
@@ -938,11 +1004,17 @@ class JaxEngine:
             pages, cached_tokens = alloc
             seq.pages = pages
             seq.computed = min(cached_tokens, seq.prefill_extent)
+            if alloc.restores:
+                # restore_wait stops when the sequence clears the
+                # _unrestored_pages gate in _dispatch_prefill
+                seq.restore_t0 = time.monotonic()
             if seq.generated == 0:  # don't double-count resumed sequences
                 wait = time.monotonic() - seq.arrival
                 self.queue_wait_seconds_total += wait
                 seq.queue_wait_s = wait
                 seq.prefix_hit = seq.computed
+                seq.device_hit_blocks = alloc.device_hit_blocks
+                seq.host_restored_blocks = alloc.host_restored_blocks
                 self.step_timeline.add(
                     "admit", queue_wait_ms=round(wait * 1000.0, 3),
                     request_id=seq.context.id,
@@ -950,6 +1022,7 @@ class JaxEngine:
                     waiting=len(self.waiting))
                 self.prefix_hit_tokens_total += seq.computed
                 self.prompt_tokens_total += seq.num_prompt
+                self._hit_window.append((seq.computed, seq.num_prompt))
             self.prefilling.append(seq)
 
     # ------------------------------------------------------- KV tier drain
@@ -1027,6 +1100,7 @@ class JaxEngine:
             self._offload_inflight = keep
             self._land_inflight_offloads(harvest)
         if res:
+            rt0 = time.perf_counter()
             pages = [p for p, _ in res]
             slots = [s for _, s in res]
             # pad targets out-of-range → dropped by the scatter; pad the
@@ -1050,6 +1124,19 @@ class JaxEngine:
             self.kv_k = _inject_pages(self.kv_k, iidx, k_rows)
             self.kv_v = _inject_pages(self.kv_v, iidx, v_rows)
             self.restore_pages_total += len(res)
+            # dynacache: restore drain visibility — a step-timeline event
+            # and a dyntrace span per drained batch (dispatch time only;
+            # no sync added — the copies land with the next device step).
+            # Both are no-ops when their ring/sampling is off.
+            rdt = time.perf_counter() - rt0
+            self.step_timeline.add(
+                "cache.restore", pages=len(res),
+                queued=len(self._unrestored_pages),
+                dispatch_ms=round(rdt * 1000.0, 3))
+            tracing.get_tracer().record_span(
+                "cache.restore", rdt, parent=None,
+                attributes={"pages": len(res),
+                            "queued": len(self._unrestored_pages)})
 
     # ------------------------------------------------------------- prefill
 
@@ -1074,6 +1161,11 @@ class JaxEngine:
                 # stale KV. It waits; the drain clears a chunk per
                 # iteration
                 continue
+            if seq.restore_t0 is not None:
+                # dynacache: the sequence's host-tier restores have all
+                # dispatched — admission→here is its restore wait
+                seq.restore_wait_s = time.monotonic() - seq.restore_t0
+                seq.restore_t0 = None
             if seq.prefill_extent - seq.computed <= 0:
                 # resumed sequence fully covered by the prefix cache
                 self.prefilling.remove(seq)
@@ -1885,12 +1977,23 @@ class JaxEngine:
         occupancy-weighted step share by the sampled mean device time
         per dispatch (None until something has been sampled)."""
         est = self.profiler.mean_device_ms_per_step()
+        ps = self.ecfg.page_size
+        prompt_blocks = (seq.num_prompt + ps - 1) // ps
         return {
             "queue_wait_ms": round(seq.queue_wait_s * 1000.0, 3),
             "device_step_share": round(seq.dispatch_share, 6),
             "dispatches": seq.dispatches,
             "prompt_tokens": seq.num_prompt,
             "prefix_hit_tokens": seq.prefix_hit,
+            # dynacache prefix split: device_hit + host_restored + the
+            # implied fresh remainder sum to prompt_blocks (conservation,
+            # pinned by tests/test_cache_obs.py). router_overlap_blocks
+            # is merged in by the frontend's KvRouter when the finish
+            # cost block passes its attribution listener.
+            "prompt_blocks": prompt_blocks,
+            "device_hit_blocks": seq.device_hit_blocks,
+            "host_restored_blocks": seq.host_restored_blocks,
+            "restore_wait_ms": round(seq.restore_wait_s * 1000.0, 3),
             "decode_tokens": seq.generated,
             "kv_pages_peak": seq.max_pages,
             "kv_bytes_peak": seq.max_pages * self._page_bytes,
@@ -2139,6 +2242,10 @@ class JaxEngine:
 
         def _do():
             self.prompt_tokens_total += seq.num_prompt
+            # decode-side hits were claimed by reserve_remote, not here;
+            # window the admission with the same zero-hit accounting the
+            # lifetime counters use for this path
+            self._hit_window.append((0, seq.num_prompt))
             with self._pm_lock:
                 self._commit_full_pages(seq)  # prefix-cache publish + events
                 self._append_token(seq, int(first_token))

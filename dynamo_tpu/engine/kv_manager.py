@@ -31,6 +31,7 @@ page copies before its next device step (jax_engine._drain_kv_tier).
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -79,6 +80,9 @@ class KvEvent:
 class PageState:
     refcount: int = 0
     block_hash: Optional[int] = None  # set when committed (full + hashed)
+    # dynacache: when this page's block entered the device tier (commit
+    # or host-tier restore) — eviction age = now - committed_at
+    committed_at: float = 0.0
 
 
 @dataclass
@@ -90,6 +94,12 @@ class Alloc:
     pages: List[int]
     cached_tokens: int
     restores: List[Tuple[int, int]] = field(default_factory=list)
+    # dynacache prefix split: how the allocated pages were sourced.
+    # device_hit + host_restored + fresh == len(pages) (conservation —
+    # pinned by tests/test_cache_obs.py)
+    device_hit_blocks: int = 0
+    host_restored_blocks: int = 0
+    fresh_blocks: int = 0
 
     def __iter__(self):
         return iter((self.pages, self.cached_tokens))
@@ -128,6 +138,28 @@ class PageManager:
         # by the same call must not reassign them (they reach
         # pending_restore only when the call completes)
         self._pinned_slots: set = set()
+        # ---- dynacache telemetry (host-side counters; same loop/lock
+        # discipline as the pool structures above) ----
+        # allocation prefix split (blocks == pages)
+        self.device_hit_blocks_total = 0  # guarded-by: loop
+        self.host_restored_blocks_total = 0  # guarded-by: loop
+        self.fresh_blocks_total = 0  # guarded-by: loop
+        # HBM evictions by fate: offloaded-to-host vs dropped entirely,
+        # plus block age (commit→eviction) and host-tier evictions
+        self.evict_offloaded_total = 0  # guarded-by: loop
+        self.evict_dropped_total = 0  # guarded-by: loop
+        self.evict_age_seconds_total = 0.0  # guarded-by: loop
+        self.host_evictions_total = 0  # guarded-by: loop
+        # restore-queue drain latency: enqueue stamp per queued restore
+        # page; drained totals accumulated in drain_tier_ops
+        self._restore_enq: Dict[int, float] = {}  # guarded-by: loop
+        self.restores_drained_total = 0  # guarded-by: loop
+        self.restore_wait_seconds_total = 0.0  # guarded-by: loop
+        # hot prefix chains: per-block-hash hit counter, bounded — hashes
+        # past the cap are simply untracked (top-K reporting only needs
+        # the hot head, and an unbounded dict would grow with the corpus)
+        self._hit_counts: Dict[int, int] = {}  # guarded-by: loop
+        self._hit_track_cap = 1024
 
     # ------------------------------------------------------------- queries
 
@@ -227,6 +259,7 @@ class PageManager:
                 # (the engine drains the copy before its next device step);
                 # no "stored" event — the block never left this worker
                 self.pages[fresh].block_hash = h
+                self.pages[fresh].committed_at = time.monotonic()
                 self.by_hash[h] = fresh
                 self.host_lru.move_to_end(slot)
                 restores.append((fresh, slot))
@@ -235,8 +268,27 @@ class PageManager:
                 claimed.append(self._pop_fresh())
         finally:
             self._pinned_slots -= pinned
+        now = time.monotonic()
+        for page, _ in restores:
+            self._restore_enq[page] = now
         self.pending_restore.extend(restores)
-        return Alloc(claimed, len(plan) * self.page_size, restores)
+        # dynacache: prefix split + hot-chain hit counts for the blocks
+        # actually reused (plan may have been truncated above)
+        device_hit = sum(1 for p, _, _ in plan if p is not None)
+        host_restored = len(restores)
+        fresh_blocks = len(claimed) - device_hit - host_restored
+        self.device_hit_blocks_total += device_hit
+        self.host_restored_blocks_total += host_restored
+        self.fresh_blocks_total += fresh_blocks
+        for _, _, h in plan:
+            if h in self._hit_counts:
+                self._hit_counts[h] += 1
+            elif len(self._hit_counts) < self._hit_track_cap:
+                self._hit_counts[h] = 1
+        return Alloc(claimed, len(plan) * self.page_size, restores,
+                     device_hit_blocks=device_hit,
+                     host_restored_blocks=host_restored,
+                     fresh_blocks=fresh_blocks)
 
     def allocate_page(self) -> Optional[int]:
         """One more page for a growing sequence (decode)."""
@@ -266,6 +318,7 @@ class PageManager:
             # another page already holds this block; keep the existing one
             return
         st.block_hash = block_hash
+        st.committed_at = time.monotonic()
         self.by_hash[block_hash] = page
         self.events.append(KvEvent("stored", [block_hash],
                                    parent_hash=parent_hash,
@@ -321,6 +374,9 @@ class PageManager:
                 h = st.block_hash
                 del self.by_hash[h]
                 st.block_hash = None
+                if st.committed_at:
+                    self.evict_age_seconds_total += max(
+                        time.monotonic() - st.committed_at, 0.0)
                 slot = None
                 if self.host_pages > 0:
                     if h in self.host_by_hash:
@@ -335,13 +391,17 @@ class PageManager:
                             self.host_lru[slot] = h
                             self.pending_offload.append((page, slot))
                 if slot is None:
+                    self.evict_dropped_total += 1
                     self.events.append(KvEvent("removed", [h]))
+                else:
+                    self.evict_offloaded_total += 1
         # the page may carry a stale queued restore (its sequence released
         # before any device step drained it) — a late copy would clobber
         # the new owner's content
         if self.pending_restore:
             self.pending_restore = [(p, s) for p, s in self.pending_restore
                                     if p != page]
+            self._restore_enq.pop(page, None)
         st = self.pages[page]
         assert st.refcount == 0
         st.refcount = 1
@@ -362,6 +422,7 @@ class PageManager:
             if slot not in busy:
                 old_h = self.host_lru.pop(slot)
                 del self.host_by_hash[old_h]
+                self.host_evictions_total += 1
                 if old_h not in self.by_hash:
                     self.events.append(KvEvent("removed", [old_h]))
                 return slot
@@ -385,11 +446,51 @@ class PageManager:
         else:
             res = self.pending_restore[:restore_limit]
             self.pending_restore = self.pending_restore[restore_limit:]
+        if res:
+            # restore drain latency: enqueue → this pop (the dispatch point)
+            now = time.monotonic()
+            for page, _ in res:
+                ts = self._restore_enq.pop(page, None)
+                if ts is not None:
+                    self.restore_wait_seconds_total += max(now - ts, 0.0)
+            self.restores_drained_total += len(res)
         return off, res
 
     def host_usage(self) -> float:
         return len(self.host_by_hash) / self.host_pages if self.host_pages \
             else 0.0
+
+    # ------------------------------------------------- dynacache telemetry
+
+    def top_prefixes(self, k: int) -> List[dict]:
+        """The K hottest cached block hashes by reuse count (bounded by
+        the tracking cap), with residency so a dashboard can tell a hot
+        chain that is still serving hits from one that was evicted."""
+        hot = sorted(self._hit_counts.items(),
+                     key=lambda kv: (-kv[1], kv[0]))[:max(k, 0)]
+        return [{"block_hash": f"{h:016x}", "hits": n,
+                 "tier": ("device" if h in self.by_hash
+                          else "host" if h in self.host_by_hash
+                          else "evicted")}
+                for h, n in hot]
+
+    def cache_stats(self) -> dict:
+        """One flat dict of the dynacache counters (engine stats() embeds
+        these under ``cache_*`` keys; /debug/cache renders them nested)."""
+        return {
+            "device_hit_blocks_total": self.device_hit_blocks_total,
+            "host_restored_blocks_total": self.host_restored_blocks_total,
+            "fresh_blocks_total": self.fresh_blocks_total,
+            "evict_offloaded_total": self.evict_offloaded_total,
+            "evict_dropped_total": self.evict_dropped_total,
+            "evict_age_seconds_total": round(self.evict_age_seconds_total,
+                                             4),
+            "host_evictions_total": self.host_evictions_total,
+            "restore_queue_depth": len(self.pending_restore),
+            "restores_drained_total": self.restores_drained_total,
+            "restore_wait_seconds_total": round(
+                self.restore_wait_seconds_total, 4),
+        }
 
     def drain_events(self) -> List[KvEvent]:
         out, self.events = self.events, []

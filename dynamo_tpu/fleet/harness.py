@@ -76,6 +76,9 @@ class FleetSim:
         self._enqueued: Dict[str, asyncio.Event] = {
             r.rid: asyncio.Event() for r in self.trace.requests}
         self._client_tasks: List[asyncio.Task] = []
+        # dynacache: run-long per-worker (hit_tokens, prompt_tokens) view
+        # folded from every scrape (survives drained workers)
+        self._cache_seen: Dict[int, tuple] = {}
         self._discovery_timeout = env_float(
             "DYN_FLEET_DISCOVERY_TIMEOUT") or 10.0
         # wired in setup()
@@ -250,6 +253,14 @@ class FleetSim:
             await self.agg.scrape_once()
         except Exception:
             log.exception("aggregator scrape failed")
+        # dynacache: fold each scrape's per-worker hit/prompt totals into
+        # a run-long view — a drained worker's counters leave the
+        # aggregator with it, but its realized hits still happened (the
+        # hot-tenant worker is often the one newest-first scale-down
+        # retires). Counters are per-worker monotonic, so overwrite.
+        for wid, m in self.agg.worker_metrics.items():
+            self._cache_seen[wid] = (m.prefix_hit_tokens_total,
+                                     m.prompt_tokens_total)
         try:
             await self.router.scrape_once()
         except Exception:
@@ -402,6 +413,10 @@ class FleetSim:
             # fleet scenarios regression-gate scheduler overhead next to
             # the SLO verdicts (virtual-state values only: deterministic)
             "engine_gauges": self._engine_gauges(),
+            # dynacache plane: the router's PREDICTED overlap hit rate
+            # next to the workers' REALIZED (engine-side) hit rate, so
+            # scenarios like hot-tenant can assert both views agree
+            "cache": self._cache_block(),
         }
         if self.k8s is not None:
             extra["k8s_dry_run"] = {
@@ -433,6 +448,22 @@ class FleetSim:
                 (m.loop_lag_p99_seconds for m in wm), default=0.0),
             "queue_wait_seconds_total": round(
                 sum(m.queue_wait_seconds_total for m in wm), 6),
+        }
+
+    def _cache_block(self) -> dict:
+        """Predicted (router overlap scoring) vs realized (worker-side
+        stored-chain replay) hit rates, folded over every scrape of the
+        run so drained workers' totals still count — sorted per-worker
+        rows keep the JSON byte-stable across runs."""
+        rows = sorted(self._cache_seen.items())
+        hits = sum(h for _, (h, _p) in rows)
+        prompts = sum(p for _, (_h, p) in rows)
+        rstats = self.router.stats()
+        return {
+            "router_predicted_hit_rate": rstats["avg_hit_rate"],
+            "engine_realized_hit_rate": hits / max(prompts, 1),
+            "per_worker_realized": [h / max(p, 1)
+                                    for _, (h, p) in rows],
         }
 
     async def teardown(self) -> None:

@@ -103,6 +103,13 @@ class SimEngineModel:
         self.blackout = False
         self.served_total = 0
         self._stored_blocks: int = 0   # modeled resident cache blocks
+        # dynacache: modeled engine-side prefix cache — the set of block
+        # hashes this worker has stored; a new prompt's REALIZED hit is
+        # its longest leading chain already present. Virtual-state only,
+        # so seeded reports stay byte-identical.
+        self._stored_hashes: set = set()
+        self.realized_hit_blocks: int = 0
+        self.prompt_blocks_total: int = 0
 
     # ------------------------------------------------------------ intake
 
@@ -141,6 +148,18 @@ class SimEngineModel:
             if self.profile.publish_kv_events and req.token_ids:
                 hashes = chain_hashes(req.token_ids, self.block_size)
                 if hashes:
+                    # realized engine-side hit: the longest leading chain
+                    # already stored on THIS worker (the router's overlap
+                    # prediction is scored against this in the report's
+                    # cache block)
+                    hit = 0
+                    for h in hashes:
+                        if h not in self._stored_hashes:
+                            break
+                        hit += 1
+                    self.realized_hit_blocks += hit
+                    self.prompt_blocks_total += len(hashes)
+                    self._stored_hashes.update(hashes)
                     kv_events.append((hashes, None))
                     self._stored_blocks = min(
                         self._stored_blocks + len(hashes),
@@ -197,6 +216,20 @@ class SimEngineModel:
             kv_total_blocks=p.kv_total_blocks,
             num_requests_waiting=len(self.queue),
             gpu_cache_usage_perc=blocks / max(p.kv_total_blocks, 1),
+            # dynacache: realized (engine-side) hit rate from the modeled
+            # stored-chain set — reported next to the router's predicted
+            # avg_hit_rate in the fleet report's cache block
+            gpu_prefix_cache_hit_rate=(
+                self.realized_hit_blocks
+                / max(self.prompt_blocks_total, 1)),
+            gpu_prefix_cache_hit_rate_lifetime=(
+                self.realized_hit_blocks
+                / max(self.prompt_blocks_total, 1)),
+            prefix_hit_tokens_total=(self.realized_hit_blocks
+                                     * self.block_size),
+            prompt_tokens_total=(self.prompt_blocks_total
+                                 * self.block_size),
+            cache_device_hit_blocks_total=self.realized_hit_blocks,
             # dynaprof gauges, modeled from virtual state only (so seeded
             # reports stay byte-identical): slot utilization stands in
             # for the sampled device fraction; free pages from the block

@@ -74,6 +74,7 @@ class HttpService:
             web.get("/v1/models", self._models),
             web.get("/v1/traces", self._traces),
             web.get("/v1/traces/{request_id}", self._trace_one),
+            web.get("/debug/cache", self._debug_cache),
             web.get("/debug/profile", self._debug_profile),
             web.get("/debug/profile/stacks", self._debug_stacks),
             web.post("/debug/profile/start", self._profile_start),
@@ -158,6 +159,13 @@ class HttpService:
         return web.json_response(data, headers={"X-Request-Id": rid})
 
     # ------------------------------------------------- dynaprof debug hooks
+
+    async def _debug_cache(self, request: web.Request) -> web.Response:
+        """dynacache snapshot: every registered cache view in the process
+        — per-engine pool/host-tier occupancy, windowed hit rate, hot
+        prefix chains, restore queue — plus the KV router's calibration
+        counters when a router runs here."""
+        return web.json_response({"caches": profiling.caches_snapshot()})
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """One-stop profiling snapshot: loop lag + stall-watchdog stats,

@@ -24,7 +24,25 @@ class ForwardPassMetrics:
     kv_total_blocks: int = 0
     num_requests_waiting: int = 0
     gpu_cache_usage_perc: float = 0.0
+    # dynacache: the headline hit rate is WINDOWED (recent admissions);
+    # the lifetime ratio and the raw token totals ride alongside
     gpu_prefix_cache_hit_rate: float = 0.0
+    gpu_prefix_cache_hit_rate_lifetime: float = 0.0
+    prefix_hit_tokens_total: int = 0
+    prompt_tokens_total: int = 0
+    # dynacache lifecycle counters (engine PageManager.cache_stats()):
+    # allocation prefix split, eviction fates + block age, host-tier
+    # evictions, restore-queue depth and drain latency
+    cache_device_hit_blocks_total: int = 0
+    cache_host_restored_blocks_total: int = 0
+    cache_fresh_blocks_total: int = 0
+    cache_evict_offloaded_total: int = 0
+    cache_evict_dropped_total: int = 0
+    cache_evict_age_seconds_total: float = 0.0
+    cache_host_evictions_total: int = 0
+    cache_restore_queue_depth: int = 0
+    cache_restores_drained_total: int = 0
+    cache_restore_wait_seconds_total: float = 0.0
     # self-speculative decoding observability (engine/spec_decode.py):
     # accepted/drafted tokens, and accepted drafts per verify step
     spec_decode_acceptance_rate: float = 0.0
@@ -70,6 +88,22 @@ class ForwardPassMetrics:
     def from_dict(cls, d: dict) -> "ForwardPassMetrics":
         known = {f for f in cls.__dataclass_fields__}  # type: ignore[attr-defined]
         return cls(**{k: v for k, v in d.items() if k in known})
+
+
+# Engine ``stats()`` keys that deliberately do NOT ride ForwardPassMetrics
+# into a Prometheus gauge, with the reason. The dynacache sync-gate test
+# (tests/test_cache_obs.py) asserts every numeric stats() key is either an
+# FPM field (and rendered by the aggregator) or listed here — so a new
+# stats counter can never silently stop at the stats plane again (the
+# drift class PR 10 found by hand).
+STATS_PROMETHEUS_SKIP = {
+    "spec_decode_steps":
+        "raw counter folded into spec_decode_mean_accepted_len",
+    "spec_decode_draft_tokens_total":
+        "raw counter folded into spec_decode_acceptance_rate",
+    "spec_decode_accepted_tokens_total":
+        "raw counter folded into spec_decode_acceptance_rate",
+}
 
 
 @dataclass

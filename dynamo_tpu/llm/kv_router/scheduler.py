@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import random
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -67,13 +68,19 @@ class KvScheduler:
     # tie-breaking entropy: injectable so deterministic drivers (the fleet
     # simulator) can seed routing; default keeps process-level randomness
     rng: random.Random = field(default_factory=random.Random)
+    # dynacache calibration feed: the last N routing decisions with every
+    # candidate's (capped) overlap score and the chosen worker, so the
+    # router can compare its prediction against the engine's realized
+    # prefix hit when the finish cost block comes back
+    decisions: deque = field(default_factory=lambda: deque(maxlen=256))
 
     def update_metrics(self, metrics: Dict[int, ForwardPassMetrics]) -> None:
         """Replace worker snapshots (periodic scrape) and reset the
         optimistic deltas (reference ProcessedEndpoints refresh)."""
         self.workers = {wid: WorkerState(m) for wid, m in metrics.items()}
 
-    def schedule(self, num_tokens: int, overlaps: OverlapScores) -> int:
+    def schedule(self, num_tokens: int, overlaps: OverlapScores,
+                 request_id: Optional[str] = None) -> int:
         """Pick a worker for a request of ``num_tokens`` prompt tokens.
         Raises RuntimeError when no worker is available."""
         if not self.workers:
@@ -101,6 +108,17 @@ class KvScheduler:
         if not best:
             raise RuntimeError("all workers saturated")
         chosen = self.rng.choice(best)
+        # per-decision record: every live candidate's capped overlap plus
+        # the pick (bounded ring; feeds predicted-vs-realized calibration)
+        self.decisions.append({
+            "request_id": request_id,
+            "chosen": chosen,
+            "isl_blocks": isl_blocks,
+            "overlap_blocks": min(overlaps.scores.get(chosen, 0),
+                                  isl_blocks),
+            "candidates": {wid: min(overlaps.scores.get(wid, 0), isl_blocks)
+                           for wid in self.workers},
+        })
         # optimistic accounting until the next scrape
         w = self.workers[chosen]
         w.extra_requests += 1

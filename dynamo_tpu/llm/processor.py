@@ -89,10 +89,14 @@ class Processor:
         self.router = router
         self.preprocessor = OpenAIPreprocessor(mdc)
 
-    async def _route(self, pre: PreprocessedRequest) -> Optional[int]:
+    async def _route(self, pre: PreprocessedRequest,
+                     context: Context) -> Optional[int]:
         if self.router is None:
             return None
-        worker_id = await self.router.schedule(pre.token_ids)
+        # the request id keys the router's predicted-vs-realized
+        # calibration entry (matched when the finish cost block returns)
+        worker_id = await self.router.schedule(pre.token_ids,
+                                               request_id=context.id)
         return worker_id
 
     def chat(self, request: ChatCompletionRequest,
@@ -103,7 +107,7 @@ class Processor:
         pre, annotations = self.preprocessor.preprocess_chat(request)
         for ann in annotations:
             yield ann
-        worker_id = await self._route(pre)
+        worker_id = await self._route(pre, context)
         engine = _RemoteTokenEngine(self.client, worker_id)
         backend = Backend(engine, self.preprocessor.tokenizer)
         async for chunk in self.preprocessor.chat_stream(
@@ -119,7 +123,7 @@ class Processor:
         pre, annotations = self.preprocessor.preprocess_completion(request)
         for ann in annotations:
             yield ann
-        worker_id = await self._route(pre)
+        worker_id = await self._route(pre, context)
         engine = _RemoteTokenEngine(self.client, worker_id)
         backend = Backend(engine, self.preprocessor.tokenizer)
         rid = f"cmpl-{context.id or uuid.uuid4().hex}"

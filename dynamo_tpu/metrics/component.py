@@ -139,8 +139,54 @@ class MetricsAggregator:
              lambda m: m.num_requests_waiting),
             ("dyn_worker_cache_usage_perc", "KV cache usage fraction",
              lambda m: m.gpu_cache_usage_perc),
-            ("dyn_worker_prefix_cache_hit_rate", "engine prefix hit rate",
+            ("dyn_worker_prefix_cache_hit_rate",
+             "engine prefix hit rate (windowed over recent admissions)",
              lambda m: m.gpu_prefix_cache_hit_rate),
+            # dynacache: cache-lifecycle plane (allocation prefix split,
+            # eviction fates + block age, restore queue) — every counter
+            # the engine's PageManager keeps, per worker
+            ("dyn_engine_cache_hit_rate_lifetime",
+             "engine prefix hit rate since start (cumulative)",
+             lambda m: m.gpu_prefix_cache_hit_rate_lifetime),
+            ("dyn_engine_cache_prefix_hit_tokens_total",
+             "prompt tokens served from the prefix cache",
+             lambda m: m.prefix_hit_tokens_total),
+            ("dyn_engine_cache_prompt_tokens_total",
+             "prompt tokens admitted", lambda m: m.prompt_tokens_total),
+            ("dyn_engine_cache_device_hit_blocks_total",
+             "allocated blocks reused directly from the HBM pool",
+             lambda m: m.cache_device_hit_blocks_total),
+            ("dyn_engine_cache_host_restored_blocks_total",
+             "allocated blocks restored from the host-DRAM tier",
+             lambda m: m.cache_host_restored_blocks_total),
+            ("dyn_engine_cache_fresh_blocks_total",
+             "allocated blocks computed fresh (no cache source)",
+             lambda m: m.cache_fresh_blocks_total),
+            ("dyn_engine_cache_evict_offloaded_total",
+             "HBM evictions that spilled to the host tier",
+             lambda m: m.cache_evict_offloaded_total),
+            ("dyn_engine_cache_evict_dropped_total",
+             "HBM evictions dropped entirely (no host slot)",
+             lambda m: m.cache_evict_dropped_total),
+            ("dyn_engine_cache_evict_age_seconds_total",
+             "summed block age (commit to eviction) of evicted blocks",
+             lambda m: m.cache_evict_age_seconds_total),
+            ("dyn_engine_cache_host_evictions_total",
+             "host-tier blocks evicted to make room",
+             lambda m: m.cache_host_evictions_total),
+            ("dyn_engine_cache_restore_queue_depth",
+             "host->HBM restores queued but not yet dispatched",
+             lambda m: m.cache_restore_queue_depth),
+            ("dyn_engine_cache_restores_drained_total",
+             "host->HBM restores dispatched",
+             lambda m: m.cache_restores_drained_total),
+            ("dyn_engine_cache_restore_wait_seconds_total",
+             "summed queue wait of dispatched restores",
+             lambda m: m.cache_restore_wait_seconds_total),
+            ("dyn_engine_batch_dispatches_total",
+             "dispatches that distributed a per-request step share "
+             "(dynaprof attribution conservation denominator)",
+             lambda m: m.batch_dispatches_total),
             ("dyn_worker_spec_decode_acceptance_rate",
              "speculative-draft tokens accepted / drafted",
              lambda m: m.spec_decode_acceptance_rate),

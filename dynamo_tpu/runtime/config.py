@@ -144,6 +144,17 @@ register_env("DYN_REDISPATCH_MAX", "2", "llm/disagg",
              "re-enqueues after a fast transfer-plane failure, e.g. a "
              "prefill worker dying mid-transfer). 1 disables hedging.")
 
+register_env("DYN_CACHE_TOPK", "20", "engine",
+             "dynacache: hot prefix chains reported per engine in "
+             "GET /debug/cache (top-K cached block hashes by reuse "
+             "count; internal tracking stays bounded regardless).")
+register_env("DYN_CACHE_WINDOW", "256", "engine",
+             "dynacache: admissions in the windowed prefix-hit-rate "
+             "window. stats()['gpu_prefix_cache_hit_rate'] (and the "
+             "dyn_worker_prefix_cache_hit_rate gauge) reflect the last "
+             "N admissions; the lifetime ratio and raw token totals are "
+             "exported alongside.")
+
 register_env("DYN_JIT_FENCE", None, "engine",
              "Runtime compile fence: reaction to an XLA compile AFTER "
              "JaxEngine.warmup() (the zero-compile serving invariant). "

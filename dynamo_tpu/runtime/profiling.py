@@ -31,14 +31,17 @@ Overhead budget and knobs: docs/profiling.md.
 from __future__ import annotations
 
 import asyncio
+import logging
 import sys
 import threading
 import time
 import weakref
 from collections import OrderedDict, deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .config import env_float, env_int
+
+log = logging.getLogger("dynamo_tpu.profiling")
 
 # --------------------------------------------------------- loop lag monitor
 
@@ -334,6 +337,26 @@ def _attr_cap() -> int:
     return max(env_int("DYN_PROF_ATTR_RING") or 2048, 1)
 
 
+# attribution listeners: called on EVERY record (engine-side finish AND
+# the Backend's re-register of a remote cost block) with (request_id,
+# cost). Called OUTSIDE the ring lock, and a listener MAY mutate the cost
+# dict in place — that is how the KvRouter merges router_overlap_blocks
+# into the same dict /v1/traces serves (dynacache calibration).
+_attr_listeners: List[Callable[[str, dict], None]] = []
+
+
+def add_attribution_listener(fn: Callable[[str, dict], None]) -> None:
+    if fn not in _attr_listeners:
+        _attr_listeners.append(fn)
+
+
+def remove_attribution_listener(fn: Callable[[str, dict], None]) -> None:
+    try:
+        _attr_listeners.remove(fn)
+    except ValueError:
+        pass
+
+
 def record_attribution(request_id: Optional[str], cost: dict) -> None:
     """Record one finished request's cost-attribution dict (bounded ring,
     newest wins). Called by the engine at finish and by the Backend when
@@ -348,6 +371,11 @@ def record_attribution(request_id: Optional[str], cost: dict) -> None:
         _attributions.move_to_end(request_id)
         while len(_attributions) > cap:
             _attributions.popitem(last=False)
+    for fn in list(_attr_listeners):
+        try:
+            fn(request_id, cost)
+        except Exception:  # noqa: BLE001 — observability must not break serving
+            log.exception("attribution listener failed")
 
 
 def request_attribution(request_id: str) -> Optional[dict]:
@@ -384,4 +412,35 @@ def profiles_snapshot() -> Dict[str, dict]:
                 del _profiles[name]
             else:
                 out[name] = p.summary()
+    return out
+
+
+# ----------------------------------------------------- cache-view registry
+# dynacache: anything with a ``cache_snapshot()`` (the JaxEngine's
+# pool/host-tier/hot-prefix view) registers here so GET /debug/cache can
+# render every live cache in the process — same weakref hygiene as the
+# engine-profile registry above.
+
+_caches: Dict[str, "weakref.ref"] = {}
+_caches_lock = threading.Lock()
+
+
+def register_cache(name: str, owner: Any) -> None:
+    with _caches_lock:
+        _caches[name] = weakref.ref(owner)
+
+
+def caches_snapshot() -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    with _caches_lock:
+        for name, ref in list(_caches.items()):
+            c = ref()
+            if c is None:
+                del _caches[name]
+            else:
+                try:
+                    out[name] = c.cache_snapshot()
+                except Exception:  # noqa: BLE001 — a dying engine must not 500 the debug page
+                    log.debug("cache snapshot for %s failed", name,
+                              exc_info=True)
     return out

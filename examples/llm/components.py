@@ -208,7 +208,10 @@ class _RouterEdge:
         self.handle = handle
         self._last = {}
 
-    async def schedule(self, token_ids):
+    async def schedule(self, token_ids, request_id=None):
+        # request_id keys the in-process KvRouter's calibration entries;
+        # the remote Router service runs its own KvRouter, so the edge
+        # just accepts and drops it (no cost block flows back this hop)
         stream = await self.handle.round_robin({"token_ids": list(token_ids)})
         async for env in stream:
             if env.data is not None:

@@ -150,8 +150,12 @@ def test_scenario_sweep(run_async, name):
     assert report["requests"]["completed"] > 0
     assert report["slo"]["met"], report["phases"]
     if name == "hot-tenant":
-        # shared-prefix traffic must register overlap in the router
+        # shared-prefix traffic must register overlap in BOTH views:
+        # the router's predicted overlap AND the workers' realized
+        # (engine-side) stored-chain replay (dynacache)
         assert report["router"]["avg_hit_rate"] > 0.3
+        assert report["cache"]["router_predicted_hit_rate"] > 0.3
+        assert report["cache"]["engine_realized_hit_rate"] > 0.3
     if name == "blackout":
         # zero-observed advisories are published but never actuated
         ignored = [a for a in report["actuations"]
