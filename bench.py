@@ -64,6 +64,13 @@ def metric_name(args) -> str:
               if getattr(args, "shared_prefix", False) else "")
         return (f"disagg/agg req/s ratio (1-chip time-shared, threshold "
                 f"{args.disagg_threshold}{x8}{ch}{sp})")
+    if args.scenario == "sharded":
+        smoke = "cpu smoke" if getattr(args, "cpu", False) else "chip"
+        return (f"output tokens/s, {args.dp_replicas}x mesh-sharded "
+                f"replicas ({getattr(args, 'mesh', None) or 'model=2'}) "
+                f"behind the KV router vs one unsharded engine, identical "
+                f"workload (ISL~{args.isl}/OSL {args.osl}, "
+                f"{args.requests} reqs, {_model_tag(args)} llama, {smoke})")
     if args.scenario == "shared":
         smoke = "cpu smoke" if getattr(args, "cpu", False) else "1 chip"
         return (f"prefix-cache hit rate, shared-prefix workloads "
@@ -84,7 +91,8 @@ def metric_unit(args) -> str:
     if getattr(args, "spec", False) or getattr(args, "sweep", None):
         return "tok/s"
     return {"multiturn": "ms", "disagg": "ratio",
-            "shared": "rate"}.get(args.scenario, "tok/s")
+            "shared": "rate", "sharded": "tok/s"}.get(args.scenario,
+                                                      "tok/s")
 
 
 def emit_unavailable(args, reason: str) -> None:
@@ -181,7 +189,8 @@ def parse_args():
     ap.add_argument("--decode-steps", type=int, default=16,
                     help="fused decode window (amortizes dispatch latency)")
     ap.add_argument("--scenario", default="sharegpt",
-                    choices=["sharegpt", "multiturn", "disagg", "shared"],
+                    choices=["sharegpt", "multiturn", "disagg", "shared",
+                             "sharded"],
                     help="multiturn = conversations with growing shared "
                          "prefixes (the KV-offload TTFT scenario, "
                          "reference docs/architecture.md:91-96); "
@@ -191,7 +200,20 @@ def parse_args():
                          "shared = dynacache shared-prefix workloads "
                          "driven through the REAL HTTP->KV-router->engine "
                          "stack, share vs no-share A/B per shape with the "
-                         "router/engine/host-tier attribution breakdown")
+                         "router/engine/host-tier attribution breakdown; "
+                         "sharded = dynashard A/B: an unsharded single "
+                         "engine vs --dp-replicas mesh-sharded replicas "
+                         "behind the real HTTP frontend + KV router at "
+                         "identical workload (tok/s, mesh_shape, "
+                         "per-replica device_time_fraction, compile "
+                         "counts)")
+    ap.add_argument("--mesh", default=None,
+                    help="sharded scenario: per-replica mesh as 'axis=N' "
+                         "pairs (e.g. 'model=2'; default DYN_MESH_SHAPE "
+                         "or model=2)")
+    ap.add_argument("--dp-replicas", type=int, default=2,
+                    help="sharded scenario: data-parallel replicas behind "
+                         "the KV router")
     ap.add_argument("--shared-shape", default="multi_tenant",
                     choices=["multi_tenant", "rag", "agent", "all"],
                     help="shared scenario workload shape: multi_tenant = "
@@ -258,10 +280,11 @@ def parse_args():
     return ap.parse_args()
 
 
-def build_engine(args):
-    import jax
-
-    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+def engine_setup(args):
+    """The bench engine-config assembly, shared by the single-engine
+    build and the dynashard replica set: (model_cfg, engine_cfg, params,
+    quant)."""
+    from dynamo_tpu.engine.jax_engine import EngineConfig
     from dynamo_tpu.models.config import ModelConfig
 
     if args.model == "tiny":
@@ -324,7 +347,6 @@ def build_engine(args):
         ecfg.host_pages = args.host_pages
     if args.host_tier_int8:
         ecfg.host_tier_int8 = True
-    print(f"devices: {jax.devices()}", file=sys.stderr)
     params = None
     if args.model == "8b":
         # 8B Gaussian host-init costs minutes of single-core time the
@@ -334,9 +356,19 @@ def build_engine(args):
         from dynamo_tpu.models.quant import synthetic_int8_params
 
         params = synthetic_int8_params(llama, cfg)
+    quant = ("int8" if args.dtype == "int8" and params is None else None)
+    return cfg, ecfg, params, quant
+
+
+def build_engine(args):
+    import jax
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine
+
+    cfg, ecfg, params, quant = engine_setup(args)
+    print(f"devices: {jax.devices()}", file=sys.stderr)
     engine = JaxEngine(cfg, ecfg, seed=args.seed, params=params,
-                       quant="int8" if args.dtype == "int8" and
-                       params is None else None)
+                       quant=quant)
     return engine, cfg
 
 
@@ -769,6 +801,212 @@ async def run_shared(args):
             await publisher.stop()
         await engine.stop()
         await drt.shutdown()
+
+
+# --------------------------------------------------- dynashard sharded A/B
+
+
+async def _sharded_leg(args, tag, prompts, *, token_counts, http, port):
+    """Drive the identical workload through one leg's HTTP frontend;
+    returns {wall_s, output_tok_per_s, ttft_p50_ms, requests, errors}.
+    Output tokens are counted ENGINE-side (decode_tokens_total delta +
+    one first token per request) so both legs use the same ruler."""
+    import json as _json
+
+    before = [f() for f in token_counts]
+    rows: list = []
+    sem = asyncio.Semaphore(args.concurrency)
+
+    async def one(i, prompt):
+        async with sem:
+            t0 = time.monotonic()
+            first = None
+            async with http.post(
+                    f"http://127.0.0.1:{port}/v1/completions",
+                    json={"model": "bench", "prompt": prompt,
+                          "stream": True, "max_tokens": args.osl},
+                    headers={"X-Request-Id": f"{tag}-{i:04d}"}) as resp:
+                if resp.status != 200:
+                    rows.append({"ttft": None, "error": True})
+                    return
+                async for raw in resp.content:
+                    line = raw.strip()
+                    if line == b"data: [DONE]":
+                        break
+                    if not line.startswith(b"data: "):
+                        continue
+                    chunk = _json.loads(line[len(b"data: "):])
+                    if first is None and any(
+                            (c.get("text") or "")
+                            for c in chunk.get("choices", [])):
+                        first = time.monotonic() - t0
+            rows.append({"ttft": first, "error": False})
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(one(i, p) for i, p in enumerate(prompts)))
+    wall = time.monotonic() - t0
+    after = [f() for f in token_counts]
+    ok = [r for r in rows if not r["error"]]
+    out_toks = sum(a - b for a, b in zip(after, before)) + len(ok)
+    ttfts = sorted(r["ttft"] for r in ok if r["ttft"] is not None)
+    return {
+        "requests": len(rows),
+        "errors": sum(1 for r in rows if r["error"]),
+        "wall_s": round(wall, 3),
+        "output_tok_per_s": round(out_toks / wall, 1) if wall else 0.0,
+        "ttft_p50_ms": (round(ttfts[len(ttfts) // 2] * 1000, 1)
+                        if ttfts else None),
+    }
+
+
+async def run_sharded(args):
+    """dynashard tentpole A/B: the SAME workload served by (a) one
+    unsharded engine and (b) --dp-replicas mesh-sharded engine replicas
+    on partitioned submeshes — both behind the real aiohttp → HttpService
+    → Processor → KvRouter → generate_tokens stack. Reports tok/s per
+    leg, the mesh shape, per-replica device_time_fraction and compile
+    counts (the compile fence must hold under sharding: 0 per replica)."""
+    import aiohttp
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.processor import Processor
+    from dynamo_tpu.llm.worker import serve_token_model
+    from dynamo_tpu.parallel.serving import (devices_per_replica,
+                                             parse_mesh_shape,
+                                             ShardedReplicaSet)
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    axes = parse_mesh_shape(args.mesh or env_str_cfg("DYN_MESH_SHAPE")
+                            or "model=2")
+    replicas = max(args.dp_replicas, 1)
+    need = devices_per_replica(axes) * replicas
+    if len(jax.devices()) < need:
+        raise RuntimeError(
+            f"sharded A/B needs {need} devices "
+            f"({replicas} x {axes}), have {len(jax.devices())} — on CPU "
+            f"set DYN_FORCE_HOST_DEVICES (bench --cpu defaults it to 8)")
+    cfg, ecfg, params, quant = engine_setup(args)
+
+    rng = np.random.RandomState(args.seed)
+    cap = min(ecfg.page_buckets[-1] * ecfg.page_size, 1 << 30)
+    budget = max(cap - args.osl - 16, 64)
+    prompts = [_word_text(rng, min(max(args.isl + int(v), 32), budget))
+               for v in rng.randint(-args.isl // 4, args.isl // 4 + 1,
+                                    size=args.requests)]
+    mdc = ModelDeploymentCard(name="bench", tokenizer_kind="byte",
+                              kv_block_size=ecfg.page_size,
+                              model_type="completions")
+
+    async def leg(tag, start_leg):
+        drt = await DistributedRuntime.detached()
+        service = kvr = token_client = None
+        try:
+            token_counts, compiles, extra, stop_leg = await start_leg(drt)
+            kvr = KvRouter(drt, "bench", tag,
+                           block_size=ecfg.page_size, seed=args.seed)
+            await kvr.start(run_loop=False)
+            await kvr.scrape_once()
+            token_client = await drt.namespace("bench").component(tag) \
+                .endpoint("generate_tokens").client()
+            processor = Processor(mdc, token_client, kvr)
+            service = HttpService()
+            service.manager.add_completions_model("bench",
+                                                  processor.completion)
+            await service.start(host="127.0.0.1", port=0)
+            async with aiohttp.ClientSession() as http:
+                rep = await _sharded_leg(args, tag, prompts,
+                                         token_counts=token_counts,
+                                         http=http, port=service.port)
+            rep["post_warmup_compiles"] = compiles()
+            rep.update(extra())
+            print(json.dumps({tag: rep}), file=sys.stderr)
+            return rep
+        finally:
+            if service is not None:
+                await service.stop()
+            if kvr is not None:
+                await kvr.stop()
+            if token_client is not None:
+                await token_client.close()
+            try:
+                await stop_leg()
+            except UnboundLocalError:
+                pass
+            await drt.shutdown()
+
+    async def start_unsharded(drt):
+        engine = JaxEngine(cfg, ecfg, seed=args.seed, params=params,
+                           quant=quant)
+        print("warming up unsharded engine...", file=sys.stderr)
+        await asyncio.to_thread(engine.warmup)
+        _handle, publisher = await serve_token_model(
+            drt, mdc, engine, namespace="bench", component="agg")
+
+        async def stop():
+            await publisher.stop()
+            await engine.stop()
+
+        return ([lambda: engine.decode_tokens_total],
+                lambda: engine.fence.post_warmup_compiles,
+                lambda: {"device_time_fraction":
+                         round(engine.profiler.device_time_fraction(), 4),
+                         "mesh_shape": "single"},
+                stop)
+
+    async def start_sharded(drt):
+        rs = ShardedReplicaSet(
+            cfg, ecfg, mesh_axes=axes, replicas=replicas,
+            namespace="bench", component="sharded", mdc=mdc,
+            dcp_address=drt.dcp.address, params=params, seed=args.seed,
+            quant=quant)
+        print(f"warming up {replicas} sharded replicas "
+              f"(mesh {rs.mesh_shape})...", file=sys.stderr)
+        await rs.start()
+
+        def extra():
+            return {
+                "mesh_shape": rs.mesh_shape,
+                "sharding": rs.describe(),
+                "per_replica_device_time_fraction":
+                    rs.device_time_fractions(),
+                "per_replica_compiles": rs.post_warmup_compiles(),
+                "per_replica_decode_tokens": {
+                    r.name: r.engine.decode_tokens_total
+                    for r in rs.replicas},
+            }
+
+        return ([lambda r=r: r.engine.decode_tokens_total
+                 for r in rs.replicas],
+                lambda: sum(rs.post_warmup_compiles().values()),
+                extra, rs.stop)
+
+    unsharded = await leg("agg", start_unsharded)
+    sharded = await leg("sharded", start_sharded)
+    report = {
+        "scenario": "sharded_vs_unsharded",
+        "mesh_shape": sharded.get("mesh_shape"),
+        "dp_replicas": replicas,
+        "unsharded": unsharded,
+        "sharded": sharded,
+        "sharded_over_unsharded_tok_per_s": round(
+            sharded["output_tok_per_s"]
+            / max(unsharded["output_tok_per_s"], 1e-9), 3),
+        "post_warmup_compiles": (unsharded["post_warmup_compiles"]
+                                 + sharded["post_warmup_compiles"]),
+    }
+    print(json.dumps(report), file=sys.stderr)
+    return report
+
+
+def env_str_cfg(name):
+    from dynamo_tpu.runtime.config import env_str
+
+    return env_str(name)
 
 
 async def measure(engine, reqs, concurrency, trace=False):
@@ -1254,6 +1492,15 @@ def main():
     watchdog = None
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        if args.scenario == "sharded":
+            # the forced-device-count flag must land in XLA_FLAGS before
+            # the jax backend initializes (silently ignored afterwards)
+            from dynamo_tpu.parallel.serving import \
+                apply_forced_host_devices
+            from dynamo_tpu.runtime.config import env_set_default
+
+            env_set_default("DYN_FORCE_HOST_DEVICES", "8")
+            apply_forced_host_devices()
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -1333,6 +1580,14 @@ def _run_scenario(args) -> dict:
                 "value": report["prefix_hit_rate"],
                 "unit": metric_unit(args),
                 "vs_baseline": report["ttft_noshare_over_share"] or 1.0,
+                "detail": report}
+    if args.scenario == "sharded":
+        report = asyncio.run(run_sharded(args))
+        return {"metric": metric_name(args),
+                "value": report["sharded"]["output_tok_per_s"],
+                "unit": metric_unit(args),
+                "vs_baseline":
+                    report["sharded_over_unsharded_tok_per_s"],
                 "detail": report}
     report = asyncio.run(run_bench(args))
     # vs_baseline: reference publishes no absolute numbers —

@@ -310,9 +310,22 @@ class JaxEngine:
 
     def __init__(self, model_cfg: ModelConfig, engine_cfg: Optional[EngineConfig]
                  = None, params=None, seed: int = 0, dtype=None, mesh=None,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None,
+                 worker_label: Optional[str] = None):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
+        # dynashard replica identity: a STABLE per-replica label (e.g.
+        # "r0") threaded through stats() → ForwardPassMetrics → the
+        # aggregator's `replica` gauge label, the per-request cost block
+        # and dyntrace spans — instance ids (lease hex) are unique but
+        # not stable across restarts, so dashboards key on this instead
+        self.worker_label = worker_label or ""
+        self.mesh_devices = int(mesh.size) if mesh is not None else 1
+        self.mesh_axes = ({k: int(v) for k, v in mesh.shape.items()
+                           if int(v) > 1} if mesh is not None else {})
+        self.mesh_shape = (",".join(f"{k}={v}" for k, v in
+                                    self.mesh_axes.items())
+                           or "single")
         model = get_model_module(model_cfg)
         if params is None:
             if quant == "int8":
@@ -523,6 +536,10 @@ class JaxEngine:
         page_buckets = grid["page_buckets"] or [8]
         t0 = time.monotonic()
         n = 0
+        # under a mesh: the committed (NamedSharding) decode-window carry
+        # per batch bucket, captured below to warm the pipelined call
+        # forms (see the committed-carry note in the decode loop)
+        carries: Dict[int, tuple] = {}
         prefill_bs = grid["prefill_batches"]
         for P in page_buckets:
             for T in grid["prefill_lens"]:
@@ -582,6 +599,31 @@ class JaxEngine:
                             jnp.full((B, ecfg.max_eos_ids), -1, jnp.int32),
                             pv, k_steps=ecfg.decode_steps,
                             logprobs_topn=0)
+                        if pv is None and self.mesh is not None:
+                            # committed-carry variant: under a mesh the
+                            # pipelined window's (tok, pos, done, steps,
+                            # remaining) arrive COMMITTED (NamedSharding
+                            # outputs of the previous window /
+                            # _merge_carry) while the host-array call
+                            # above is uncommitted — DIFFERENT jit cache
+                            # entries, so without this the first chained
+                            # window would compile mid-serving (found by
+                            # the compile fence on the first sharded
+                            # engine). Feed the window its own carry to
+                            # warm that variant; save it for the
+                            # merge-combo loop below.
+                            carries[B] = _carry
+                            (toks, _carry, self.kv_k,
+                             self.kv_v) = self.decode_multi_fn(
+                                self.params, *_carry, self.kv_k,
+                                self.kv_v, tableB, jnp.zeros(B),
+                                jnp.zeros(B, jnp.int32), jnp.ones(B),
+                                jnp.zeros(B, jnp.uint32),
+                                jnp.full((B, ecfg.max_eos_ids), -1,
+                                         jnp.int32),
+                                pv, k_steps=ecfg.decode_steps,
+                                logprobs_topn=0)
+                            n += 1
                 else:
                     logits, self.kv_k, self.kv_v = self.decode_fn(
                         self.params, jnp.zeros(B, jnp.int32),
@@ -637,9 +679,18 @@ class JaxEngine:
         if decode and ecfg.decode_steps > 1 and ecfg.pipeline_decode:
             bset = grid["decode_batches"]
             for Bp in bset:
-                carry = (jnp.zeros(Bp, jnp.int32), jnp.zeros(Bp, jnp.int32),
-                         jnp.zeros(Bp, bool), jnp.zeros(Bp, jnp.int32),
-                         jnp.ones(Bp, jnp.int32))
+                # under a mesh the in-flight window's carry is COMMITTED
+                # (NamedSharding) — warm the merge with the real warmed
+                # carry so serving's exact sharding mix (committed carry
+                # + uncommitted host rows) hits the cache (unsharded
+                # engines keep the host-zeros form: committed and
+                # uncommitted coincide on one device)
+                carry = carries.get(Bp) if self.mesh is not None else None
+                if carry is None:
+                    carry = (jnp.zeros(Bp, jnp.int32),
+                             jnp.zeros(Bp, jnp.int32),
+                             jnp.zeros(Bp, bool), jnp.zeros(Bp, jnp.int32),
+                             jnp.ones(Bp, jnp.int32))
                 for Bn in bset:
                     _merge_carry(*carry, jnp.zeros(Bn, jnp.int32),
                                  jnp.zeros(Bn, bool),
@@ -666,9 +717,22 @@ class JaxEngine:
                                                   quantize_pages)
 
                         q, s = quantize_pages(g)
+                        if self.mesh is not None:
+                            # serving restores dequantize UNCOMMITTED
+                            # host arrays; under a mesh the committed
+                            # quantize outputs here are a different jit
+                            # cache entry — round-trip through the host
+                            # so warmup matches the serving call form
+                            q = jnp.asarray(np.asarray(q))  # dynalint: disable=implicit-host-transfer
+                            s = jnp.asarray(np.asarray(s))  # dynalint: disable=implicit-host-transfer
                         rows = dequantize_pages(q, s)
                     else:
                         rows = g
+                        if self.mesh is not None:
+                            # same committed-vs-uncommitted note: serving
+                            # restores inject np views of the host pool.
+                            # Warmup-time sync, not a hot-path leak.
+                            rows = jnp.asarray(np.asarray(rows))  # dynalint: disable=implicit-host-transfer
                     setattr(self, pool_attr, _inject_pages(
                         getattr(self, pool_attr),
                         jnp.full((size,), ecfg.num_pages, jnp.int32),
@@ -709,6 +773,14 @@ class JaxEngine:
         if not isinstance(request, PreprocessedRequest):
             request = PreprocessedRequest.from_dict(request)
         self.start()
+        if self.worker_label or self.mesh_devices > 1:
+            # dynashard: stamp which replica/submesh serves this request
+            # on the enclosing span (serve.generate_tokens on a worker,
+            # http.request when served in-process)
+            span = tracing.current_span()
+            if span is not None:
+                span.set_attribute("replica", self.worker_label)
+                span.set_attribute("mesh_shape", self.mesh_shape)
         seq = Sequence(req=request, context=context, out=asyncio.Queue(),
                        tokens=list(request.token_ids),
                        num_prompt=len(request.token_ids))
@@ -732,6 +804,13 @@ class JaxEngine:
         metrics aggregator's dyn_worker_*/dyn_engine_* gauges."""
         lag = profiling.loop_lag_snapshot()
         return {
+            # dynashard replica identity: the stable per-replica label +
+            # submesh geometry ride the stats plane so the aggregator can
+            # label gauges per replica (instance ids alone are unstable
+            # lease hex) and dashboards can split by mesh size
+            "worker_label": self.worker_label,
+            "mesh_shape": self.mesh_shape,
+            "mesh_devices": self.mesh_devices,
             # dynaprof: loop health + sampled device/host split +
             # per-bucket program costs + page-pool occupancy
             "loop_lag_p50_seconds": lag["p50_s"],
@@ -2000,6 +2079,10 @@ class JaxEngine:
             "device_ms_est": (round(seq.dispatch_share * est, 3)
                               if est is not None else None),
             "finish_reason": seq.finished,
+            # dynashard: which replica/submesh served this request —
+            # /v1/traces/{rid} and the usage cost extension surface these
+            "replica": self.worker_label,
+            "mesh_shape": self.mesh_shape,
         }
 
     def _emit_finish(self, seq: Sequence) -> None:

@@ -40,6 +40,7 @@ from ..llm.kv_router.router import KvRouter
 from ..llm.model_card import ModelDeploymentCard
 from ..llm.processor import Processor
 from ..metrics.component import MetricsAggregator
+from ..parallel.serving import DevicePool, NoFreeDevices
 from ..planner.planner import Planner, WatchTarget
 from ..planner.policy import PLANNER_KV_PREFIX
 from ..runtime.component import Client
@@ -79,6 +80,15 @@ class FleetSim:
         # dynacache: run-long per-worker (hit_tokens, prompt_tokens) view
         # folded from every scrape (survives drained workers)
         self._cache_seen: Dict[int, tuple] = {}
+        # dynashard: the modeled accelerator pool replicas draw their
+        # submeshes from (None in unsharded scenarios) + the assignment
+        # timeline for the report's `sharding` block
+        self.device_pool: Optional[DevicePool] = None
+        if scenario.devices_per_replica > 0:
+            self.device_pool = DevicePool(
+                range(scenario.device_pool_size))
+        self._sharding_events: List[dict] = []
+        self._max_devices_in_use = 0
         self._discovery_timeout = env_float(
             "DYN_FLEET_DISCOVERY_TIMEOUT") or 10.0
         # wired in setup()
@@ -153,11 +163,27 @@ class FleetSim:
         await self._scrape()
 
     async def _worker_factory(self, name: str) -> SimWorker:
+        submesh = None
+        if self.device_pool is not None:
+            # partition a submesh for the new replica BEFORE any await:
+            # an exhausted pool must fail the spawn, not serve unsharded
+            submesh = self.device_pool.acquire(
+                name, self.scenario.devices_per_replica)
+            idx = self.device_pool.assignment()[name]
+            self._sharding_events.append(
+                {"at": self.clock.now(), "event": "assign",
+                 "worker": name, "devices": idx})
+            in_use = sum(len(d) for d in
+                         self.device_pool.assigned.values())
+            self._max_devices_in_use = max(self._max_devices_in_use,
+                                           in_use)
+            submesh = idx
         drt = await DistributedRuntime.attach(self.drt.dcp.address)
         worker = SimWorker(
             drt, NAMESPACE, COMPONENT, name, self.scenario.profile,
             self.scenario.block_size, self.clock.now,
-            lambda rid, ev, vt, n=name: self._lifecycle(n, rid, ev, vt))
+            lambda rid, ev, vt, n=name: self._lifecycle(n, rid, ev, vt),
+            submesh=submesh)
         await worker.start()
         return worker
 
@@ -245,6 +271,14 @@ class FleetSim:
         retired = await self.controller.retire_idle_drained()
         for name in retired:
             self.scorer.worker_event(self.clock.now(), "removed", name)
+            if self.device_pool is not None:
+                # a retired replica's submesh returns to the pool — the
+                # next join re-partitions onto these devices
+                devs = self.device_pool.assignment().get(name, [])
+                self.device_pool.release(name)
+                self._sharding_events.append(
+                    {"at": self.clock.now(), "event": "release",
+                     "worker": name, "devices": devs})
         # let woken handlers push their token frames down the wire
         await asyncio.sleep(0)
 
@@ -320,7 +354,17 @@ class FleetSim:
                     await worker.crash()
                     self.scorer.worker_event(vt, "crash", worker.name)
             elif fault.kind == "join":
-                name = await self.controller._spawn()
+                try:
+                    name = await self.controller._spawn()
+                except NoFreeDevices:
+                    # the modeled accelerator pool is the hard capacity
+                    # limit: a join with no free submesh is DENIED, not
+                    # served unsharded (recorded for the report)
+                    self._sharding_events.append(
+                        {"at": vt, "event": "join_denied_no_devices",
+                         "worker": None, "devices": []})
+                    self.scorer.worker_event(vt, "join_denied", "*")
+                    continue
                 self.scorer.worker_event(vt, "join", name)
                 await self._sync_discovery()
             elif fault.kind == "blackout_start":
@@ -418,6 +462,18 @@ class FleetSim:
             # scenarios like hot-tenant can assert both views agree
             "cache": self._cache_block(),
         }
+        if self.device_pool is not None:
+            # dynashard plane: the submesh-assignment story of the run —
+            # every partition/release with its virtual timestamp, the
+            # final assignment, and the peak device usage (all modeled
+            # state: byte-identical per seed)
+            extra["sharding"] = {
+                "device_pool_size": self.scenario.device_pool_size,
+                "devices_per_replica": self.scenario.devices_per_replica,
+                "assignment": self.device_pool.assignment(),
+                "timeline": self._sharding_events,
+                "max_devices_in_use": self._max_devices_in_use,
+            }
         if self.k8s is not None:
             extra["k8s_dry_run"] = {
                 "deployment_replicas": self._k8s_replicas,

@@ -60,6 +60,14 @@ class Scenario:
     k8s_dry_run: bool = False
     # extra virtual steps granted after the last arrival to drain queues
     drain_steps: int = 40
+    # dynashard: model each replica as a submesh of this many devices
+    # drawn from a pool of device_pool_size — the planner then scales
+    # SHARDED replicas, and every join/drain re-partitions the submesh
+    # assignment through the shared DevicePool (parallel/serving.py).
+    # 0/0 = the unsharded scenarios. The pool hard-caps the fleet:
+    # device_pool_size // devices_per_replica replicas fit.
+    devices_per_replica: int = 0
+    device_pool_size: int = 0
 
 
 def _smoke() -> Scenario:
@@ -204,6 +212,34 @@ def _join() -> Scenario:
     )
 
 
+def _sharded() -> Scenario:
+    """dynashard closed loop: the planner scales SHARDED replicas (each a
+    2-device submesh of an 8-device pool). A burst forces a scale-up
+    (joins partition fresh submeshes), the post-burst scale-down drains
+    newest-first (their devices return to the pool), and a late join
+    fault re-partitions onto the freed devices — the report's `sharding`
+    block records the assignment timeline and the SLO verdict shows
+    recovery."""
+    steps = 44
+    return Scenario(
+        name="sharded", steps=steps,
+        traffic=lambda seed: burst(seed, steps=steps, base_rate=1.5,
+                                   burst_rate=7.0, burst_start=8,
+                                   burst_end=18, max_tokens=12),
+        initial_workers=2,
+        profile=WorkerProfile(slots=3, tokens_per_step=6),
+        planner=PlannerConfig(min_replicas=2, max_replicas=4,
+                              waiting_per_worker_high=2.0,
+                              scale_up_cooldown_s=6.0,
+                              scale_down_cooldown_s=10.0),
+        slo=SloTargets(ttft_p95=5.0, queue_wait_p95=4.0),
+        faults=[FaultEvent(step=34, kind="join")],
+        disturb_end_step=18,
+        devices_per_replica=2,
+        device_pool_size=8,
+    )
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "smoke": _smoke,
     "burst": _burst,
@@ -213,6 +249,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "blackout": _blackout,
     "breaker": _breaker,
     "join": _join,
+    "sharded": _sharded,
 }
 
 

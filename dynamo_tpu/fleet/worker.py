@@ -97,6 +97,11 @@ class SimEngineModel:
         self.block_size = block_size
         self.clock = clock
         self.on_lifecycle = on_lifecycle
+        # dynashard: stable per-replica identity + modeled submesh size,
+        # riding the same ForwardPassMetrics fields the real sharded
+        # engine exports (the aggregator's `replica` gauge label)
+        self.worker_label = name
+        self.mesh_devices = 1
         self.queue: Deque[_SimRequest] = deque()
         self.active: List[_SimRequest] = []
         self.crashed = False
@@ -210,6 +215,8 @@ class SimEngineModel:
         blocks = min(inflight_blocks + self._stored_blocks,
                      p.kv_total_blocks)
         return ForwardPassMetrics(
+            worker_label=self.worker_label,
+            mesh_devices=self.mesh_devices,
             request_active_slots=len(self.active),
             request_total_slots=p.total_slots,
             kv_active_blocks=blocks,
@@ -247,14 +254,21 @@ class SimWorker:
                  component: str, name: str, profile: WorkerProfile,
                  block_size: int, clock: Callable[[], float],
                  on_lifecycle: Callable[[str, str, float], None],
-                 endpoint: str = "generate_tokens"):
+                 endpoint: str = "generate_tokens",
+                 submesh: Optional[List[int]] = None):
         self.drt = drt
         self.namespace = namespace
         self.component = component
         self.endpoint = endpoint
         self.name = name
+        # dynashard scenario: the modeled device ids this replica's
+        # submesh occupies (assigned by the harness's DevicePool; None =
+        # the unsharded fleet scenarios)
+        self.submesh = list(submesh) if submesh else None
         self.model = SimEngineModel(name, profile, block_size, clock,
                                     on_lifecycle)
+        if self.submesh:
+            self.model.mesh_devices = len(self.submesh)
         self.kv_subject = f"{namespace}.{component}.{KV_EVENT_SUBJECT}"
         self.draining = False
         self._handle = None

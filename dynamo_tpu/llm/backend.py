@@ -9,6 +9,7 @@ and stamps finish reasons.
 
 from __future__ import annotations
 
+import asyncio
 from typing import AsyncIterator, List, Optional
 
 from ..runtime import profiling
@@ -67,9 +68,36 @@ class Backend:
     stage fills ``text`` and ``finish_reason``.
     """
 
+    # bound (seconds) on draining the engine's in-flight finish chunk
+    # after a Backend-side stop: ~an engine iteration, never a hang
+    COST_HARVEST_BOUND_S = 0.25
+
     def __init__(self, engine, tokenizer: Tokenizer):
         self.engine = engine
         self.tokenizer = tokenizer
+
+    async def _harvest_finish_cost(self, agen, context):
+        """Drain a few more engine chunks (bounded) for the cost block
+        riding the engine's own finish; registers + returns it, or None
+        on timeout/exhaustion. Without this, any request the Backend
+        finishes first (length cap, eos) would lose its remote cost
+        attribution — /v1/traces/{rid} on the frontend, the usage
+        extension and the KV router's predicted-vs-realized calibration
+        all feed off this block (found live by the dynashard
+        multi-process verify: cost never crossed the wire)."""
+        try:
+            while True:
+                raw = await asyncio.wait_for(agen.__anext__(),
+                                             self.COST_HARVEST_BOUND_S)
+                out = raw if isinstance(raw, EngineOutput) \
+                    else EngineOutput.from_dict(raw)
+                if out.cost is not None:
+                    profiling.record_attribution(context.id, out.cost)
+                    return out.cost
+                if out.finish_reason:
+                    return None
+        except (StopAsyncIteration, asyncio.TimeoutError):
+            return None
 
     async def generate(self, request: PreprocessedRequest,
                        context: Context) -> AsyncIterator[EngineOutput]:
@@ -100,7 +128,8 @@ class Backend:
             tail, _ = jail.feed(decode.flush())
             return released + tail + jail.flush()
 
-        async for raw in _aiter(self.engine.generate(request, context)):
+        agen = _aiter(self.engine.generate(request, context))
+        async for raw in agen:
             out = raw if isinstance(raw, EngineOutput) else EngineOutput.from_dict(raw)
             if out.cost is not None:
                 # remote workers attach dynaprof cost attribution to the
@@ -136,6 +165,19 @@ class Backend:
             out.completion_tokens = produced
             if out.finish_reason:
                 out.text = _final_text(released, stop_seq_hit=hit)
+                if out.cost is None and finished is not None and not hit:
+                    # the Backend's own stop (token cap / eos / stop
+                    # token) fired BEFORE the engine's finish chunk —
+                    # the chunk that carries the dynaprof cost block
+                    # (replica, prefix split). The engine enforces the
+                    # same budget/eos on device, so its finish is
+                    # already in flight: drain it (bounded) so remote
+                    # cost attribution still lands in this process's
+                    # ring. Skipped for stop-STRING matches (`hit`) —
+                    # the engine doesn't know host-side stop sequences
+                    # and would not finish within the bound.
+                    out.cost = await self._harvest_finish_cost(
+                        agen, context)
                 yield out
                 context.stop_generating()
                 return

@@ -18,6 +18,14 @@ KV_HIT_RATE_SUBJECT = "kv-hit-rate"  # router observability events
 class ForwardPassMetrics:
     """Per-worker load snapshot (reference protocols.rs:18-30)."""
 
+    # dynashard replica identity: the engine's stable per-replica label
+    # (e.g. "r0") and submesh geometry. The label becomes the `replica`
+    # Prometheus label when set — instance ids (lease hex) are unique
+    # but change on every restart, so N-replicas-in-one-process dashboards
+    # key on this instead (ISSUE 12 satellite: metric identity).
+    worker_label: str = ""
+    mesh_shape: str = ""
+    mesh_devices: int = 1
     request_active_slots: int = 0
     request_total_slots: int = 0
     kv_active_blocks: int = 0

@@ -126,7 +126,20 @@ class MetricsAggregator:
             lines.append(f"# TYPE {name} gauge")
             lines.extend(rows)
 
+        def wlabels(wid, m) -> str:
+            """Per-worker label set. The `replica` label (the engine's
+            stable worker_label, dynashard) disambiguates N replicas in
+            one process and survives restarts — the `worker` lease hex
+            does neither."""
+            extra = ""
+            if getattr(m, "worker_label", ""):
+                extra = f',replica="{m.worker_label}"'
+            return f'namespace="{ns}",worker="{wid:x}"{extra}'
+
         per_worker = [
+            ("dyn_engine_mesh_devices",
+             "devices in this worker's submesh (1 = unsharded; dynashard)",
+             lambda m: m.mesh_devices),
             ("dyn_worker_request_active_slots", "active request slots",
              lambda m: m.request_active_slots),
             ("dyn_worker_request_total_slots", "total request slots",
@@ -252,7 +265,7 @@ class MetricsAggregator:
         ]
         for name, help_, get in per_worker:
             rows = [
-                f'{name}{{namespace="{ns}",worker="{wid:x}"}} {get(m)}'
+                f'{name}{{{wlabels(wid, m)}}} {get(m)}'
                 for wid, m in sorted(self.worker_metrics.items())]
             gauge(name, help_, rows)
         # dynaprof labeled families: loop lag quantiles + per-bucket
@@ -260,16 +273,16 @@ class MetricsAggregator:
         # the ROADMAP item-3 regression surface)
         gauge("dyn_runtime_loop_lag_seconds",
               "per-worker event-loop sleep-drift percentiles (dynaprof)",
-              [f'dyn_runtime_loop_lag_seconds{{namespace="{ns}",'
-               f'worker="{wid:x}",quantile="{q}"}} {val}'
+              [f'dyn_runtime_loop_lag_seconds{{{wlabels(wid, m)},'
+               f'quantile="{q}"}} {val}'
                for wid, m in sorted(self.worker_metrics.items())
                for q, val in (("p50", m.loop_lag_p50_seconds),
                               ("p99", m.loop_lag_p99_seconds))])
         gauge("dyn_engine_bucket_cost_us",
               "mean sampled device-drain microseconds per dispatch, per "
               "compiled (kind, bucket) program (dynaprof cost table)",
-              [f'dyn_engine_bucket_cost_us{{namespace="{ns}",'
-               f'worker="{wid:x}",bucket="{bucket}"}} '
+              [f'dyn_engine_bucket_cost_us{{{wlabels(wid, m)},'
+               f'bucket="{bucket}"}} '
                f'{row.get("device_us", 0.0)}'
                for wid, m in sorted(self.worker_metrics.items())
                for bucket, row in sorted(

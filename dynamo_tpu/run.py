@@ -37,7 +37,7 @@ import signal
 import sys
 import time
 
-from .runtime.config import env_str
+from .runtime.config import env_int, env_str
 from typing import Optional, Tuple
 
 log = logging.getLogger("dynamo_tpu.run")
@@ -71,6 +71,15 @@ def parse_args(argv=None):
     ap.add_argument("--sequence-parallel-size", type=int, default=1,
                     help="seq-axis mesh size for ring-attention long "
                          "prefill (long-context serving)")
+    ap.add_argument("--mesh-shape", default=env_str("DYN_MESH_SHAPE"),
+                    help="dynashard: per-replica mesh as 'axis=N' pairs "
+                         "(e.g. 'model=2', 'data=2,model=4'); overrides "
+                         "--tensor-parallel-size/--sequence-parallel-size")
+    ap.add_argument("--dp-replicas", type=int,
+                    default=env_int("DYN_DP_REPLICAS") or 1,
+                    help="dynashard: data-parallel engine replicas, each "
+                         "on its own submesh with its own worker identity "
+                         "behind the KV router (worker mode, out=jax)")
     ap.add_argument("--spec-decode", action="store_true",
                     help="self-speculative decoding: prompt-lookup drafts "
                          "verified in one [B, K+1] forward; greedy rows "
@@ -180,74 +189,101 @@ def build_engine(args) -> Tuple[object, object, bool]:
         kind, path = args.output.split(":", 1)
         return _load_python_engine(path, kind), mdc, kind == "pystr"
     if args.output == "jax":
-        from .engine.jax_engine import EngineConfig, JaxEngine
-        from .models.loader import load_params
+        from .engine.jax_engine import JaxEngine
 
-        cfg = build_model_config(args)
-        ecfg = EngineConfig()
-        if args.model in (None, "tiny") and not args.model_path:
-            ecfg = EngineConfig(page_size=16, num_pages=256, max_batch=16,
-                                prefill_chunk=128, prefill_buckets=(128,),
-                                batch_buckets=(4, 16), page_buckets=(16,))
-        import dataclasses
-
-        overrides = {}
-        if args.kv_cache_block_size:
-            overrides["page_size"] = args.kv_cache_block_size
-            # keep the chunk a page multiple (the page-granular KV commit
-            # invariant __post_init__ enforces)
-            overrides["prefill_chunk"] = max(
-                ecfg.prefill_chunk // args.kv_cache_block_size, 1
-            ) * args.kv_cache_block_size
-        if args.num_pages:
-            overrides["num_pages"] = args.num_pages
-        if args.max_batch_size:
-            overrides["max_batch"] = args.max_batch_size
-        if args.prefill_token_budget is not None:
-            overrides["prefill_token_budget"] = args.prefill_token_budget
-        if args.spec_decode:
-            overrides["spec_decode"] = True
-            overrides["spec_tokens"] = args.spec_tokens
-        if overrides:
-            # replace() re-runs __post_init__ — CLI overrides get the same
-            # validation as direct construction
-            ecfg = dataclasses.replace(ecfg, **overrides)
+        cfg, ecfg, params, quant, mesh = _jax_engine_setup(args)
         mdc.kv_block_size = ecfg.page_size
-        params = None
-        mesh = None
-        if args.coordinator:
-            from .parallel.mesh import initialize_multihost
-            initialize_multihost(args.coordinator, args.num_processes,
-                                 args.process_id)
-            log.info("joined multi-host group %s as process %d/%d "
-                     "(%d global devices)", args.coordinator,
-                     args.process_id, args.num_processes,
-                     len(__import__("jax").devices()))
-        if args.tensor_parallel_size > 1 or args.sequence_parallel_size > 1:
-            from .parallel.mesh import MeshSpec
-            mesh = MeshSpec(model=args.tensor_parallel_size,
-                            seq=args.sequence_parallel_size).build()
-        if args.long_prefill_threshold is not None:
-            if args.sequence_parallel_size <= 1:
-                raise SystemExit(
-                    "--long-prefill-threshold needs "
-                    "--sequence-parallel-size > 1 (the ring prefill runs "
-                    "over the mesh's seq axis)")
-            ecfg = dataclasses.replace(
-                ecfg, long_prefill_threshold=args.long_prefill_threshold)
-        quant = "int8" if args.dtype == "int8" else None
-        if args.model_path:
-            try:
-                params = load_params(args.model_path, cfg, quant=quant)
-                quant = None  # already applied on the host at load
-            except FileNotFoundError:
-                log.warning("no weights at %s; random init", args.model_path)
         engine = JaxEngine(cfg, ecfg, params=params, seed=args.seed,
                            mesh=mesh, quant=quant)
         if not args.no_warmup:
             engine.warmup(progress=True)
         return engine, mdc, False
     raise SystemExit(f"unknown out={args.output!r}")
+
+
+def mesh_axes_for(args) -> dict:
+    """The per-replica mesh axes: --mesh-shape (or DYN_MESH_SHAPE) wins;
+    the per-axis convenience flags otherwise."""
+    from .parallel.serving import parse_mesh_shape
+
+    if getattr(args, "mesh_shape", None):
+        return parse_mesh_shape(args.mesh_shape)
+    axes = {}
+    if args.tensor_parallel_size > 1:
+        axes["model"] = args.tensor_parallel_size
+    if args.sequence_parallel_size > 1:
+        axes["seq"] = args.sequence_parallel_size
+    return axes
+
+
+def _jax_engine_setup(args):
+    """The out=jax configuration assembly, shared by the single-engine
+    build and the dynashard replica set: returns
+    (model_cfg, engine_cfg, params, quant, mesh). ``mesh`` is the
+    whole-local-device mesh of the single-engine path; the replica set
+    ignores it and partitions submeshes itself (mesh_axes_for)."""
+    import dataclasses
+
+    from .engine.jax_engine import EngineConfig
+    from .models.loader import load_params
+
+    cfg = build_model_config(args)
+    ecfg = EngineConfig()
+    if args.model in (None, "tiny") and not args.model_path:
+        ecfg = EngineConfig(page_size=16, num_pages=256, max_batch=16,
+                            prefill_chunk=128, prefill_buckets=(128,),
+                            batch_buckets=(4, 16), page_buckets=(16,))
+    overrides = {}
+    if args.kv_cache_block_size:
+        overrides["page_size"] = args.kv_cache_block_size
+        # keep the chunk a page multiple (the page-granular KV commit
+        # invariant __post_init__ enforces)
+        overrides["prefill_chunk"] = max(
+            ecfg.prefill_chunk // args.kv_cache_block_size, 1
+        ) * args.kv_cache_block_size
+    if args.num_pages:
+        overrides["num_pages"] = args.num_pages
+    if args.max_batch_size:
+        overrides["max_batch"] = args.max_batch_size
+    if args.prefill_token_budget is not None:
+        overrides["prefill_token_budget"] = args.prefill_token_budget
+    if args.spec_decode:
+        overrides["spec_decode"] = True
+        overrides["spec_tokens"] = args.spec_tokens
+    if overrides:
+        # replace() re-runs __post_init__ — CLI overrides get the same
+        # validation as direct construction
+        ecfg = dataclasses.replace(ecfg, **overrides)
+    params = None
+    mesh = None
+    if args.coordinator:
+        from .parallel.mesh import initialize_multihost
+        initialize_multihost(args.coordinator, args.num_processes,
+                             args.process_id)
+        log.info("joined multi-host group %s as process %d/%d "
+                 "(%d global devices)", args.coordinator,
+                 args.process_id, args.num_processes,
+                 len(__import__("jax").devices()))
+    axes = mesh_axes_for(args)
+    if axes and getattr(args, "dp_replicas", 1) <= 1:
+        from .parallel.mesh import MeshSpec
+        mesh = MeshSpec(**axes).build()
+    if args.long_prefill_threshold is not None:
+        if axes.get("seq", 1) <= 1:
+            raise SystemExit(
+                "--long-prefill-threshold needs a seq mesh axis > 1 "
+                "(--sequence-parallel-size or --mesh-shape seq=N: the "
+                "ring prefill runs over the mesh's seq axis)")
+        ecfg = dataclasses.replace(
+            ecfg, long_prefill_threshold=args.long_prefill_threshold)
+    quant = "int8" if args.dtype == "int8" else None
+    if args.model_path:
+        try:
+            params = load_params(args.model_path, cfg, quant=quant)
+            quant = None  # already applied on the host at load
+        except FileNotFoundError:
+            log.warning("no weights at %s; random init", args.model_path)
+    return cfg, ecfg, params, quant, mesh
 
 
 def _load_python_engine(path: str, kind: str):
@@ -425,10 +461,19 @@ async def run_batch(args, path: str) -> None:
 
 async def run_worker(args, path: str) -> None:
     """``in=dyn://ns.comp[.ep]``: serve the engine as a discoverable model
-    worker (reference input/endpoint.rs worker mode)."""
+    worker (reference input/endpoint.rs worker mode). With
+    ``--dp-replicas N > 1`` (out=jax only) the process serves a dynashard
+    :class:`ShardedReplicaSet` instead: N mesh-sharded engine replicas on
+    partitioned submeshes, each its own worker instance behind the KV
+    router."""
     from .llm.worker import serve_openai_model
     from .runtime.component import EndpointAddress
 
+    if args.dp_replicas > 1:
+        if args.output != "jax":
+            raise SystemExit("--dp-replicas needs out=jax")
+        await _run_sharded_worker(args, path)
+        return
     engine, mdc, full = await asyncio.to_thread(build_engine, args)
     if full:
         raise SystemExit("worker mode needs a token-level engine "
@@ -445,6 +490,32 @@ async def run_worker(args, path: str) -> None:
     if hasattr(engine, "stop"):
         await engine.stop()
     await drt.shutdown()
+
+
+async def _run_sharded_worker(args, path: str) -> None:
+    """dynashard worker mode: N data-parallel sharded replicas of one
+    token-level component, each with its own lease/instance id and KV
+    publisher (parallel/serving.py)."""
+    from .parallel.serving import ShardedReplicaSet
+    from .runtime.component import EndpointAddress
+
+    addr = EndpointAddress.parse(path)
+    cfg, ecfg, params, quant, _mesh = await asyncio.to_thread(
+        _jax_engine_setup, args)
+    # card construction can read model files — off the event loop
+    mdc = await asyncio.to_thread(build_mdc, args)
+    mdc.kv_block_size = ecfg.page_size
+    replica_set = ShardedReplicaSet(
+        cfg, ecfg, mesh_axes=mesh_axes_for(args),
+        replicas=args.dp_replicas, namespace=addr.namespace,
+        component=addr.component, mdc=mdc,
+        dcp_address=args.dcp or env_str("DYN_DCP_ADDRESS"),
+        params=params, seed=args.seed, quant=quant,
+        warmup=not args.no_warmup)
+    await replica_set.start()
+    log.info("sharded worker serving %s: %s", path, replica_set.describe())
+    await _wait_for_signal()
+    await replica_set.stop()
 
 
 async def run_none(args) -> None:

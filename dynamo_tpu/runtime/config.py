@@ -186,6 +186,23 @@ register_env("DYN_FLEET_REPORT_DIR", None, "fleet",
              "Fleet simulator CLI: also write each run's JSON report "
              "into this directory (unset = stdout only).")
 
+register_env("DYN_DP_REPLICAS", "1", "parallel",
+             "dynashard: data-parallel engine replicas per process. Each "
+             "replica gets its own submesh of the local device set, its "
+             "own DistributedRuntime lease (= worker instance id) and its "
+             "own KV-event publisher behind the KV router.")
+register_env("DYN_FORCE_HOST_DEVICES", None, "parallel",
+             "CPU bring-up: force this many virtual host devices by "
+             "appending --xla_force_host_platform_device_count to "
+             "XLA_FLAGS. Must be applied BEFORE the jax backend "
+             "initializes (parallel.serving.apply_forced_host_devices; "
+             "the tier-1 sharded tests run in a subprocess for exactly "
+             "this reason).")
+register_env("DYN_MESH_SHAPE", None, "parallel",
+             "dynashard: per-replica device mesh as 'axis=N' pairs, e.g. "
+             "'model=2' or 'data=2,model=4' (axes: data/model/expert/"
+             "seq/stage — parallel/mesh.py). Unset = unsharded engines.")
+
 register_env("DYN_DISABLE_PALLAS", None, "models",
              "Any non-empty value forces the XLA gather attention path "
              "everywhere (Pallas kill switch).")
@@ -235,6 +252,10 @@ register_env("KUBERNETES_SERVICE_PORT", "443", "external",
 register_env("JAX_PLATFORMS", None, "external",
              "JAX backend selector; the SDK/bench pin control-plane "
              "processes to cpu so only TPU workers touch the chip.")
+register_env("XLA_FLAGS", None, "external",
+             "XLA runtime flags; read (never clobbered) by "
+             "parallel.serving.apply_forced_host_devices when appending "
+             "the DYN_FORCE_HOST_DEVICES device-count override.")
 
 
 class UnregisteredEnvVar(KeyError):

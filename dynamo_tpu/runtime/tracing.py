@@ -61,6 +61,15 @@ def current_request_id() -> Optional[str]:
     return _request_id.get()
 
 
+def current_span():
+    """The ambient (recording) span, or None — lets instrumented code
+    attach attributes to whatever span encloses it without threading span
+    objects through every call signature (dynashard stamps the serving
+    replica/mesh this way)."""
+    cur = _current.get()
+    return cur if cur is not None and cur.recording else None
+
+
 class NoopSpan:
     """Returned when a span is not sampled. Absorbs the full Span API at
     near-zero cost and suppresses descendant sampling decisions by

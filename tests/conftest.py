@@ -19,8 +19,11 @@ if not os.environ.get("DYN_TEST_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
+import sys  # noqa: E402
 
 import pytest  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture
@@ -29,5 +32,24 @@ def run_async():
 
     def _run(coro):
         return asyncio.run(coro)
+
+    return _run
+
+
+@pytest.fixture
+def device_subprocess(tmp_path):
+    """Write a worker script and run it in a subprocess whose XLA_FLAGS
+    force exactly N virtual CPU devices BEFORE jax imports (the flag is
+    read once at backend init, so in-process monkeypatching cannot do
+    this). Shared by test_tp_serving and test_sharded_serving — see
+    tests/device_harness.py."""
+    from device_harness import run_device_subprocess
+
+    def _run(source: str, *args, devices: int = 8, timeout: float = 600,
+             env: dict = None):
+        script = tmp_path / "device_worker.py"
+        script.write_text(source)
+        return run_device_subprocess(script, args, devices=devices,
+                                     timeout=timeout, env_extra=env)
 
     return _run

@@ -1,0 +1,52 @@
+"""Shared launcher for forced-device-count subprocess tests.
+
+XLA reads ``--xla_force_host_platform_device_count`` exactly once, at
+backend initialization — monkeypatching ``XLA_FLAGS`` inside an already-
+running test process is silently ignored. Tests that need a SPECIFIC
+device count regardless of the ambient environment (the dynashard
+sharded-serving e2e, the multi-host bootstrap smoke) therefore run their
+scenario in a subprocess whose environment is assembled here, before any
+jax import can happen. One place instead of per-test copy-paste
+(ISSUE 12 satellite: test_tp_serving and test_sharded_serving share
+this).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def forced_device_env(devices: int, **extra: object) -> dict:
+    """A subprocess environment pinned to ``devices`` virtual CPU
+    devices. ``devices <= 1`` strips the forcing flag entirely (one real
+    CPU device per process — what the multi-host bootstrap needs)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    if devices > 1:
+        env["XLA_FLAGS"] = \
+            f"--xla_force_host_platform_device_count={devices}"
+        env["DYN_FORCE_HOST_DEVICES"] = str(devices)
+    else:
+        env.pop("DYN_FORCE_HOST_DEVICES", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def run_device_subprocess(script_path, args: Sequence = (), *,
+                          devices: int = 8, timeout: float = 600,
+                          env_extra: Optional[dict] = None
+                          ) -> subprocess.CompletedProcess:
+    """Run ``script_path`` under :func:`forced_device_env`. stderr is
+    folded into stdout so an assertion message shows the whole story."""
+    env = forced_device_env(devices, **(env_extra or {}))
+    return subprocess.run(
+        [sys.executable, str(script_path), *map(str, args)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=timeout)

@@ -51,6 +51,8 @@ def record_of(fn, *a):
     ({"sweep": "32:64:4"}, "tok/s"),
     ({"sweep": "32:64:4", "scenario": "multiturn"}, "tok/s"),  # sweep wins
     ({"model": "8b", "dtype": "int8"}, "tok/s"),
+    ({"scenario": "sharded", "dp_replicas": 2, "mesh": "model=2"},
+     "tok/s"),
 ])
 def test_emit_unavailable_matches_metric_name(over, unit):
     """A chip-unavailable record must carry the SAME metric label (and a

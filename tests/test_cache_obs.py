@@ -254,7 +254,9 @@ def test_stats_prometheus_sync_gate(run_async):
     sentinels = {}
     fpm = ForwardPassMetrics()
     for i, name in enumerate(sorted(fpm_fields)):
-        if isinstance(getattr(fpm, name), dict):
+        if isinstance(getattr(fpm, name), (dict, str)):
+            # dicts render as labeled families; strings are identity
+            # LABELS (worker_label/mesh_shape — dynashard), not counters
             continue
         val = 900000 + i if isinstance(getattr(fpm, name), int) \
             else round(0.5 + i / 1000.0, 3)

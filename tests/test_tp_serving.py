@@ -8,7 +8,6 @@ Reference parity: vLLM multi-node TP rode a Ray head/follower bootstrap
 jax.distributed forms the global mesh (SURVEY §5 comm backend).
 """
 
-import os
 import socket
 import subprocess
 import sys
@@ -133,17 +132,18 @@ MULTIHOST_WORKER = textwrap.dedent("""
 def test_multihost_two_process_smoke(tmp_path):
     """Two real processes join via initialize_multihost (the Ray-bootstrap
     replacement) and run a jitted collective over the global 2-device CPU
-    mesh."""
+    mesh. Environment assembly rides the shared forced-device-count
+    harness (tests/device_harness.py): devices=1 strips XLA_FLAGS so each
+    process contributes exactly one CPU device."""
+    from device_harness import forced_device_env
+
     script = tmp_path / "worker.py"
     script.write_text(MULTIHOST_WORKER)
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     coord = f"127.0.0.1:{port}"
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # one CPU device per process
-    env["PYTHONPATH"] = "/root/repo"
+    env = forced_device_env(devices=1)
     procs = [subprocess.Popen([sys.executable, str(script), coord, str(i)],
                               env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True)

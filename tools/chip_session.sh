@@ -68,5 +68,17 @@ run_step disagg 2400 --scenario disagg
 #    ingest time in the breakdown fields (lossy, opt-in)
 DYN_KV_TRANSFER_INT8=1 run_step disagg_int8 2400 --scenario disagg
 
+# 9. dynashard sharded serving A/B (ISSUE 12 / ROADMAP item 3): one
+#    unsharded engine vs data-parallel mesh-sharded replicas behind the
+#    real HTTP + KV-router stack at identical workload. On a single
+#    chip this degrades to wiring validation; on a multi-chip slice the
+#    tok/s ratio is the headline. Compile counts must stay 0 per
+#    replica (the under-sharding fence contract).
+run_step sharded_tp2 2400 --scenario sharded --mesh model=2 --dp-replicas 2
+# 10. the 8B north-star across a model=2 submesh: int8 8B ≈ 12.8 GB of
+#     16 GB HBM on one chip — model-parallel removes the squeeze
+run_step sharded_8b 3600 --scenario sharded --model 8b --dtype int8 \
+    --mesh model=2 --dp-replicas 1 --concurrency 16
+
 echo "=== chip session complete; results in $OUT/ ==="
 grep -h . "$OUT"/*.json 2>/dev/null | head -20
