@@ -78,6 +78,12 @@ def metric_name(args) -> str:
                 f"the real HTTP->KV-router->engine stack "
                 f"({args.users}u x {args.turns}w, {_model_tag(args)} "
                 f"llama, {smoke})")
+    if args.scenario == "failover":
+        smoke = "cpu smoke" if getattr(args, "cpu", False) else "1 chip"
+        return (f"goodput tok/s under mid-burst worker kill with "
+                f"mid-stream failover (2 workers, ISL~{args.isl}/OSL "
+                f"{args.osl}, {args.requests} reqs) + shed rate under 2x "
+                f"overload ({_model_tag(args)} llama, {smoke})")
     return ("output tokens/s, synthetic ShareGPT "
             f"(ISL~{args.isl}/OSL {args.osl}, {args.requests} reqs, "
             f"conc {args.concurrency}, {_model_tag(args)} llama, 1 chip)")
@@ -90,9 +96,9 @@ def metric_unit(args) -> str:
     paths all call this)."""
     if getattr(args, "spec", False) or getattr(args, "sweep", None):
         return "tok/s"
-    return {"multiturn": "ms", "disagg": "ratio",
-            "shared": "rate", "sharded": "tok/s"}.get(args.scenario,
-                                                      "tok/s")
+    return {"multiturn": "ms", "disagg": "ratio", "shared": "rate",
+            "sharded": "tok/s", "failover": "tok/s"}.get(args.scenario,
+                                                         "tok/s")
 
 
 def emit_unavailable(args, reason: str) -> None:
@@ -190,7 +196,7 @@ def parse_args():
                     help="fused decode window (amortizes dispatch latency)")
     ap.add_argument("--scenario", default="sharegpt",
                     choices=["sharegpt", "multiturn", "disagg", "shared",
-                             "sharded"],
+                             "sharded", "failover"],
                     help="multiturn = conversations with growing shared "
                          "prefixes (the KV-offload TTFT scenario, "
                          "reference docs/architecture.md:91-96); "
@@ -206,7 +212,13 @@ def parse_args():
                          "behind the real HTTP frontend + KV router at "
                          "identical workload (tok/s, mesh_shape, "
                          "per-replica device_time_fraction, compile "
-                         "counts)")
+                         "counts); "
+                         "failover = dynarevive robustness bench: a "
+                         "2-worker pool behind the KV router with one "
+                         "worker killed mid-burst (goodput under churn + "
+                         "resume-stall p99 via mid-stream failover) and a "
+                         "2x-overload wave against SLO-aware admission "
+                         "control (shed rate + admitted TTFT p99)")
     ap.add_argument("--mesh", default=None,
                     help="sharded scenario: per-replica mesh as 'axis=N' "
                          "pairs (e.g. 'model=2'; default DYN_MESH_SHAPE "
@@ -1003,6 +1015,283 @@ async def run_sharded(args):
     return report
 
 
+def _pctile(vals, q):
+    """Deterministic nearest-rank percentile; None on empty."""
+    import math
+
+    if not vals:
+        return None
+    vs = sorted(vals)
+    rank = max(int(math.ceil(q / 100.0 * len(vs))), 1)
+    return vs[rank - 1]
+
+
+async def run_failover(args):
+    """dynarevive robustness bench: two workers behind the real
+    aiohttp → HttpService → Processor → KvRouter → generate_tokens
+    stack. Phase 1 (churn): one worker is killed mid-burst — mid-stream
+    failover must resume its streams on the sibling with zero client
+    errors; reports goodput under churn and resume-stall p99 (the
+    client-visible gap the failover inserts). Phase 2 (overload): 2x the
+    surviving capacity is thrown at the frontend with SLO-aware
+    admission control on; reports shed rate and admitted-TTFT p99 (the
+    point of shedding: the requests we DO admit stay fast)."""
+    import aiohttp
+    import json as _json
+    import random as _random
+
+    import numpy as np
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.processor import Processor
+    from dynamo_tpu.llm.worker import serve_token_model
+    from dynamo_tpu.runtime import profiling, revive
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    cfg, ecfg, params, quant = engine_setup(args)
+    rng = np.random.RandomState(args.seed)
+    cap = ecfg.page_buckets[-1] * ecfg.page_size
+    # the resume prompt is prompt + emitted: keep isl + osl inside the
+    # warmed grid so failover never trips the compile fence
+    isl = max(min(args.isl, cap - 2 * args.osl - 16), 32)
+    prompts = [_word_text(rng, isl) for _ in range(args.requests)]
+    mdc = ModelDeploymentCard(name="bench", tokenizer_kind="byte",
+                              kv_block_size=ecfg.page_size,
+                              model_type="completions")
+
+    drt = await DistributedRuntime.detached()
+    drt2 = await DistributedRuntime.attach(drt.dcp.address)
+    engines, handles, pubs = [], [], []
+    service = kvr = token_client = admission = None
+    try:
+        for i, d in enumerate((drt, drt2)):
+            # same seed → identical weights on both workers (the greedy
+            # resume token-identity contract needs sibling equivalence)
+            eng = JaxEngine(cfg, ecfg, seed=args.seed, params=params,
+                            quant=quant, worker_label=f"w{i}")
+            print(f"warming up worker {i}...", file=sys.stderr)
+            # the compile fence is process-global: mask the already-armed
+            # siblings while this worker warms up (the dynashard join
+            # idiom) so per-worker compile counts stay meaningful
+            live_fences = [e.fence for e in engines]
+            for f in live_fences:
+                f.disarm()
+            try:
+                await asyncio.to_thread(eng.warmup)
+            finally:
+                for f in live_fences:
+                    f.arm()
+            handle, pub = await serve_token_model(
+                d, mdc, eng, namespace="bench", component="fo")
+            engines.append(eng)
+            handles.append(handle)
+            pubs.append(pub)
+        # production shape: the scrape loop runs, so the dead worker
+        # drops out of the scheduler and optimistic slot accounting
+        # resets as real occupancy comes back
+        kvr = KvRouter(drt, "bench", "fo", block_size=ecfg.page_size,
+                       scrape_interval=0.25, seed=args.seed)
+        await kvr.start(run_loop=True)
+        await kvr.scrape_once()
+        token_client = await drt.namespace("bench").component("fo") \
+            .endpoint("generate_tokens").client()
+        processor = Processor(mdc, token_client, kvr)
+
+        def signals():
+            live = [e.stats() for e in engines if not e.draining]
+            if not live:
+                return revive.LoadSignals()
+            return revive.LoadSignals(
+                queue_depth=sum(s["num_requests_waiting"] for s in live),
+                workers=len(live),
+                loop_lag_p99_ms=max(s["loop_lag_p99_seconds"]
+                                    for s in live) * 1000.0,
+                kv_free_blocks=min(s["kv_free_blocks"] for s in live))
+
+        admission = revive.AdmissionController(
+            signals,
+            cfg=revive.ShedConfig(
+                queue_depth=max(ecfg.max_batch // 4, 2)),
+            rng=_random.Random(args.seed))
+        service = HttpService()  # churn phase: no shedding
+        service.manager.add_completions_model("bench",
+                                              processor.completion)
+        await service.start(host="127.0.0.1", port=0)
+
+        async def one(http, i, prompt, rows, tag, osl):
+            rid = f"{tag}-{i:04d}"
+            t0 = time.monotonic()
+            first = last = None
+            max_gap = 0.0
+            chars = 0
+            errored = False
+            async with http.post(
+                    f"http://127.0.0.1:{service.port}/v1/completions",
+                    json={"model": "bench", "prompt": prompt,
+                          "stream": True, "max_tokens": osl},
+                    headers={"X-Request-Id": rid}) as resp:
+                if resp.status == 503:
+                    rows.append({"rid": rid, "shed": True, "error": False,
+                                 "ttft": None, "max_gap": 0.0, "chars": 0})
+                    return
+                if resp.status != 200:
+                    rows.append({"rid": rid, "shed": False, "error": True,
+                                 "ttft": None, "max_gap": 0.0, "chars": 0})
+                    return
+                async for raw in resp.content:
+                    line = raw.strip()
+                    if line == b"data: [DONE]":
+                        break
+                    if line.startswith(b"event: error"):
+                        errored = True
+                        continue
+                    if not line.startswith(b"data: "):
+                        continue
+                    chunk = _json.loads(line[len(b"data: "):])
+                    piece = "".join(c.get("text") or ""
+                                    for c in chunk.get("choices", []))
+                    if piece:
+                        now = time.monotonic()
+                        if first is None:
+                            first = now - t0
+                        elif last is not None:
+                            max_gap = max(max_gap, now - last)
+                        last = now
+                        chars += len(piece)  # byte tokenizer: chars==tokens
+            rows.append({"rid": rid, "shed": False, "error": errored,
+                         "ttft": first, "max_gap": max_gap,
+                         "chars": chars})
+
+        # ---------------------------------------- phase 1: churn (kill)
+        resumed_before = revive.journal().resumed_total
+        rows1: list = []
+        killed = []
+
+        async def killer():
+            # wait for the victim to be loaded and mid-decode, then die
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if handles[0].inflight > 0 and \
+                        engines[0].decode_tokens_total >= args.osl:
+                    await handles[0].die()
+                    engines[0].draining = True  # capacity is gone for real
+                    killed.append(time.monotonic())
+                    return
+                await asyncio.sleep(0.005)
+
+        async with aiohttp.ClientSession() as http:
+            t0 = time.monotonic()
+            ktask = asyncio.ensure_future(killer())
+            await asyncio.gather(*(one(http, i, p, rows1, "churn",
+                                       args.osl)
+                                   for i, p in enumerate(prompts)))
+            wall1 = time.monotonic() - t0
+            await ktask
+
+            resumed_rows = []
+            for r in rows1:
+                cost = profiling.request_attribution(r["rid"]) or {}
+                if cost.get("resumed_attempts"):
+                    resumed_rows.append(r)
+            ok1 = [r for r in rows1 if not r["error"] and not r["shed"]]
+            churn = {
+                "requests": len(rows1),
+                "completed": len(ok1),
+                "errors": sum(1 for r in rows1 if r["error"]),
+                "worker_killed": bool(killed),
+                "resumed": len(resumed_rows),
+                "goodput_tok_per_s": round(
+                    sum(r["chars"] for r in ok1) / wall1, 1)
+                if wall1 else 0.0,
+                "resume_stall_p99_ms": (round(_pctile(
+                    [r["max_gap"] for r in resumed_rows], 99) * 1000, 1)
+                    if resumed_rows else None),
+                "ttft_p99_ms": (round(_pctile(
+                    [r["ttft"] for r in ok1 if r["ttft"] is not None],
+                    99) * 1000, 1) if ok1 else None),
+            }
+            print(_json.dumps({"churn": churn}), file=sys.stderr)
+
+            # ------------------------------- phase 2: 2x overload, shed
+            # sustained 2x the survivor's slot capacity in flight (not
+            # one instantaneous burst): later arrivals see the queues the
+            # earlier ones built, which is what the shed signals read
+            service.set_admission(admission)
+            admission.start(0.02)  # peak-hold sampler between arrivals
+            n2 = 4 * ecfg.max_batch
+            sem2 = asyncio.Semaphore(2 * ecfg.max_batch)
+            prompts2 = [_word_text(rng, isl) for _ in range(n2)]
+            rows2: list = []
+            osl2 = max(args.osl // 2, 8)
+
+            async def over(i, p):
+                async with sem2:
+                    await one(http, i, p, rows2, "over", osl2)
+
+            t0 = time.monotonic()
+            await asyncio.gather(*(over(i, p)
+                                   for i, p in enumerate(prompts2)))
+            wall2 = time.monotonic() - t0
+            shed = [r for r in rows2 if r["shed"]]
+            admitted = [r for r in rows2
+                        if not r["shed"] and not r["error"]]
+            overload = {
+                "requests": n2,
+                "overload_factor": 2.0,
+                "shed": len(shed),
+                "shed_rate": round(len(shed) / max(n2, 1), 3),
+                "admitted": len(admitted),
+                "errors": sum(1 for r in rows2 if r["error"]),
+                "admitted_ttft_p99_ms": (round(_pctile(
+                    [r["ttft"] for r in admitted
+                     if r["ttft"] is not None], 99) * 1000, 1)
+                    if admitted else None),
+                "goodput_tok_per_s": round(
+                    sum(r["chars"] for r in admitted) / wall2, 1)
+                if wall2 else 0.0,
+                "shed_by_signal": dict(sorted(
+                    admission.shed_by_signal.items())),
+            }
+            print(_json.dumps({"overload": overload}), file=sys.stderr)
+
+        report = {
+            "scenario": "failover",
+            "workers": 2,
+            "isl": isl, "osl": args.osl,
+            "churn": churn,
+            "overload": overload,
+            "revive_resumes": revive.journal().resumed_total
+            - resumed_before,
+            # the surviving replica must never compile mid-failover: the
+            # resume prompt stays on the warmed grid
+            "post_warmup_compiles": {
+                f"w{i}": e.fence.post_warmup_compiles
+                for i, e in enumerate(engines)},
+        }
+        print(_json.dumps(report), file=sys.stderr)
+        return report
+    finally:
+        if admission is not None:
+            await admission.stop()
+        if service is not None:
+            await service.stop()
+        if kvr is not None:
+            await kvr.stop()
+        if token_client is not None:
+            await token_client.close()
+        for pub in pubs:
+            await pub.stop()
+        for handle in handles:
+            await handle.stop()
+        for eng in engines:
+            await eng.stop()
+        await drt2.shutdown()
+        await drt.shutdown()
+
+
 def env_str_cfg(name):
     from dynamo_tpu.runtime.config import env_str
 
@@ -1588,6 +1877,12 @@ def _run_scenario(args) -> dict:
                 "unit": metric_unit(args),
                 "vs_baseline":
                     report["sharded_over_unsharded_tok_per_s"],
+                "detail": report}
+    if args.scenario == "failover":
+        report = asyncio.run(run_failover(args))
+        return {"metric": metric_name(args),
+                "value": report["churn"]["goodput_tok_per_s"],
+                "unit": metric_unit(args), "vs_baseline": 1.0,
                 "detail": report}
     report = asyncio.run(run_bench(args))
     # vs_baseline: reference publishes no absolute numbers —

@@ -45,7 +45,7 @@ from ..llm.protocols.common import (FINISH_CANCELLED, FINISH_EOS,
 from ..models.config import ModelConfig
 from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
-from ..runtime import profiling, tracing
+from ..runtime import guard, profiling, tracing
 from ..runtime.config import env_int
 from ..runtime.engine import Context
 from .jit_fence import CompileFence
@@ -472,6 +472,9 @@ class JaxEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
         self._stopped = False
+        # dynarevive graceful drain: a draining engine refuses new work
+        # (typed NoCapacity) while in-flight sequences run to completion
+        self.draining = False
         self._exec = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="jax-step")
         # observability (ForwardPassMetrics analog, kv_router/protocols.rs)
@@ -766,12 +769,46 @@ class JaxEngine:
             await profiling.release_loop_profiler()
         self._exec.shutdown(wait=False)
 
+    async def drain(self, timeout_s: float = 10.0) -> bool:
+        """dynarevive graceful drain: refuse new work (``generate``
+        raises typed NoCapacity) and run every in-flight sequence to its
+        natural finish, bounded by ``timeout_s``. On timeout, leftovers
+        are cancelled on the normal cancel path (pages free, clients get
+        a "cancelled" finish). Returns True when everything finished
+        inside the budget. The engine keeps running — call ``stop()``
+        afterwards to end the scheduler loop."""
+        self.draining = True
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(timeout_s, 0.0)
+
+        def busy() -> bool:
+            return bool(self.waiting or self.prefilling or self.running
+                        or self._inflight or self._pending_prefill)
+
+        while busy() and loop.time() < deadline:
+            await asyncio.sleep(0.02)
+        drained = not busy()
+        if not drained:
+            log.warning("engine drain timed out with work in flight "
+                        "(waiting=%d prefilling=%d running=%d); "
+                        "cancelling leftovers", len(self.waiting),
+                        len(self.prefilling), len(self.running))
+            for seq in self.waiting + self.prefilling + self.running:
+                seq.context.kill()
+            self._wake.set()
+        return drained
+
     # ------------------------------------------------------ AsyncEngine API
 
     async def generate(self, request: PreprocessedRequest,
                        context: Context) -> AsyncIterator[EngineOutput]:
         if not isinstance(request, PreprocessedRequest):
             request = PreprocessedRequest.from_dict(request)
+        if self.draining:
+            # typed refusal (HTTP 503 + Retry-After upstream): a
+            # draining engine admits nothing new while in-flight
+            # sequences finish
+            raise guard.NoCapacity("engine draining")
         self.start()
         if self.worker_label or self.mesh_devices > 1:
             # dynashard: stamp which replica/submesh serves this request
@@ -919,6 +956,14 @@ class JaxEngine:
                 self._wake.clear()
                 await self._wake.wait()
                 continue
+            if guard.chaos() is not None:
+                # worker-scoped chaos (dynarevive): a delay rule on
+                # `engine.stall` freezes the scheduler loop for its ms —
+                # the kill-mid-decode / stalled-worker scenarios in the
+                # same seeded grammar as the transport faults. The
+                # `guard.chaos() is not None` gate keeps the hot path
+                # free of the coroutine when no chaos is configured.
+                await guard.chaos_point("engine.stall")
             try:
                 self._admit()
                 await loop.run_in_executor(self._exec, self._step)

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import Dict, List, Optional, Set
 
 from ..llm.http.service import HttpService
@@ -43,6 +44,7 @@ from ..metrics.component import MetricsAggregator
 from ..parallel.serving import DevicePool, NoFreeDevices
 from ..planner.planner import Planner, WatchTarget
 from ..planner.policy import PLANNER_KV_PREFIX
+from ..runtime import revive
 from ..runtime.component import Client
 from ..runtime.config import env_float
 from ..runtime.dcp_client import pack, unpack
@@ -89,6 +91,9 @@ class FleetSim:
                 range(scenario.device_pool_size))
         self._sharding_events: List[dict] = []
         self._max_devices_in_use = 0
+        # dynarevive: SLO-aware shed controller (wired in setup() when
+        # the scenario sets shed_queue_depth)
+        self.admission: Optional[revive.AdmissionController] = None
         self._discovery_timeout = env_float(
             "DYN_FLEET_DISCOVERY_TIMEOUT") or 10.0
         # wired in setup()
@@ -148,7 +153,21 @@ class FleetSim:
             .component(COMPONENT).endpoint("generate_tokens").client()
         processor = Processor(mdc, self.token_client, self.router)
 
-        self.service = HttpService()
+        if sc.shed_queue_depth > 0:
+            # dynarevive admission control over the aggregator's view,
+            # with a seeded rng so the jittered Retry-After (and thus the
+            # report) stays byte-identical per seed
+            # window=4: signals refresh once per virtual step (scrape),
+            # so a long peak-hold would keep shedding for many steps
+            # after a burst clears; the sim never runs the wall-clock
+            # sampler task
+            self.admission = revive.AdmissionController(
+                lambda: revive.signals_from_metrics(
+                    self.agg.worker_metrics),
+                cfg=revive.ShedConfig(queue_depth=sc.shed_queue_depth),
+                rng=random.Random(self.seed ^ 0x5EED),
+                window=4)
+        self.service = HttpService(admission=self.admission)
         self.service.manager.add_completions_model(MODEL,
                                                    processor.completion)
         await self.service.start(host="127.0.0.1", port=0)
@@ -194,16 +213,23 @@ class FleetSim:
         rec = self.scorer.record(rid)
         if rec is None:
             return
+        # first-stamp-wins on arrival/admission/first-token: a resumed
+        # request (dynarevive failover re-submits the same rid on a
+        # sibling worker) keeps its ORIGINAL latency stamps — TTFT is
+        # what the client saw, not what the resume saw
         if event == "enqueued":
             rec.worker = worker
-            rec.arrival_vt = vt
+            if rec.arrival_vt is None:
+                rec.arrival_vt = vt
             ev = self._enqueued.get(rid)
             if ev is not None:
                 ev.set()
         elif event == "admitted":
-            rec.admitted_vt = vt
+            if rec.admitted_vt is None:
+                rec.admitted_vt = vt
         elif event == "first_token":
-            rec.first_token_vt = vt
+            if rec.first_token_vt is None:
+                rec.first_token_vt = vt
         elif event == "done":
             rec.done_vt = vt
             rec.tokens_out = self._max_tokens.get(rid, 0)
@@ -221,6 +247,12 @@ class FleetSim:
                     f"{self._base_url}/v1/completions", json=body,
                     headers={"X-Request-Id": spec.rid}) as resp:
                 rec.http_status = resp.status
+                if resp.status == 503:
+                    # admission control answered an early 503 with
+                    # Retry-After: shed, not failed — the client was
+                    # told when to come back
+                    rec.status = "shed"
+                    return
                 if resp.status != 200:
                     rec.status = "failed"
                     return
@@ -231,11 +263,18 @@ class FleetSim:
                         errored = True
                     elif line == b"data: [DONE]":
                         break
-                if rec.status == "pending":
-                    rec.status = "failed" if errored else "ok"
+                if rec.status in ("pending", "crashed"):
+                    if errored:
+                        rec.status = "failed"
+                    else:
+                        # a "crashed" record whose stream still finished
+                        # clean is a dynarevive mid-stream failover: the
+                        # worker died, the resume completed the stream
+                        rec.resumed = rec.status == "crashed"
+                        rec.status = "ok"
         except Exception:
             log.debug("client request %s failed", spec.rid, exc_info=True)
-            if rec.status == "pending":
+            if rec.status in ("pending", "crashed"):
                 rec.status = "failed"
 
     async def _inject(self, step: int) -> None:
@@ -353,6 +392,17 @@ class FleetSim:
                     worker = live[min(fault.arg, len(live) - 1)]
                     await worker.crash()
                     self.scorer.worker_event(vt, "crash", worker.name)
+            elif fault.kind == "drain":
+                # rolling-restart wave: graceful drain of one live
+                # worker — discovery out, in-flight finishes, the
+                # router must never route to it again (dynarevive)
+                live = self.controller.live
+                if live:
+                    worker = live[min(fault.arg, len(live) - 1)]
+                    # sim-model lifecycle drain, not a socket drain
+                    await worker.drain()  # dynalint: disable=unbounded-await
+                    self.scorer.worker_event(vt, "drain", worker.name)
+                    await self._sync_discovery()
             elif fault.kind == "join":
                 try:
                     name = await self.controller._spawn()
@@ -462,6 +512,25 @@ class FleetSim:
             # scenarios like hot-tenant can assert both views agree
             "cache": self._cache_block(),
         }
+        if self.admission is not None or any(
+                f.kind in ("crash", "drain") for f in self.scenario.faults):
+            # dynarevive plane: mid-stream failover + drain + shed story
+            # of the run (scorer-derived counts only — process-global
+            # revive counters never enter the report, keeping seeded
+            # runs byte-identical across processes)
+            recs = self.scorer.records.values()
+            extra["failover"] = {
+                "resumed_requests": len([r for r in recs if r.resumed]),
+                "still_crashed": len([r for r in recs
+                                      if r.status == "crashed"]),
+                "shed_requests": len([r for r in recs
+                                      if r.status == "shed"]),
+                "shed_by_signal": (dict(sorted(
+                    self.admission.shed_by_signal.items()))
+                    if self.admission else {}),
+                "drains": [e for e in self.scorer.worker_events
+                           if e["event"] == "drain"],
+            }
         if self.device_pool is not None:
             # dynashard plane: the submesh-assignment story of the run —
             # every partition/release with its virtual timestamp, the

@@ -35,8 +35,13 @@ class RequestRecord:
     first_token_vt: Optional[float] = None
     done_vt: Optional[float] = None
     tokens_out: int = 0
-    status: str = "pending"         # pending | ok | failed | crashed
+    # pending | ok | failed | crashed | shed. "crashed" is transient
+    # under dynarevive: a mid-stream failover that completes flips it to
+    # "ok" with resumed=True; "shed" = admission control answered an
+    # early 503 (not a failure — the client was told to come back)
+    status: str = "pending"
     http_status: Optional[int] = None
+    resumed: bool = False           # completed via mid-stream failover
 
     @property
     def queue_wait(self) -> Optional[float]:
@@ -185,6 +190,8 @@ class SloScorer:
                 "completed": len([r for r in recs if r.status == "ok"]),
                 "failed": len([r for r in recs
                                if r.status in ("failed", "crashed")]),
+                "shed": len([r for r in recs if r.status == "shed"]),
+                "resumed": len([r for r in recs if r.resumed]),
                 "tokens_out": sum(r.tokens_out for r in recs),
             },
             "phases": phases,

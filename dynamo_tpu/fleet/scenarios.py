@@ -27,6 +27,8 @@ class FaultEvent:
     """One scripted fault, applied at the start of ``step``.
 
     kinds: ``crash`` (crash the ``arg``-th live worker, mid-stream),
+    ``drain`` (gracefully drain the ``arg``-th live worker — discovery
+    out first, in-flight finishes; the dynarevive rolling-restart wave),
     ``join`` (spawn one extra worker outside the planner loop — delayed
     join), ``blackout_start`` / ``blackout_end`` (all live workers stop /
     resume answering stats scrapes), ``flap_start`` / ``flap_end``
@@ -68,6 +70,10 @@ class Scenario:
     # device_pool_size // devices_per_replica replicas fit.
     devices_per_replica: int = 0
     device_pool_size: int = 0
+    # dynarevive: SLO-aware admission control — shed (early 503 +
+    # seeded jittered Retry-After) once the fleet-wide admission queue
+    # exceeds this many waiting requests PER live worker. 0 = off.
+    shed_queue_depth: int = 0
 
 
 def _smoke() -> Scenario:
@@ -240,6 +246,37 @@ def _sharded() -> Scenario:
     )
 
 
+def _failover() -> Scenario:
+    """dynarevive end-to-end: a loaded worker is killed mid-burst and a
+    rolling-drain wave follows. Mid-stream failover must resume every
+    crashed stream on a sibling (zero failed requests, nonzero resumed
+    count), drains must finish their in-flight work without the router
+    ever routing to them, admission control sheds the overflow with
+    jittered Retry-After instead of letting the queue melt, and the SLO
+    must recover after the wave — byte-identical per seed like every
+    other scenario."""
+    steps = 44
+    return Scenario(
+        name="failover", steps=steps,
+        traffic=lambda seed: burst(seed, steps=steps, base_rate=2.0,
+                                   burst_rate=7.0, burst_start=8,
+                                   burst_end=22, max_tokens=12),
+        initial_workers=3,
+        profile=WorkerProfile(slots=3, tokens_per_step=6),
+        planner=PlannerConfig(min_replicas=3, max_replicas=6,
+                              waiting_per_worker_high=2.0,
+                              scale_up_cooldown_s=6.0,
+                              scale_down_cooldown_s=60.0),
+        faults=[FaultEvent(step=12, kind="crash", arg=0),
+                # rolling-drain wave through the survivors
+                FaultEvent(step=18, kind="drain", arg=0),
+                FaultEvent(step=24, kind="drain", arg=0)],
+        slo=SloTargets(ttft_p95=5.0, queue_wait_p95=4.0),
+        disturb_end_step=24,
+        shed_queue_depth=4,
+    )
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "smoke": _smoke,
     "burst": _burst,
@@ -250,6 +287,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "breaker": _breaker,
     "join": _join,
     "sharded": _sharded,
+    "failover": _failover,
 }
 
 

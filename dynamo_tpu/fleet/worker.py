@@ -348,13 +348,15 @@ class SimWorker:
         self.model.blackout = on
 
     async def drain(self) -> None:
-        """Leave discovery; in-flight requests keep stepping to done."""
+        """dynarevive graceful drain: leave discovery (no new
+        admissions; the handle nacks stragglers) while in-flight
+        requests keep stepping to done and their streams finish clean.
+        The handle stays owned so ``stop()`` (via retire_idle_drained,
+        once the model is idle) completes the state machine."""
         self.draining = True
-        # claim the handle before the await: drain/stop racing each
-        # other at the handle.stop() must not double-stop it
-        handle, self._handle = self._handle, None
+        handle = self._handle
         if handle:
-            await handle.stop()
+            await handle.begin_drain()
 
     async def stop(self) -> None:
         handle, self._handle = self._handle, None

@@ -19,7 +19,7 @@ from typing import AsyncIterator, Callable, Dict, Optional
 
 from aiohttp import web
 
-from ...runtime import guard, profiling, tracing
+from ...runtime import guard, profiling, revive, tracing
 from ...runtime.dcp_client import NoRespondersError
 from ...runtime.engine import Annotated, Context
 from ...runtime.tasks import spawn_tracked
@@ -64,9 +64,14 @@ class ModelManager:
 
 class HttpService:
     def __init__(self, manager: Optional[ModelManager] = None,
-                 metrics: Optional[Metrics] = None):
+                 metrics: Optional[Metrics] = None,
+                 admission: Optional[revive.AdmissionController] = None):
         self.manager = manager or ModelManager()
         self.metrics = metrics or Metrics()
+        # dynarevive SLO-aware admission control: shed load (early 503 +
+        # load-derived jittered Retry-After) before the engines melt.
+        # None = admit everything (wire one with set_admission()).
+        self.admission = admission
         self.app = web.Application()
         self.app.add_routes([
             web.post("/v1/chat/completions", self._chat),
@@ -79,17 +84,33 @@ class HttpService:
             web.get("/debug/profile/stacks", self._debug_stacks),
             web.post("/debug/profile/start", self._profile_start),
             web.post("/debug/profile/stop", self._profile_stop),
+            web.post("/drain", self._drain),
             web.get("/metrics", self._metrics),
             web.get("/health", self._health),
             web.get("/live", self._health),
         ])
         self._runner: Optional[web.AppRunner] = None
         self.port = 0
+        # dynarevive graceful drain: POST /drain flips this — new
+        # requests get 503 while the registered drain callbacks run
+        # (serve handles / local engines finishing their in-flight work)
+        self.draining = False
+        self._drain_cbs: list = []
         # on-demand jax.profiler capture state (/debug/profile/start)
         self._jax_trace_dir: Optional[str] = None
         # summarize finished dyntrace spans into the per-stage duration
         # histograms (dyn_llm_http_service_stage_duration_seconds)
         tracing.get_tracer().add_listener(self._on_span_end)
+
+    def set_admission(self,
+                      admission: Optional[revive.AdmissionController]
+                      ) -> None:
+        self.admission = admission
+
+    def on_drain(self, cb) -> None:
+        """Register an async zero-arg drain callback run by POST /drain
+        (in registration order) after new admissions stop."""
+        self._drain_cbs.append(cb)
 
     def _on_span_end(self, span) -> None:
         if span.duration_s is not None:
@@ -119,9 +140,33 @@ class HttpService:
     # ------------------------------------------------------------- handlers
 
     async def _health(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "healthy",
-                                  "models": [m.id for m in
-                                             self.manager.list_models().data]})
+        return web.json_response({
+            "status": "draining" if self.draining else "healthy",
+            "models": [m.id for m in self.manager.list_models().data]})
+
+    async def _drain(self, request: web.Request) -> web.Response:
+        """dynarevive graceful drain: stop admitting (every new request
+        503s with Retry-After), then run the registered drain callbacks
+        — worker handles finishing in-flight sequences bounded by
+        DYN_DRAIN_TIMEOUT_MS, KV event flushes, engine drains."""
+        if self.draining:
+            return web.json_response({"draining": True,
+                                      "already": True}, status=409)
+        self.draining = True
+        log.info("POST /drain: shedding new requests, running %d drain "
+                 "callbacks", len(self._drain_cbs))
+        results = []
+        for cb in self._drain_cbs:
+            try:
+                results.append(await cb())
+            except Exception as e:  # noqa: BLE001 — drain every target
+                # even when one callback fails; report, don't abort
+                log.exception("drain callback failed")
+                results.append(f"error: {e!r}")
+        return web.json_response({"draining": True, "results":
+                                  [r if isinstance(r, (bool, str, int,
+                                                       float, type(None)))
+                                   else repr(r) for r in results]})
 
     async def _models(self, request: web.Request) -> web.Response:
         return web.json_response(self.manager.list_models().model_dump())
@@ -264,6 +309,24 @@ class HttpService:
                 return _error_response(
                     404, f"model {req.model!r} not found; available: "
                          f"{sorted(engines)}", hdrs)
+            if self.draining:
+                # draining frontend: refuse new work, point clients at a
+                # sibling (the LB retries elsewhere within Retry-After)
+                return _error_response(
+                    503, "frontend draining",
+                    {**hdrs, "Retry-After": str(self._retry_after())},
+                    err_type="overloaded_error")
+            if self.admission is not None:
+                # dynarevive SLO-aware shed: answer an early 503 from
+                # load signals the stack already exports instead of
+                # queueing a request the engine will deadline anyway
+                retry_after = self.admission.admit()
+                if retry_after is not None:
+                    span.set_attribute("shed", True)
+                    return _error_response(
+                        503, "shedding load (overloaded)",
+                        {**hdrs, "Retry-After": str(retry_after)},
+                        err_type="overloaded_error")
             span.set_attribute("model", req.model)
             span.set_attribute("stream", bool(req.stream))
             mguard = self.metrics.guard(
@@ -299,13 +362,18 @@ class HttpService:
                                        hdrs, err_type="timeout_error")
             except guard.NoCapacity as e:
                 # no live/healthy instance right now: retryable, tell the
-                # client when to come back — not a 500
+                # client when to come back — not a 500. The Retry-After
+                # is load-derived and jittered (dynarevive): a constant
+                # "1" synchronized every client's retry into a second
+                # stampede against a recovering fleet.
                 return _error_response(
-                    503, str(e), {**hdrs, "Retry-After": "1"},
+                    503, str(e),
+                    {**hdrs, "Retry-After": str(self._retry_after())},
                     err_type="overloaded_error")
             except NoRespondersError as e:
                 return _error_response(
-                    503, str(e), {**hdrs, "Retry-After": "1"},
+                    503, str(e),
+                    {**hdrs, "Retry-After": str(self._retry_after())},
                     err_type="overloaded_error")
             except ValueError as e:
                 return _error_response(400, str(e), hdrs)
@@ -316,6 +384,15 @@ class HttpService:
                 return _error_response(500, repr(e), hdrs)
             finally:
                 mguard.done()
+
+    def _retry_after(self) -> int:
+        """Retry-After seconds for 503s: the admission controller's
+        pressure-derived jittered value when one is wired, else the
+        unit-pressure jitter (never the old synchronized constant 1)."""
+        if self.admission is not None:
+            _, pressure = self.admission.evaluate()
+            return self.admission.retry_after(max(pressure, 1.0))
+        return revive.retry_after_s()
 
     async def _sse(self, http_request: web.Request, req, first, aiter,
                    ctx: Context, mguard, t0: float,

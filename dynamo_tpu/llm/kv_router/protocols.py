@@ -26,6 +26,11 @@ class ForwardPassMetrics:
     worker_label: str = ""
     mesh_shape: str = ""
     mesh_devices: int = 1
+    # dynarevive graceful drain: 1 while the worker is finishing its
+    # in-flight sequences after withdrawing from discovery. Draining ≠
+    # dead — the stats plane keeps answering (no breaker opens) and the
+    # scheduler simply stops offering this worker new requests.
+    draining: int = 0
     request_active_slots: int = 0
     request_total_slots: int = 0
     kv_active_blocks: int = 0

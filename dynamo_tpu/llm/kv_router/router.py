@@ -19,7 +19,8 @@ from typing import Dict, Optional, Sequence
 
 from ...runtime import guard, profiling, tracing, wire
 from ...runtime.component import Client
-from ...runtime.dcp_client import DcpClient, pack, unpack
+from ...runtime.dcp_client import (DcpClient, NoRespondersError, pack,
+                                   unpack)
 from ...runtime.runtime import DistributedRuntime
 from ...runtime.tasks import backoff_interval, cancel_join, spawn_tracked
 from .indexer import KvIndexer, OverlapScores
@@ -148,16 +149,29 @@ class KvRouter:
     # ------------------------------------------------------------ routing
 
     async def schedule(self, token_ids: Sequence[int],
-                       request_id: Optional[str] = None) -> int:
+                       request_id: Optional[str] = None,
+                       exclude=None) -> int:
         """token_ids → worker instance id. ``request_id`` keys the
-        predicted-vs-realized calibration entry for this decision."""
+        predicted-vs-realized calibration entry for this decision.
+        ``exclude`` (dynarevive failover) drops candidate workers — the
+        dead worker a resume must avoid even while its discovery record
+        and warm prefix index entries linger."""
         with tracing.get_tracer().start_span("route", attributes={
                 "tokens": len(token_ids)}) as span:
             if not self.scheduler.workers:
                 await self.scrape_once()
             if not self.scheduler.workers:
-                # no stats yet: fall back to any live instance
-                ids = await self.client.wait_for_instances(timeout=10)
+                # no stats yet: fall back to any live instance. An EMPTY
+                # pool is typed NoResponders (HTTP 503 + Retry-After) —
+                # found live by the dynarevive drain drive: draining the
+                # last worker turned new requests into raw TimeoutError
+                # 500s here instead of the retryable no-capacity shape
+                try:
+                    ids = await self.client.wait_for_instances(timeout=10)
+                except asyncio.TimeoutError:
+                    raise NoRespondersError(
+                        f"no live instances of {self.namespace}."
+                        f"{self.component}") from None
                 if not self.scheduler.workers:
                     # re-check after the wait: a scrape may have landed
                     # real occupancy during it, and zeroed fallback
@@ -168,7 +182,8 @@ class KvRouter:
             overlaps = self.indexer.find_matches_for_request(token_ids)
             # only consider overlaps from live workers
             wid = self.scheduler.schedule(len(token_ids), overlaps,
-                                          request_id=request_id)
+                                          request_id=request_id,
+                                          exclude=exclude)
             if request_id:
                 bs = self.scheduler.block_size
                 isl_blocks = max((len(token_ids) + bs - 1) // bs, 1)

@@ -80,9 +80,13 @@ class KvScheduler:
         self.workers = {wid: WorkerState(m) for wid, m in metrics.items()}
 
     def schedule(self, num_tokens: int, overlaps: OverlapScores,
-                 request_id: Optional[str] = None) -> int:
+                 request_id: Optional[str] = None,
+                 exclude=None) -> int:
         """Pick a worker for a request of ``num_tokens`` prompt tokens.
-        Raises RuntimeError when no worker is available."""
+        Raises RuntimeError when no worker is available. ``exclude``
+        drops candidates outright (dynarevive failover: the dead worker
+        a resume must avoid); draining workers are skipped like
+        saturated ones (draining ≠ dead, but it admits nothing new)."""
         if not self.workers:
             raise RuntimeError("no workers registered with the KV scheduler")
         isl_blocks = max((num_tokens + self.block_size - 1) // self.block_size, 1)
@@ -90,9 +94,14 @@ class KvScheduler:
         mean_usage = sum(usages) / len(usages)
 
         alpha = self.load_balance_weight
+        excluded = set(exclude) if exclude else ()
         best_cost = None
         best: List[int] = []
         for wid, w in self.workers.items():
+            if wid in excluded:
+                continue
+            if getattr(w.metrics, "draining", 0):
+                continue
             if w.saturated():
                 continue
             overlap = min(overlaps.scores.get(wid, 0), isl_blocks)

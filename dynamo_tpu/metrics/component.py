@@ -140,6 +140,10 @@ class MetricsAggregator:
             ("dyn_engine_mesh_devices",
              "devices in this worker's submesh (1 = unsharded; dynashard)",
              lambda m: m.mesh_devices),
+            ("dyn_worker_draining",
+             "1 while the worker drains (discovery withdrawn, in-flight "
+             "finishing; dynarevive — draining is not dead)",
+             lambda m: m.draining),
             ("dyn_worker_request_active_slots", "active request slots",
              lambda m: m.request_active_slots),
             ("dyn_worker_request_total_slots", "total request slots",

@@ -240,6 +240,20 @@ class ShardedReplica:
         if handle is not None:
             await handle.stop()
 
+    async def drain_graceful(self, timeout_s=None) -> bool:
+        """dynarevive graceful drain: discovery withdrawn first, then
+        in-flight sequences finish (bounded by DYN_DRAIN_TIMEOUT_MS /
+        ``timeout_s``), KV events flush, and only then does the handle
+        stop. Returns True when everything finished inside the budget."""
+        from ..runtime import revive
+
+        handle, self._handle = self._handle, None
+        if handle is None:
+            return True
+        return await revive.drain_worker(
+            handle, engine=self.engine, publisher=self._publisher,
+            timeout_s=timeout_s)
+
     async def stop(self) -> None:
         # lifecycle drain (discovery withdrawal), not a socket drain
         await self.drain()  # dynalint: disable=unbounded-await
@@ -423,6 +437,20 @@ class ShardedReplicaSet:
             "instances": {r.name: f"{r.instance_id:x}"
                           for r in self.replicas},
         }
+
+    async def drain(self, timeout_s=None) -> bool:
+        """dynarevive graceful shutdown (the SIGTERM path): every replica
+        withdraws from discovery, finishes in-flight sequences bounded by
+        DYN_DRAIN_TIMEOUT_MS, flushes KV events, then the set stops and
+        leases release. Returns True when every replica drained clean."""
+        results = []
+        for replica in self.replicas:
+            # lifecycle drain (state machine in runtime/revive.py), not
+            # a socket drain
+            results.append(  # dynalint: disable=unbounded-await
+                await replica.drain_graceful(timeout_s))
+        await self.stop()
+        return all(results)
 
     async def stop(self) -> None:
         while self.replicas:

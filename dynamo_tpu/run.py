@@ -353,6 +353,17 @@ async def run_http(args) -> None:
             comp = LocalCompletionChain(mdc, engine, chat.preprocessor)
             manager.add_chat_model(mdc.name, chat)
             manager.add_completions_model(mdc.name, comp)
+        from .runtime import revive
+
+        if hasattr(engine, "stats"):
+            # dynarevive admission control over the local engine's own
+            # signals; sheds nothing until DYN_SHED_* thresholds are set
+            svc.set_admission(revive.AdmissionController(
+                lambda: revive.signals_from_stats(engine.stats())))
+        if hasattr(engine, "drain"):
+            # POST /drain: stop admitting, finish in-flight bounded by
+            # DYN_DRAIN_TIMEOUT_MS
+            svc.on_drain(lambda: engine.drain(revive.drain_timeout_s()))
     await svc.start(args.http_host, args.http_port)
     log.info("OpenAI frontend on %s:%d", args.http_host, args.http_port)
     await _wait_for_signal()
@@ -485,8 +496,16 @@ async def run_worker(args, path: str) -> None:
         component=addr.component, endpoint=addr.endpoint,
         stats_handler=getattr(engine, "stats", None))
     log.info("worker serving %s", path)
-    await _wait_for_signal()
-    await handle.stop()
+    sig = await _wait_for_signal()
+    if sig == signal.SIGTERM:
+        # rolling restart: discovery record out first (no new
+        # admissions), in-flight sequences finish bounded by
+        # DYN_DRAIN_TIMEOUT_MS, then the lease releases (dynarevive)
+        from .runtime import revive
+
+        await revive.drain_worker(handle, engine=engine)
+    else:
+        await handle.stop()
     if hasattr(engine, "stop"):
         await engine.stop()
     await drt.shutdown()
@@ -514,8 +533,12 @@ async def _run_sharded_worker(args, path: str) -> None:
         warmup=not args.no_warmup)
     await replica_set.start()
     log.info("sharded worker serving %s: %s", path, replica_set.describe())
-    await _wait_for_signal()
-    await replica_set.stop()
+    sig = await _wait_for_signal()
+    if sig == signal.SIGTERM:
+        # lifecycle drain bounded internally by DYN_DRAIN_TIMEOUT_MS
+        await replica_set.drain()  # dynalint: disable=unbounded-await
+    else:
+        await replica_set.stop()
 
 
 async def run_none(args) -> None:
@@ -539,15 +562,26 @@ async def _attach(args):
     return await DistributedRuntime.detached()
 
 
-async def _wait_for_signal() -> None:
+async def _wait_for_signal() -> int:
+    """Park until SIGINT/SIGTERM; returns the signal number so callers
+    can pick fast teardown (SIGINT) vs graceful drain (SIGTERM — the
+    rolling-restart signal, dynarevive docs/robustness.md)."""
     ev = asyncio.Event()
+    fired: list = []
     loop = asyncio.get_running_loop()
+
+    def _on_signal(signum: int) -> None:
+        if not fired:
+            fired.append(signum)
+        ev.set()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
-            loop.add_signal_handler(sig, ev.set)
+            loop.add_signal_handler(sig, _on_signal, sig)
         except NotImplementedError:
             pass
     await ev.wait()
+    return fired[0] if fired else signal.SIGINT
 
 
 async def amain(args) -> int:

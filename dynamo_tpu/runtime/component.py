@@ -183,8 +183,15 @@ class Endpoint:
         return c
 
 
+class _WorkerKilled(Exception):
+    """Internal: a ``worker.kill`` chaos rule fired — this handle must
+    die like a crashed process (conn drops, no error frames, lease and
+    discovery record left behind)."""
+
+
 class ServeHandle:
-    """A served endpoint instance; ``stop()`` to withdraw from discovery."""
+    """A served endpoint instance; ``stop()`` to withdraw from discovery,
+    ``begin_drain()``/``drain()`` for the graceful path (dynarevive)."""
 
     def __init__(self, endpoint: Endpoint, instance: EndpointInstance,
                  handler: Handler, stats_handler):
@@ -195,6 +202,13 @@ class ServeHandle:
         self._sids: List[int] = []
         self._inflight: Dict[str, Context] = {}
         self._stopped = asyncio.Event()
+        # dynarevive lifecycle: draining = discovery record withdrawn,
+        # new requests nacked, in-flight streams finishing, stats plane
+        # still answering (draining ≠ dead). dead = a worker.kill chaos
+        # rule fired — the wedged-process shape (lease + discovery record
+        # stay, nothing answers).
+        self.draining = False
+        self._dead = False
 
     async def _start(self) -> None:
         drt = self.endpoint.drt
@@ -221,26 +235,100 @@ class ServeHandle:
     async def stop(self) -> None:
         drt = self.endpoint.drt
         self._stopped.set()
-        for sid in self._sids:
+        # claim the subscriptions before the awaits: a concurrent
+        # stop()/drain() interleaving must not double-unsubscribe
+        sids, self._sids = self._sids, []
+        for sid in sids:
             try:
                 await drt.dcp.unsubscribe(sid)
             except Exception:
                 log.debug("unsubscribe %d failed during stop", sid,
                           exc_info=True)
+        await self._withdraw_discovery()
+        for ctx in self._inflight.values():
+            ctx.kill()
+
+    async def _withdraw_discovery(self) -> None:
         key = instance_key(self.instance.namespace, self.instance.component,
                            self.instance.endpoint, self.instance.instance_id)
         try:
-            await drt.dcp.kv_delete(key)
+            await self.endpoint.drt.dcp.kv_delete(key)
         except Exception:
-            pass
-        for ctx in self._inflight.values():
-            ctx.kill()
+            log.debug("discovery withdraw failed for %s",
+                      self.instance.subject, exc_info=True)
+
+    # ------------------------------------------------- dynarevive: drain
+
+    async def begin_drain(self) -> None:
+        """Enter the draining state: delete the discovery record (every
+        watching client drops this instance; routers stop picking it),
+        nack any request that still reaches the subjects, keep answering
+        stats with ``draining=1``, and let in-flight streams finish.
+        Draining ≠ dead: nothing errors, no breaker opens."""
+        if self.draining:
+            return
+        self.draining = True
+        log.info("draining %s (instance %x, %d in flight)",
+                 self.endpoint.path, self.instance.instance_id,
+                 len(self._inflight))
+        await self._withdraw_discovery()
+
+    async def wait_idle(self, timeout_s: float) -> bool:
+        """Wall-bounded wait for the in-flight set to empty. Returns
+        False when the timeout expired with work still in flight."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(timeout_s, 0.0)
+        while self._inflight and loop.time() < deadline:
+            await asyncio.sleep(0.02)
+        return not self._inflight
+
+    async def drain(self, timeout_s: float = 10.0) -> bool:
+        """begin_drain + bounded in-flight wait + full stop. Returns True
+        when everything finished inside the budget."""
+        await self.begin_drain()
+        drained = await self.wait_idle(timeout_s)
+        await self.stop()
+        return drained
 
     @property
     def inflight(self) -> int:
         return len(self._inflight)
 
+    async def die(self) -> None:
+        """Bench/test hook: apply the ``worker.kill`` chaos shape on
+        demand (wedged process: streams drop raw, planes go silent,
+        lease + discovery record stay)."""
+        await self._on_killed()
+
+    async def _on_killed(self) -> None:
+        """worker.kill chaos fired: become a wedged process. Request and
+        stats planes go silent (subscriptions dropped, stats errors), the
+        lease keepalive and discovery record stay — exactly the
+        crashed-but-leased shape the breaker/eviction paths handle —
+        and every in-flight context is killed so engine pages free."""
+        if self._dead:
+            return
+        self._dead = True
+        log.warning("chaos worker.kill: instance %x of %s is now dead "
+                    "(lease and discovery record left behind)",
+                    self.instance.instance_id, self.endpoint.path)
+        sids, self._sids = self._sids, []
+        for sid in sids:
+            try:
+                await self.endpoint.drt.dcp.unsubscribe(sid)
+            except Exception:
+                log.debug("unsubscribe during chaos kill failed",
+                          exc_info=True)
+        for ctx in self._inflight.values():
+            ctx.kill()
+
     async def _on_stats(self, msg: Message) -> None:
+        if self._dead:
+            # a dead process answers nothing; erroring (vs timing out)
+            # keeps the test/scrape planes fast while the breaker still
+            # counts the failure
+            await msg.respond_error("worker killed by chaos")
+            return
         try:
             data = self.stats_handler() if self.stats_handler else {}
         except Exception as e:  # noqa: BLE001 — a crashing stats handler
@@ -250,6 +338,11 @@ class ServeHandle:
                       exc_info=True)
             await msg.respond_error(f"stats handler failed: {e!r}")
             return
+        if self.draining:
+            # draining ≠ dead: the scrape plane keeps answering, flagged,
+            # so the router/aggregator treat this instance as leaving —
+            # not as a failure to break on
+            data = dict(data, draining=1)
         await msg.respond(pack(wire.checked(wire.DCP_STATS_REPLY, {
             "instance_id": self.instance.instance_id,
             "subject": self.instance.subject,
@@ -277,6 +370,16 @@ class ServeHandle:
         except Exception as e:  # noqa: BLE001
             if msg.needs_reply:
                 await msg.respond_error(f"bad request envelope: {e!r}")
+            return
+        if self._dead:
+            return  # a dead process never acks: the caller's ack wait fails
+        if self.draining:
+            # drain admits nothing new: a typed nack the Client maps to
+            # "request rejected" (retry lands on a live sibling)
+            if msg.needs_reply:
+                await msg.respond(pack(wire.checked(wire.DCP_REQUEST_ACK, {
+                    "accepted": False,
+                    "instance_id": self.instance.instance_id})))
             return
         if msg.needs_reply:
             await msg.respond(pack(wire.checked(wire.DCP_REQUEST_ACK, {
@@ -315,12 +418,30 @@ class ServeHandle:
                 async for item in agen:
                     if ctx.killed:
                         break
+                    if guard.chaos() is not None or self._dead:
+                        # worker-scoped chaos (dynarevive): a fired
+                        # `worker.kill` rule turns THIS handle into a
+                        # wedged process; sibling streams on the same
+                        # handle die with it
+                        if self._dead:
+                            raise _WorkerKilled()
+                        try:
+                            await guard.chaos_point("worker.kill")
+                        except (guard.ChaosError,
+                                ConnectionResetError) as e:
+                            raise _WorkerKilled() from e
                     env = item if isinstance(item, Annotated) \
                         else Annotated(data=item)
                     if env.id is None:
                         env.id = req_id
                     await callhome.send_data(pack(env.to_dict()))
+                if self._dead:
+                    raise _WorkerKilled()
                 await callhome.complete()
+        except _WorkerKilled:
+            # die like a process: no error frame, no complete — the
+            # caller sees a raw connection drop (finally closes it)
+            await self._on_killed()
         except asyncio.CancelledError:
             if callhome:
                 await callhome.error("worker cancelled")

@@ -62,6 +62,12 @@ register_env("DYN_CHAOS", None, "runtime",
              "(grammar in docs/robustness.md). Unset = no chaos.")
 register_env("DYN_CONFIG_PATH", None, "runtime",
              "Path to a YAML/JSON RuntimeConfig overlay file.")
+register_env("DYN_DRAIN_TIMEOUT_MS", "10000", "runtime",
+             "dynarevive graceful drain: bound (ms) on finishing "
+             "in-flight sequences after a worker receives SIGTERM or "
+             "POST /drain — discovery record deleted first (no new "
+             "admissions), KV events flushed, then the lease releases. "
+             "On expiry leftover requests are killed.")
 register_env("DYN_DCP_ADDRESS", None, "runtime",
              "host:port of the DCP control plane. Unset: workers embed an "
              "in-process server; CLIs fall back to 127.0.0.1:6650.")
@@ -96,6 +102,20 @@ register_env("DYN_REQUEST_DEADLINE_MS", "0", "runtime",
              "header. 0 = no implicit deadline.")
 register_env("DYN_REQUEST_TIMEOUT", "60.0", "runtime",
              "Default request-plane timeout in seconds.")
+register_env("DYN_REVIVE_JOURNAL_TOKENS", "4096", "runtime",
+             "dynarevive failover: per-request bound on journaled "
+             "emitted tokens (the resume prompt is prompt + journal, so "
+             "past this bound the request is marked non-resumable "
+             "rather than resumed with a truncated prompt).")
+register_env("DYN_REVIVE_MAX", "2", "runtime",
+             "dynarevive failover: max mid-stream re-dispatches per "
+             "request after an upstream worker dies before its finish "
+             "chunk (0 disables failover; the stream errors like "
+             "pre-revive).")
+register_env("DYN_REVIVE_RING", "2048", "runtime",
+             "dynarevive failover: max concurrent journal entries kept "
+             "per process (one per in-flight request; eviction only "
+             "costs the evicted request its resumability).")
 register_env("DYN_RETRY_BASE_MS", "50", "runtime",
              "RetryPolicy: decorrelated-jitter backoff base in ms.")
 register_env("DYN_RETRY_CAP_MS", "2000", "runtime",
@@ -104,6 +124,23 @@ register_env("DYN_RETRY_MAX_ATTEMPTS", "3", "runtime",
              "RetryPolicy: total attempts (first try included) for route "
              "resolution, remote-prefill dispatch, and stats scrapes. "
              "Retries never run past the request deadline.")
+register_env("DYN_SHED_KV_FREE_BLOCKS", "0", "runtime",
+             "dynarevive admission control: shed (early 503) when the "
+             "worst worker's free KV blocks drop to/below this floor. "
+             "0 disables the signal.")
+register_env("DYN_SHED_LOOP_LAG_MS", "0", "runtime",
+             "dynarevive admission control: shed when the worst "
+             "worker's event-loop lag p99 exceeds this many ms. "
+             "0 disables the signal.")
+register_env("DYN_SHED_QUEUE_DEPTH", "0", "runtime",
+             "dynarevive admission control: shed when the summed "
+             "admission-queue depth exceeds this many waiting requests "
+             "PER live worker. 0 disables the signal (the default "
+             "frontend sheds on nothing until configured).")
+register_env("DYN_SHED_RETRY_CAP_S", "8", "runtime",
+             "dynarevive admission control: ceiling (seconds) on the "
+             "load-derived, jittered Retry-After answered with shed / "
+             "no-capacity 503s.")
 register_env("DYN_STATS_TIMEOUT", "2.0", "runtime",
              "Per-instance stats-plane scrape probe timeout in seconds.")
 register_env("DYN_STEP_TIMELINE", "512", "runtime",

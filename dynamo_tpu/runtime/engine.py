@@ -35,7 +35,8 @@ class Context:
     normal cancel path.
     """
 
-    __slots__ = ("id", "_stop", "_kill", "annotations", "deadline")
+    __slots__ = ("id", "_stop", "_kill", "annotations", "deadline",
+                 "_kill_cbs")
 
     def __init__(self, request_id: Optional[str] = None, deadline=None):
         self.id: str = request_id or uuid.uuid4().hex
@@ -43,6 +44,11 @@ class Context:
         self._kill = asyncio.Event()
         self.annotations: dict = {}
         self.deadline = deadline
+        # synchronous kill hooks (dynarevive): transports register e.g.
+        # a connection close so kill() severs the upstream IMMEDIATELY —
+        # a client disconnect must not wait for an abandoned generator
+        # chain to be garbage-collected before the worker stops decoding
+        self._kill_cbs: list = []
 
     @property
     def expired(self) -> bool:
@@ -65,9 +71,29 @@ class Context:
     def stop_generating(self) -> None:
         self._stop.set()
 
+    def on_kill(self, cb) -> None:
+        """Register a SYNC callback run by ``kill()`` (immediately if
+        already killed). Used by stream adapters to sever their upstream
+        connection the moment the caller abandons the request."""
+        if self._kill.is_set():
+            self._run_kill_cb(cb)
+        else:
+            self._kill_cbs.append(cb)
+
+    @staticmethod
+    def _run_kill_cb(cb) -> None:
+        try:
+            cb()
+        except Exception:  # noqa: BLE001 — a teardown hook must never
+            # mask the kill itself
+            pass
+
     def kill(self) -> None:
         self._stop.set()
         self._kill.set()
+        cbs, self._kill_cbs = self._kill_cbs, []
+        for cb in cbs:
+            self._run_kill_cb(cb)
 
     async def wait_stopped(self) -> None:
         await self._stop.wait()

@@ -44,7 +44,22 @@ Chaos spec grammar (documented in docs/robustness.md)::
            | times=N  stop after N fires
 
 Injection points: ``tcp.connect``, ``tcp.send`` (call-home response
-plane), ``kv.connect``, ``kv.send``, ``kv.recv`` (KV transfer plane).
+plane), ``kv.connect``, ``kv.send``, ``kv.recv`` (KV transfer plane),
+plus the worker-scoped points (dynarevive):
+
+- ``worker.kill`` — consulted once per response frame a served endpoint
+  streams. A ``sever``/``drop`` fire turns the serving handle into a
+  wedged process: every stream on it dies with a raw connection drop (no
+  error frame), the request/stats planes go silent, and the lease +
+  discovery record stay behind — the exact crash shape mid-stream
+  failover and breaker eviction must absorb.
+  ``seed=1;sever:worker.kill@nth=4`` kills the worker under the 4th
+  streamed frame.
+- ``engine.stall`` — consulted once per engine scheduler iteration
+  (only when chaos is active; the hot path never pays for it). A
+  ``delay`` rule (``delay:engine.stall@ms=250,times=3``) stalls the
+  decode loop — the loop-lag monitor, ITL histograms and resume-stall
+  measurements all see it.
 """
 
 from __future__ import annotations

@@ -53,6 +53,7 @@ def record_of(fn, *a):
     ({"model": "8b", "dtype": "int8"}, "tok/s"),
     ({"scenario": "sharded", "dp_replicas": 2, "mesh": "model=2"},
      "tok/s"),
+    ({"scenario": "failover"}, "tok/s"),
 ])
 def test_emit_unavailable_matches_metric_name(over, unit):
     """A chip-unavailable record must carry the SAME metric label (and a

@@ -775,7 +775,11 @@ def test_http_no_capacity_maps_to_503_with_retry_after(run_async):
                             json={"model": name, "prompt": "x"}) as resp:
                         body = await resp.json()
                     assert resp.status == 503
-                    assert resp.headers.get("Retry-After") == "1"
+                    # dynarevive: Retry-After is load-derived + jittered
+                    # (a constant "1" re-stampeded recovering fleets);
+                    # still a valid HTTP delta-seconds integer >= 1
+                    ra = int(resp.headers.get("Retry-After"))
+                    assert 1 <= ra <= 8
                     assert body["error"]["type"] == "overloaded_error"
             finally:
                 await service.stop()

@@ -119,16 +119,21 @@ def test_burst_reports_identical_across_runs(run_async):
 
 
 def test_crash_scenario_evicts_and_rescales(run_async):
-    """Worker crash mid-stream: streams fail fast, the stale endpoint is
-    quarantined off every collector's scrape targets, and the planner
-    re-scales the pool."""
+    """Worker crash mid-stream: the stale endpoint is quarantined off
+    every collector's scrape targets and the planner re-scales the pool
+    — and since dynarevive, the in-flight streams on the crashed worker
+    RESUME on siblings instead of failing (mid-stream failover)."""
     report = run_async(run_scenario(get_scenario("crash"), seed=0))
 
     crashes = [e for e in report["workers"]["timeline"]
                if e["event"] == "crash"]
     assert len(crashes) == 1
-    # in-flight streams on the crashed worker failed (fail-fast, not hang)
-    assert report["requests"]["failed"] >= 1
+    # dynarevive: the crashed worker's in-flight streams resumed on a
+    # sibling — the crash is no longer client-visible (pre-revive this
+    # asserted failed >= 1; the failure mode is now a resume)
+    assert report["requests"]["failed"] == 0
+    assert report["requests"]["resumed"] >= 1
+    assert report["failover"]["still_crashed"] == 0
     # stale-endpoint hygiene: both collectors evicted the crashed
     # instance from their scrape targets
     assert report["stats_evictions"]["aggregator"]

@@ -1,0 +1,884 @@
+"""dynarevive: mid-stream failover, graceful drain, SLO-aware admission.
+
+The acceptance contract (ISSUE 13): no single worker failure ever turns
+into a client-visible error — a `worker.kill` chaos rule fired mid-decode
+on a 2-replica set leaves the client's greedy SSE stream token-identical
+to an uninterrupted control with zero compile-fence trips and prefix
+reuse on the resume; SIGTERM/drain finishes in-flight work and admits
+nothing new; overload answers early 503s with load-derived jittered
+Retry-After. All on CPU against the real transports.
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+
+from dynamo_tpu.runtime import guard, profiling, revive
+from dynamo_tpu.runtime.engine import Context
+
+
+@pytest.fixture(autouse=True)
+def _fresh_revive():
+    """Chaos and the failover journal never leak between tests."""
+    guard.set_chaos(None)
+    revive.reset_journal()
+    yield
+    guard.set_chaos(None)
+    revive.reset_journal()
+
+
+# ------------------------------------------------------------------ journal
+
+
+def test_journal_open_record_close_and_bound():
+    ring = revive.ReviveJournal(capacity=4, max_tokens=6)
+    e = ring.open("r1", prompt_tokens=10)
+    e.record([1, 2, 3])
+    e.record([4, 5])
+    assert e.tokens == [1, 2, 3, 4, 5] and e.resumable
+    # overflowing the bound marks non-resumable instead of truncating
+    e.record([6, 7])
+    assert e.tokens == [1, 2, 3, 4, 5] and not e.resumable
+    assert len(ring) == 1
+    ring.close("r1")
+    assert len(ring) == 0 and ring.get("r1") is None
+
+
+def test_journal_ring_eviction_costs_resumability_only():
+    ring = revive.ReviveJournal(capacity=2, max_tokens=100)
+    a = ring.open("a", 1)
+    ring.open("b", 1)
+    ring.open("c", 1)  # evicts a
+    assert len(ring) == 2 and ring.get("a") is None
+    assert not a.resumable
+    assert ring.evicted_total == 1
+    snap = ring.snapshot()
+    assert snap["inflight"] == 2 and snap["opened_total"] == 3
+
+
+# ------------------------------------------------------------------ session
+
+
+def _pre(tokens, max_tokens=8, min_tokens=None, echo=False):
+    from dynamo_tpu.llm.protocols.common import (OutputOptions,
+                                                 PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+
+    return PreprocessedRequest(
+        token_ids=list(tokens), sampling=SamplingOptions(),
+        stop=StopConditions(max_tokens=max_tokens, min_tokens=min_tokens),
+        output=OutputOptions(echo_prompt=echo))
+
+
+def _out(ids, finish=None):
+    from dynamo_tpu.llm.protocols.common import EngineOutput
+
+    return EngineOutput(token_ids=list(ids), finish_reason=finish)
+
+
+def test_session_resume_request_dedupes_overlap():
+    """The resume prompt is prompt + emitted with the stop budget
+    decremented and echo force-cleared — the overlap dedupe that makes
+    greedy resumes token-identical."""
+    ctx = Context("rid-1")
+    s = revive.ReviveSession(_pre([1, 2, 3], max_tokens=8, min_tokens=4,
+                                  echo=True), ctx, limit=2)
+    s.observe(_out([10, 11]))
+    s.observe(_out([12]))
+    r = s.resume_request()
+    assert r.token_ids == [1, 2, 3, 10, 11, 12]
+    assert r.stop.max_tokens == 5            # 8 - 3 emitted
+    assert r.stop.min_tokens == 1            # 4 - 3 emitted
+    assert r.output.echo_prompt is False     # echo already streamed once
+    # the base request is untouched
+    assert s.base.token_ids == [1, 2, 3]
+    assert s.base.stop.max_tokens == 8 and s.base.output.echo_prompt
+    s.close()
+
+
+def test_session_should_resume_matrix():
+    ctx = Context("rid-2")
+    s = revive.ReviveSession(_pre([1], max_tokens=8), ctx, limit=1)
+    assert s.should_resume(RuntimeError("worker died"))
+    assert s.should_resume(ConnectionResetError("severed"))
+    # typed budget/capacity/client errors never resume
+    assert not s.should_resume(guard.DeadlineExceeded("spent"))
+    assert not s.should_resume(guard.NoCapacity("all broken"))
+    assert not s.should_resume(ValueError("bad request"))
+    # a finished stream never resumes
+    s.observe(_out([5], finish="stop"))
+    assert not s.should_resume(RuntimeError("late failure"))
+    s.close()
+
+    ctx2 = Context("rid-3")
+    s2 = revive.ReviveSession(_pre([1], max_tokens=8), ctx2, limit=1)
+    s2.mark_resume()
+    assert not s2.should_resume(RuntimeError("x"))  # limit spent
+    s2.close()
+
+    ctx3 = Context("rid-4")
+    s3 = revive.ReviveSession(_pre([1], max_tokens=8), ctx3, limit=2)
+    ctx3.kill()  # client gone: nothing to save
+    assert not s3.should_resume(RuntimeError("x"))
+    s3.close()
+
+
+def test_session_budget_spent_synthesizes_length_finish():
+    """Worker died between the last budgeted token and its finish chunk:
+    the session synthesizes the lost finish instead of dispatching a
+    zero-token resume."""
+    ctx = Context("rid-5")
+    s = revive.ReviveSession(_pre([1, 2], max_tokens=3), ctx, limit=2)
+    s.observe(_out([7, 8, 9]))
+    assert s.budget_spent()
+    fin = s.synthetic_finish()
+    assert fin.finish_reason == "length"
+    assert fin.completion_tokens == 3 and fin.prompt_tokens == 2
+    s.close()
+
+
+# -------------------------------------------------------------- retry-after
+
+
+def test_retry_after_jittered_deterministic_and_capped():
+    r1 = [revive.retry_after_s(p, rng=random.Random(7), cap_s=8.0)
+          for p in (1.0, 2.0, 4.0, 50.0)]
+    r2 = [revive.retry_after_s(p, rng=random.Random(7), cap_s=8.0)
+          for p in (1.0, 2.0, 4.0, 50.0)]
+    assert r1 == r2                         # injectable rng → deterministic
+    assert all(1 <= v <= 8 for v in r1)     # pressure beyond cap clamps
+    # jitter actually varies across draws (not the old constant 1)
+    rng = random.Random(3)
+    draws = {revive.retry_after_s(3.0, rng=rng, cap_s=8.0)
+             for _ in range(32)}
+    assert len(draws) > 1
+
+
+# -------------------------------------------------------- admission control
+
+
+def test_admission_disabled_by_default_admits_everything():
+    calls = []
+
+    def signals():
+        calls.append(1)
+        return revive.LoadSignals(queue_depth=10 ** 6)
+
+    ctrl = revive.AdmissionController(signals, cfg=revive.ShedConfig())
+    assert not ctrl.cfg.enabled
+    assert ctrl.admit() is None and not calls  # signals never even read
+
+
+def test_admission_sheds_on_queue_depth_with_peak_hold():
+    sig = revive.LoadSignals(queue_depth=0, workers=2)
+
+    def signals():
+        return sig
+
+    ctrl = revive.AdmissionController(
+        signals, cfg=revive.ShedConfig(queue_depth=3),
+        rng=random.Random(0), window=8)
+    assert ctrl.admit() is None              # 0 < 3*2
+    sig = revive.LoadSignals(queue_depth=7, workers=2)
+    ra = ctrl.admit()                        # 7 >= 6: shed
+    assert isinstance(ra, int) and ra >= 1
+    assert ctrl.shed_total == 1
+    assert ctrl.shed_by_signal == {"queue_depth": 1}
+    # peak-hold: the queue drained at this instant, but the recent peak
+    # still sheds (batched engines complete in lockstep — instantaneous
+    # reads anti-correlate with load)
+    sig = revive.LoadSignals(queue_depth=0, workers=2)
+    assert ctrl.admit() is not None
+    # once the peak leaves the window, admission resumes
+    for _ in range(10):
+        ctrl.observe()
+    assert ctrl.admit() is None
+    snap = ctrl.snapshot()
+    assert snap["enabled"] and snap["shed_total"] == 2
+
+
+def test_admission_loop_lag_and_kv_signals():
+    sig = {"s": revive.LoadSignals(loop_lag_p99_ms=120.0)}
+    ctrl = revive.AdmissionController(
+        lambda: sig["s"], cfg=revive.ShedConfig(loop_lag_ms=100.0),
+        rng=random.Random(0), window=2)
+    name, pressure = ctrl.evaluate()
+    assert name == "loop_lag" and pressure == pytest.approx(1.2)
+    sig["s"] = revive.LoadSignals(loop_lag_p99_ms=0.0, kv_free_blocks=2)
+    ctrl2 = revive.AdmissionController(
+        lambda: sig["s"], cfg=revive.ShedConfig(kv_free_blocks=8),
+        rng=random.Random(0), window=2)
+    name, pressure = ctrl2.evaluate()
+    assert name == "kv_free_blocks" and pressure == pytest.approx(4.0)
+    # a broken signal source admits (never a shed storm)
+    ctrl3 = revive.AdmissionController(
+        lambda: 1 / 0, cfg=revive.ShedConfig(queue_depth=1))
+    assert ctrl3.admit() is None
+
+
+def test_signals_adapters():
+    stats = {"num_requests_waiting": 5, "loop_lag_p99_seconds": 0.25,
+             "kv_free_blocks": 17}
+    sig = revive.signals_from_stats(stats)
+    assert (sig.queue_depth, sig.workers, sig.kv_free_blocks) == (5, 1, 17)
+    assert sig.loop_lag_p99_ms == pytest.approx(250.0)
+
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+    metrics = {
+        1: ForwardPassMetrics(num_requests_waiting=3, kv_free_blocks=9,
+                              loop_lag_p99_seconds=0.1),
+        2: ForwardPassMetrics(num_requests_waiting=4, kv_free_blocks=2,
+                              loop_lag_p99_seconds=0.3),
+        3: ForwardPassMetrics(num_requests_waiting=50, draining=1),
+    }
+    sig = revive.signals_from_metrics(metrics)
+    # the draining worker is leaving: its queue is not admissible load
+    assert sig.queue_depth == 7 and sig.workers == 2
+    assert sig.kv_free_blocks == 2
+    assert sig.loop_lag_p99_ms == pytest.approx(300.0)
+
+
+# ------------------------------------------------------------ chaos grammar
+
+
+def test_chaos_grammar_worker_points_parse_and_reject():
+    seed, rules = guard.parse_chaos(
+        "seed=5;sever:worker.kill@nth=4;delay:engine.stall@ms=80,times=2")
+    assert seed == 5 and len(rules) == 2
+    kill, stall = rules
+    assert (kill.action, kill.point, kill.nth) == ("sever", "worker.kill", 4)
+    assert (stall.action, stall.point, stall.ms, stall.times) == \
+        ("delay", "engine.stall", 80.0, 2)
+    # malformed specs still fail loudly
+    with pytest.raises(ValueError):
+        guard.parse_chaos("explode:worker.kill")
+    with pytest.raises(ValueError):
+        guard.parse_chaos("sever:worker.kill@bogus=1")
+
+
+def test_chaos_worker_kill_fires_deterministically(run_async):
+    async def main():
+        inj = guard.set_chaos("seed=1;sever:worker.kill@nth=2,times=1")
+        await guard.chaos_point("worker.kill")          # hit 1: no fire
+        with pytest.raises(ConnectionResetError):
+            await guard.chaos_point("worker.kill")      # hit 2: sever
+        await guard.chaos_point("worker.kill")          # times=1: spent
+        assert inj.injected[("worker.kill", "sever")] == 1
+
+    run_async(main())
+
+
+# --------------------------------------------------- tiny engine scaffolding
+
+
+def _tiny_engine(params=None, seed=2, decode_steps=None):
+    import jax
+
+    from dynamo_tpu.engine.jax_engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.llama import init_params
+
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=8,
+                           hidden_size=32, vocab_size=300)
+    kw = {}
+    if decode_steps is not None:
+        kw["decode_steps"] = decode_steps
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_batch=4,
+                        prefill_chunk=32, batch_buckets=(1, 2, 4),
+                        prefill_buckets=(8, 32), page_buckets=(8,),
+                        watermark_pages=2, **kw)
+    if params is None:
+        params = init_params(cfg, jax.random.PRNGKey(seed))
+    return JaxEngine(cfg, ecfg, params=params, seed=seed), params
+
+
+def _req(tokens, max_tokens=6):
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+
+    return PreprocessedRequest(token_ids=tokens,
+                               sampling=SamplingOptions(),
+                               stop=StopConditions(max_tokens=max_tokens))
+
+
+async def _collect(engine, req, ctx):
+    toks = []
+    async for out in engine.generate(req, ctx):
+        toks.extend(out.token_ids)
+        if out.finish_reason is not None:
+            return toks, out.finish_reason
+    return toks, None
+
+
+def test_engine_stall_chaos_delays_but_completes(run_async):
+    async def main():
+        engine, _ = _tiny_engine()
+        inj = guard.set_chaos("seed=2;delay:engine.stall@ms=40,times=2")
+        toks, fin = await _collect(engine, _req(list(range(1, 12))), Context())
+        assert fin is not None and toks
+        assert inj.injected.get(("engine.stall", "delay")) == 2
+        await engine.stop()
+
+    run_async(main())
+
+
+# --------------------------------------- worker.kill on a served endpoint
+
+
+def test_worker_kill_makes_handle_a_wedged_process(run_async):
+    """A fired worker.kill rule: the client sees a raw conn drop (typed
+    fail-fast), the discovery record and lease stay behind, the stats
+    plane answers errors — the exact crashed-but-leased shape."""
+
+    async def main():
+        from dynamo_tpu.runtime.component import instance_key
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            async def handler(request, ctx):
+                for i in range(50):
+                    yield {"i": i}
+                    await asyncio.sleep(0.005)
+
+            ep = drt.namespace("kill").component("w").endpoint("gen")
+            handle = await ep.serve(handler)
+            client = await ep.client()
+            guard.set_chaos("seed=9;sever:worker.kill@nth=3")
+
+            stream = await client.round_robin({"x": 1})
+            got = []
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError, match="disconnected"):
+                async for env in stream:
+                    got.append(env.data)
+            assert time.monotonic() - t0 < 10.0
+            assert len(got) == 2                  # died under frame 3
+            assert handle._dead
+            # wedged process: lease + discovery record stay behind
+            key = instance_key("kill", "w", "gen",
+                               handle.instance.instance_id)
+            assert await drt.dcp.kv_get(key) is not None
+            # the stats plane errors instead of answering
+            with pytest.raises(Exception):
+                await drt.dcp.request(
+                    f"stats.{handle.instance.subject}", b"", timeout=2.0)
+            await handle.stop()
+            await client.close()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+# --------------------------------------------- the failover e2e (tentpole)
+
+
+def test_worker_kill_mid_decode_resumes_token_identical(run_async):
+    """THE acceptance e2e: `worker.kill` chaos mid-decode on a 2-replica
+    set → the client's greedy SSE stream completes token-identical to an
+    unfaulted control, no error chunk, zero post-warmup compiles on the
+    surviving replica, and the resumed request's cost block shows prefix
+    reuse (device_hit > 0) because overlap routing landed the resume on
+    the replica with the warmest prefix."""
+
+    async def main():
+        import aiohttp
+
+        from dynamo_tpu.llm.http.service import HttpService
+        from dynamo_tpu.llm.kv_router.router import KvRouter
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.llm.processor import Processor
+        from dynamo_tpu.llm.worker import serve_token_model
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        drt2 = await DistributedRuntime.attach(drt.dcp.address)
+        service = None
+        try:
+            # identical weights on both replicas (sibling equivalence is
+            # what makes the greedy resume token-identical); small decode
+            # windows so the stream has several chunks to die between
+            eng_a, params = _tiny_engine(seed=11, decode_steps=2)
+            eng_b, _ = _tiny_engine(params=params, decode_steps=2)
+            eng_a.warmup()
+            # the fence is process-global: sibling warmup is an
+            # intentional compile phase (the dynashard join idiom)
+            eng_a.fence.disarm()
+            try:
+                eng_b.warmup()
+            finally:
+                eng_a.fence.arm()
+
+            mdc = ModelDeploymentCard(name="m", tokenizer_kind="byte",
+                                      kv_block_size=8,
+                                      model_type="completions")
+            h_a, pub_a = await serve_token_model(
+                drt, mdc, eng_a, namespace="rev", component="w")
+            h_b, pub_b = await serve_token_model(
+                drt2, mdc, eng_b, namespace="rev", component="w")
+            kvr = KvRouter(drt, "rev", "w", block_size=8, seed=0)
+            await kvr.start(run_loop=False)
+            await kvr.scrape_once()
+            token_client = await drt.namespace("rev").component("w") \
+                .endpoint("generate_tokens").client()
+            processor = Processor(mdc, token_client, kvr)
+            service = HttpService()
+            service.manager.add_completions_model("m",
+                                                  processor.completion)
+            await service.start(host="127.0.0.1", port=0)
+
+            from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+            prompt = "resume me please now!!!"   # BOS + 23 bytes = 3 pages
+            tokens = ByteTokenizer().encode(prompt)  # the HTTP lowering
+            # CONTROL + cache warm: run the identical greedy request
+            # in-process on BOTH engines — the uninterrupted reference
+            # output, and both replicas now hold the prompt (and
+            # continuation) pages, so whichever survives has the warm
+            # prefix the resume should hit. The control carries the same
+            # eos semantics the HTTP preprocessor lowers.
+            def ctrl_req():
+                r = _req(tokens, 12)
+                r.eos_token_ids = [ByteTokenizer.EOS]
+                return r
+
+            want, want_fin = await _collect(eng_a, ctrl_req(),
+                                            Context("warm-a"))
+            want_b, _ = await _collect(eng_b, ctrl_req(),
+                                       Context("warm-b"))
+            assert want == want_b, "sibling equivalence broken"
+            control_text = ByteTokenizer().decode(want)
+            await pub_a.flush()
+            await pub_b.flush()
+            await asyncio.sleep(0.05)
+            await kvr.scrape_once()
+
+            # the kill: the serving replica dies under its 3rd streamed
+            # frame — mid-decode, after the client saw real tokens
+            guard.set_chaos("seed=3;sever:worker.kill@nth=3")
+
+            rid = "revive-e2e-1"
+            text = []
+            finishes = []
+            saw_error = False
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                        f"http://127.0.0.1:{service.port}/v1/completions",
+                        json={"model": "m", "prompt": prompt,
+                              "stream": True, "max_tokens": 12},
+                        headers={"X-Request-Id": rid}) as resp:
+                    assert resp.status == 200
+                    async for raw in resp.content:
+                        line = raw.strip()
+                        if line == b"data: [DONE]":
+                            break
+                        if line.startswith(b"event: error"):
+                            saw_error = True
+                        if not line.startswith(b"data: "):
+                            continue
+                        chunk = json.loads(line[len(b"data: "):])
+                        for c in chunk.get("choices", []):
+                            text.append(c.get("text") or "")
+                            if c.get("finish_reason"):
+                                finishes.append(c["finish_reason"])
+
+                # exactly one replica died under the chaos rule
+                dead = [h for h in (h_a, h_b) if h._dead]
+                assert len(dead) == 1, "chaos should kill exactly one"
+                survivor = eng_b if dead[0] is h_a else eng_a
+
+                # the contract: no error chunk, token-identical output
+                assert not saw_error
+                assert "".join(text) == control_text
+                assert finishes and finishes[-1] in ("length", "stop")
+                # one mid-stream failover happened
+                assert revive.journal().resumed_total == 1
+                # no journal entry leaked
+                assert len(revive.journal()) == 0
+                # zero compile-fence trips on the surviving replica: the
+                # resume prompt stayed on the warmed grid
+                assert survivor.fence.post_warmup_compiles == 0
+
+                # the resumed request's cost block: names the resume and
+                # shows prefix reuse on the survivor (warmest-prefix
+                # routing made the resume one cached prefill)
+                cost = profiling.request_attribution(rid)
+                assert cost is not None
+                assert cost.get("resumed_attempts") == 1
+                assert (cost.get("device_hit_blocks", 0)
+                        + cost.get("host_restored_blocks", 0)) > 0
+                # /v1/traces/{rid} serves the same block to operators
+                async with http.get(
+                        f"http://127.0.0.1:{service.port}"
+                        f"/v1/traces/{rid}") as tresp:
+                    tdata = await tresp.json()
+                assert tdata["cost"]["resumed_attempts"] == 1
+
+            await kvr.stop()
+            await token_client.close()
+            for pub in (pub_a, pub_b):
+                await pub.stop()
+            for h in (h_a, h_b):
+                await h.stop()
+            await eng_a.stop()
+            await eng_b.stop()
+        finally:
+            guard.set_chaos(None)
+            if service is not None:
+                await service.stop()
+            await drt2.shutdown()
+            await drt.shutdown()
+
+    run_async(main())
+
+
+# ------------------------------------------------------------ drain e2e
+
+
+def test_drain_finishes_inflight_refuses_new_and_router_avoids(run_async):
+    """SIGTERM-shaped drain during active decode: the in-flight stream
+    completes its full budget, new requests are refused with a typed
+    nack, the discovery record disappears (the router prunes the
+    worker), and the drain reports clean."""
+
+    async def main():
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.llm.worker import serve_token_model
+        from dynamo_tpu.runtime.component import instance_key
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            engine, _ = _tiny_engine(seed=6, decode_steps=2)
+            engine.warmup()
+            mdc = ModelDeploymentCard(name="m", tokenizer_kind="byte",
+                                      kv_block_size=8,
+                                      model_type="completions")
+            handle, pub = await serve_token_model(
+                drt, mdc, engine, namespace="drain", component="w")
+            client = await drt.namespace("drain").component("w") \
+                .endpoint("generate_tokens").client()
+            await client.wait_for_instances(timeout=5)
+
+            # start a long-ish stream and consume it concurrently
+            stream = await client.round_robin(
+                _req(list(range(1, 20)), max_tokens=24).to_dict())
+            got = []
+            fins = []
+
+            async def consume():
+                async for env in stream:
+                    if env.data is not None:
+                        got.extend(env.data.get("token_ids", []))
+                        if env.data.get("finish_reason"):
+                            fins.append(env.data["finish_reason"])
+
+            consumer = asyncio.ensure_future(consume())
+            while not got:           # the stream is mid-decode
+                await asyncio.sleep(0.01)
+
+            drained = await revive.drain_worker(
+                handle, engine=engine, publisher=pub, timeout_s=15.0)
+            await consumer
+
+            # the in-flight stream finished its FULL budget, cleanly
+            assert drained is True
+            assert fins == ["length"] and len(got) == 24
+            # discovery record gone: routers stop picking this worker
+            key = instance_key("drain", "w", "generate_tokens",
+                               handle.instance.instance_id)
+            assert await drt.dcp.kv_get(key) is None
+            # engine refuses new admissions with the typed 503 shape
+            with pytest.raises(guard.NoCapacity):
+                async for _ in engine.generate(_req([1, 2, 3]), Context()):
+                    pass
+
+            await client.close()
+            await engine.stop()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+def test_drain_nacks_new_requests_typed(run_async):
+    """A draining handle answers new dispatches with accepted=False (the
+    Client maps it to a retryable rejection, never a hang)."""
+
+    async def main():
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            async def handler(request, ctx):
+                yield {"ok": True}
+
+            ep = drt.namespace("nack").component("w").endpoint("gen")
+            handle = await ep.serve(handler)
+            client = await ep.client()
+            await client.wait_for_instances(timeout=5)
+            wid = client.instance_ids()[0]
+
+            await handle.begin_drain()
+            client.retry = guard.RetryPolicy(max_attempts=2, base_s=0.01,
+                                             cap_s=0.02)
+            # the watch delete may not have landed yet: a direct dispatch
+            # hits the draining nack, typed
+            with pytest.raises(Exception) as ei:
+                await client.direct({"x": 1}, wid, timeout=2.0)
+            assert "rejected" in str(ei.value) or "not found" in \
+                str(ei.value) or "circuit-broken" in str(ei.value)
+            # draining ≠ dead: the stats plane still answers, flagged
+            from dynamo_tpu.runtime import wire
+            from dynamo_tpu.runtime.dcp_client import unpack
+
+            reply = wire.decoded(wire.DCP_STATS_REPLY, unpack(
+                await drt.dcp.request(f"stats.{handle.instance.subject}",
+                                      b"", timeout=2.0)))
+            assert reply["data"]["draining"] == 1
+            assert await handle.wait_idle(2.0)
+            await handle.stop()
+            await client.close()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+def test_scheduler_skips_draining_workers():
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+
+    sched = KvScheduler(block_size=8, rng=random.Random(0))
+    sched.update_metrics({
+        1: ForwardPassMetrics(request_total_slots=8, kv_total_blocks=64),
+        2: ForwardPassMetrics(request_total_slots=8, kv_total_blocks=64,
+                              draining=1),
+    })
+    for _ in range(8):
+        assert sched.schedule(16, OverlapScores()) == 1
+    # exclusion (the dynarevive resume path) composes with it
+    sched.update_metrics({
+        1: ForwardPassMetrics(request_total_slots=8, kv_total_blocks=64),
+        2: ForwardPassMetrics(request_total_slots=8, kv_total_blocks=64),
+        3: ForwardPassMetrics(request_total_slots=8, kv_total_blocks=64,
+                              draining=1),
+    })
+    for _ in range(4):
+        assert sched.schedule(16, OverlapScores(), exclude={1}) == 2
+    with pytest.raises(RuntimeError):
+        sched.schedule(16, OverlapScores(), exclude={1, 2})
+
+
+# ------------------------------------------------- client disconnect e2e
+
+
+def test_client_disconnect_cancels_upstream_promptly(run_async):
+    """An SSE client dropping mid-stream must cancel the upstream
+    generation promptly: engine pages return to the pool, the
+    attribution records finish_reason "cancelled", and no failover
+    journal entry leaks."""
+
+    async def main():
+        import aiohttp
+
+        from dynamo_tpu.llm.http.service import HttpService
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.llm.processor import Processor
+        from dynamo_tpu.llm.worker import serve_token_model
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        service = None
+        try:
+            engine, _ = _tiny_engine(seed=8, decode_steps=2)
+            engine.warmup()
+            mdc = ModelDeploymentCard(name="m", tokenizer_kind="byte",
+                                      kv_block_size=8,
+                                      model_type="completions")
+            handle, pub = await serve_token_model(
+                drt, mdc, engine, namespace="disc", component="w")
+            token_client = await drt.namespace("disc").component("w") \
+                .endpoint("generate_tokens").client()
+            processor = Processor(mdc, token_client, None)
+            service = HttpService()
+            service.manager.add_completions_model("m",
+                                                  processor.completion)
+            await service.start(host="127.0.0.1", port=0)
+
+            baseline = engine.pm.active
+            rid = "disconnect-1"
+            session = aiohttp.ClientSession()
+            resp = await session.post(
+                f"http://127.0.0.1:{service.port}/v1/completions",
+                json={"model": "m", "prompt": "disconnect me now please",
+                      "stream": True, "max_tokens": 40},
+                headers={"X-Request-Id": rid})
+            assert resp.status == 200
+            chunks = 0
+            async for raw in resp.content:
+                if raw.strip().startswith(b"data: "):
+                    chunks += 1
+                if chunks >= 2:
+                    break                       # drop mid-stream
+            # abort the connection outright (no graceful close)
+            resp.close()
+            await session.close()
+
+            # the upstream must cancel PROMPTLY: pages back to baseline
+            # long before the 40-token budget could finish on its own
+            for _ in range(200):
+                cost = profiling.request_attribution(rid)
+                if engine.pm.active == baseline and cost is not None:
+                    break
+                await asyncio.sleep(0.02)
+            assert engine.pm.active == baseline, "pages leaked"
+            cost = profiling.request_attribution(rid)
+            assert cost is not None
+            assert cost["finish_reason"] == "cancelled"
+            assert cost["decode_tokens"] < 40
+            # no journal entry leaked
+            assert len(revive.journal()) == 0
+
+            await token_client.close()
+            await pub.stop()
+            await handle.stop()
+            await engine.stop()
+        finally:
+            if service is not None:
+                await service.stop()
+            await drt.shutdown()
+
+    run_async(main())
+
+
+# ------------------------------------------------ HTTP shed + POST /drain
+
+
+def _service_with(engine_fn, model="m", admission=None):
+    from dynamo_tpu.llm.http.service import HttpService
+
+    service = HttpService(admission=admission)
+    service.manager.add_completions_model(model, engine_fn)
+    return service
+
+
+def test_http_shed_answers_503_with_derived_retry_after(run_async):
+    async def main():
+        import aiohttp
+
+        async def ok_engine(req, ctx):
+            yield {"id": "cmpl-1", "object": "text_completion",
+                   "created": 1, "model": "m",
+                   "choices": [{"index": 0, "text": "x",
+                                "finish_reason": "stop"}]}
+
+        sig = {"s": revive.LoadSignals(queue_depth=0, workers=1)}
+        ctrl = revive.AdmissionController(
+            lambda: sig["s"], cfg=revive.ShedConfig(queue_depth=2),
+            rng=random.Random(1), window=1)
+        service = _service_with(ok_engine, admission=ctrl)
+        await service.start(host="127.0.0.1", port=0)
+        try:
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{service.port}/v1/completions"
+                async with http.post(url, json={"model": "m",
+                                                "prompt": "x"}) as resp:
+                    assert resp.status == 200
+                sig["s"] = revive.LoadSignals(queue_depth=9, workers=1)
+                async with http.post(url, json={"model": "m",
+                                                "prompt": "x"}) as resp:
+                    body = await resp.json()
+                    assert resp.status == 503
+                    assert body["error"]["type"] == "overloaded_error"
+                    ra = int(resp.headers["Retry-After"])
+                    assert 1 <= ra <= 8
+                assert ctrl.shed_total == 1
+                # the shed shows up on the metrics plane
+                async with http.get(
+                        f"http://127.0.0.1:{service.port}/metrics") as r:
+                    text = await r.text()
+                assert "dyn_shed_requests_total" in text
+        finally:
+            await service.stop()
+
+    run_async(main())
+
+
+def test_http_post_drain_stops_admitting_and_runs_callbacks(run_async):
+    async def main():
+        import aiohttp
+
+        async def ok_engine(req, ctx):
+            yield {"id": "cmpl-1", "object": "text_completion",
+                   "created": 1, "model": "m",
+                   "choices": [{"index": 0, "text": "x",
+                                "finish_reason": "stop"}]}
+
+        drained = []
+
+        async def on_drain():
+            drained.append(True)
+            return True
+
+        service = _service_with(ok_engine)
+        service.on_drain(on_drain)
+        await service.start(host="127.0.0.1", port=0)
+        try:
+            async with aiohttp.ClientSession() as http:
+                base = f"http://127.0.0.1:{service.port}"
+                async with http.post(f"{base}/drain") as resp:
+                    body = await resp.json()
+                assert resp.status == 200 and body["draining"]
+                assert drained == [True]
+                # new work is refused with Retry-After
+                async with http.post(f"{base}/v1/completions",
+                                     json={"model": "m",
+                                           "prompt": "x"}) as resp:
+                    assert resp.status == 503
+                    assert int(resp.headers["Retry-After"]) >= 1
+                # health reports draining; a second drain 409s
+                async with http.get(f"{base}/health") as resp:
+                    assert (await resp.json())["status"] == "draining"
+                async with http.post(f"{base}/drain") as resp:
+                    assert resp.status == 409
+        finally:
+            await service.stop()
+
+    run_async(main())
+
+
+# --------------------------------------------------- fleet failover gate
+
+
+def test_fleet_failover_scenario(run_async):
+    """`python -m dynamo_tpu.fleet --scenario failover`: a loaded worker
+    killed mid-burst + a rolling-drain wave → zero failed requests,
+    nonzero resumed count, some shed (reported, not failed), recovery
+    SLO met."""
+    from dynamo_tpu.fleet.harness import run_scenario
+    from dynamo_tpu.fleet.scenarios import get_scenario
+
+    report = run_async(run_scenario(get_scenario("failover"), seed=0))
+    assert report["requests"]["failed"] == 0
+    assert report["requests"]["resumed"] >= 1
+    fo = report["failover"]
+    assert fo["resumed_requests"] == report["requests"]["resumed"]
+    assert fo["still_crashed"] == 0
+    assert len(fo["drains"]) == 2
+    # drained workers retired cleanly (never counted dead)
+    removed = [e for e in report["workers"]["timeline"]
+               if e["event"] == "removed"]
+    assert len(removed) >= 2
+    assert report["slo"]["met"], report["phases"]
+    assert report["slo"]["time_to_recover_s"] is not None
+    # shed requests are reported as shed, never as failures
+    assert report["requests"]["shed"] == fo["shed_requests"]
