@@ -282,6 +282,13 @@ def parse_args():
                          "stage breakdown (route/prefill/kv_transfer/"
                          "decode span durations) plus a stage rollup "
                          "after the run")
+    ap.add_argument("--report-out", default=None, metavar="PATH",
+                    help="also write the full machine-readable record "
+                         "(the BENCH_r*.json shape: metric/value/unit/"
+                         "vs_baseline + the complete detail report, now "
+                         "incl. the dynaslo goodput/per-role-quantile "
+                         "block) to PATH, so every round lands in the "
+                         "perf trajectory instead of living in stderr")
     ap.add_argument("--sweep", default=None,
                     help="batch-geometry sweep (VERDICT r3 task 3): comma-"
                          "separated conc:max_batch:decode_steps triples, "
@@ -549,7 +556,8 @@ async def _shared_wave(http, port, reqs, osl: int, rows: list) -> dict:
                             first = time.monotonic() - t0
                         text.append(piece)
         texts[rid] = "".join(text)
-        rows.append({"rid": rid, "ttft": first, "error": False})
+        rows.append({"rid": rid, "ttft": first, "error": False,
+                     "e2e": time.monotonic() - t0})
 
     await asyncio.gather(*(one(rid, p) for rid, p in reqs))
     return texts
@@ -722,6 +730,7 @@ async def run_shared(args):
                   "waves": args.turns, "shapes": {}}
         agg_hits = agg_prompts = 0
         ttft_ratios = []
+        all_rows: list = []    # every leg's request rows (dynaslo goodput)
         async with aiohttp.ClientSession() as http:
             for shape in shapes:
                 legs = {}
@@ -736,6 +745,7 @@ async def run_shared(args):
                         publisher, kvr, engine.cap_tokens, tag)
                     st1 = engine.stats()
                     r1 = kvr.stats()
+                    all_rows.extend(rows)
                     hits = (st1["prefix_hit_tokens_total"]
                             - st0["prefix_hit_tokens_total"])
                     prompts = (st1["prompt_tokens_total"]
@@ -800,6 +810,9 @@ async def run_shared(args):
         report["ttft_noshare_over_share"] = (
             round(sum(ttft_ratios) / len(ttft_ratios), 3)
             if ttft_ratios else None)
+        # dynaslo: goodput + per-role quantiles from the engine's merged
+        # latency histograms (every wave's request rows judged)
+        report["slo"] = _slo_block([st], all_rows)
         print(json.dumps(report), file=sys.stderr)
         return report
     finally:
@@ -1016,14 +1029,52 @@ async def run_sharded(args):
 
 
 def _pctile(vals, q):
-    """Deterministic nearest-rank percentile; None on empty."""
-    import math
+    """Deterministic nearest-rank percentile; None on empty (the one
+    shared implementation in runtime/slo.py — dynaslo)."""
+    from dynamo_tpu.runtime.slo import nearest_rank
 
-    if not vals:
-        return None
-    vs = sorted(vals)
-    rank = max(int(math.ceil(q / 100.0 * len(vs))), 1)
-    return vs[rank - 1]
+    return nearest_rank(list(vals), q)
+
+
+# default CPU-smoke objectives for the bench goodput block when no
+# DYN_SLO_OBJECTIVES is set: generous enough that a healthy smoke run
+# scores goodput 1.0 and any wedge/regression scores below it (chip runs
+# set real targets via the env registry)
+_BENCH_DEFAULT_SLO = "ttft<=30@0.95/600;e2e<=120@0.95/600"
+
+
+def _slo_block(stats_list, request_rows=None):
+    """dynaslo bench block: per-role latency quantiles from the workers'
+    MERGED histograms (the same mergeable-histogram plane the metrics
+    aggregator renders) + per-request goodput against the registered
+    (or default CPU-smoke) objectives."""
+    from dynamo_tpu.runtime import slo as _slo
+
+    merged = _slo.merge_latency_wire(
+        [s.get("latency_hist") or {} for s in stats_list])
+    per_role = {
+        role: {metric: {"p50_ms": round(h.quantile(0.5) * 1000, 3),
+                        "p95_ms": round(h.quantile(0.95) * 1000, 3),
+                        "p99_ms": round(h.quantile(0.99) * 1000, 3),
+                        "count": h.count}
+               for metric, h in sorted(per.items()) if h.count}
+        for role, per in sorted(merged.items())}
+    reg = _slo.SloRegistry.from_env()
+    if not reg.objectives:
+        reg = _slo.SloRegistry.parse(_BENCH_DEFAULT_SLO)
+    gp = _slo.GoodputTracker(reg)
+    for r in request_rows or []:
+        if r.get("error") or r.get("shed"):
+            gp.observe_failed()
+            continue
+        metrics = {k: r[k] for k in ("ttft", "itl", "e2e")
+                   if r.get(k) is not None}
+        gp.observe_request(metrics)
+    return {
+        "objectives": [o.to_dict() for o in reg.objectives],
+        "goodput": gp.snapshot(),
+        "per_role_quantiles": per_role,
+    }
 
 
 async def run_failover(args):
@@ -1163,7 +1214,8 @@ async def run_failover(args):
                         chars += len(piece)  # byte tokenizer: chars==tokens
             rows.append({"rid": rid, "shed": False, "error": errored,
                          "ttft": first, "max_gap": max_gap,
-                         "chars": chars})
+                         "chars": chars,
+                         "e2e": time.monotonic() - t0})
 
         # ---------------------------------------- phase 1: churn (kill)
         resumed_before = revive.journal().resumed_total
@@ -1270,6 +1322,11 @@ async def run_failover(args):
             "post_warmup_compiles": {
                 f"w{i}": e.fence.post_warmup_compiles
                 for i, e in enumerate(engines)},
+            # dynaslo: goodput + per-role quantiles from the two
+            # workers' MERGED latency histograms (both phases' requests
+            # judged; shed counts against goodput, it was not served)
+            "slo": _slo_block([e.stats() for e in engines],
+                              rows1 + rows2),
         }
         print(_json.dumps(report), file=sys.stderr)
         return report
@@ -1477,6 +1534,10 @@ async def run_bench(args):
     # (empty/0.0 unless --prof-sample > 0)
     report["device_time_fraction"] = st["device_time_fraction"]
     report["bucket_cost"] = st["bucket_cost"]
+    # dynaslo: per-role latency quantiles from the engine's mergeable
+    # histograms (no per-request rows here — measure() owns the client
+    # view; goodput rides the shared/failover scenarios)
+    report["slo"] = _slo_block([st])
     if getattr(args, "trace", False):
         print(f"trace compile fence: {st['post_warmup_compiles_total']} "
               f"post-warmup XLA compile(s)", file=sys.stderr)
@@ -1835,6 +1896,13 @@ def main():
         return
     if watchdog is not None:
         watchdog.cancel()
+    if getattr(args, "report_out", None):
+        # full machine-readable record for the perf trajectory; must
+        # round-trip through json.load (tier-1 gated)
+        with open(args.report_out, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"report written to {args.report_out}", file=sys.stderr)
     # the ONE line the driver records
     print(json.dumps(record))
 

@@ -45,7 +45,7 @@ from ..llm.protocols.common import (FINISH_CANCELLED, FINISH_EOS,
 from ..models.config import ModelConfig
 from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
-from ..runtime import guard, profiling, tracing
+from ..runtime import guard, profiling, slo, tracing
 from ..runtime.config import env_int
 from ..runtime.engine import Context
 from .jit_fence import CompileFence
@@ -265,6 +265,10 @@ class Sequence:
     host_restored_blocks: int = 0
     restore_t0: Optional[float] = None
     restore_wait_s: float = 0.0
+    # dynaslo: last token-bearing emission (None until the first token
+    # leaves the engine) — TTFT on the first emission, per-token ITL on
+    # every later gap, e2e at finish (all host clock reads, no syncs)
+    last_emit_t: Optional[float] = None
 
     def max_new(self) -> int:
         mt = self.req.stop.max_tokens
@@ -519,7 +523,23 @@ class JaxEngine:
         # instead (ISSUE 11 satellite; totals stay exported alongside)
         self._hit_window: deque = deque(
             maxlen=max(env_int("DYN_CACHE_WINDOW") or 256, 1))
+        # dynaslo: per-role mergeable latency histograms (TTFT, ITL,
+        # queue wait, e2e) — host-side counter arithmetic only, shipped
+        # via stats() → ForwardPassMetrics.latency_hist and merged by
+        # the metrics aggregator into fleet-wide quantiles. The role
+        # defaults to "unified"; disagg wrappers relabel via set_role().
+        self.latency = slo.LatencyRecorder("unified")
         profiling.register_cache(f"jax-engine-{id(self):x}", self)
+
+    @property
+    def role(self) -> str:
+        return self.latency.role
+
+    def set_role(self, role: str) -> None:
+        """Label this engine's serving role (prefill|decode|unified) for
+        the stats plane and latency histograms (dynaslo). Call before
+        serving; earlier observations keep their original role."""
+        self.latency.role = role
 
     # ---------------------------------------------------------- lifecycle
 
@@ -848,6 +868,11 @@ class JaxEngine:
             "worker_label": self.worker_label,
             "mesh_shape": self.mesh_shape,
             "mesh_devices": self.mesh_devices,
+            # dynaslo: serving role + per-role mergeable latency
+            # histograms (TTFT/ITL/queue-wait/e2e) — the aggregator
+            # merges these across workers into fleet-wide quantiles
+            "role": self.role,
+            "latency_hist": self.latency.to_wire(),
             # dynaprof: loop health + sampled device/host split +
             # per-bucket program costs + page-pool occupancy
             "loop_lag_p50_seconds": lag["p50_s"],
@@ -1136,6 +1161,7 @@ class JaxEngine:
                 wait = time.monotonic() - seq.arrival
                 self.queue_wait_seconds_total += wait
                 seq.queue_wait_s = wait
+                self.latency.observe("queue_wait", wait)
                 seq.prefix_hit = seq.computed
                 seq.device_hit_blocks = alloc.device_hit_blocks
                 seq.host_restored_blocks = alloc.host_restored_blocks
@@ -2134,6 +2160,9 @@ class JaxEngine:
         if seq.finish_emitted or seq.finished is None:
             return
         seq.finish_emitted = True
+        # dynaslo e2e: arrival → finish emission (cancel/error finishes
+        # included — a timed-out request IS a latency observation)
+        self.latency.observe("e2e", time.monotonic() - seq.arrival)
         cost = self._attribution(seq)
         profiling.record_attribution(seq.context.id, cost)
         self._emit(seq, EngineOutput(token_ids=[], finish_reason=seq.finished,
@@ -2142,6 +2171,18 @@ class JaxEngine:
                                      cost=cost))
 
     def _emit(self, seq: Sequence, out: EngineOutput) -> None:
+        if out.token_ids:
+            # dynaslo: first token-bearing emission is TTFT; later gaps
+            # are per-token ITL (an n-token window emission records n
+            # per-token gaps of gap/n, so window size never skews the
+            # distribution). Host clock reads only.
+            now = time.monotonic()
+            if seq.last_emit_t is None:
+                self.latency.observe("ttft", now - seq.arrival)
+            else:
+                n = len(out.token_ids)
+                self.latency.observe("itl", (now - seq.last_emit_t) / n, n)
+            seq.last_emit_t = now
         # steps run in the executor thread; asyncio.Queue is not thread-safe,
         # so route puts through the loop
         try:

@@ -13,14 +13,14 @@ from .harness import FleetSim, run_scenario
 from .report import RequestRecord, SloScorer, SloTargets, percentile
 from .scenarios import SCENARIOS, FaultEvent, Scenario, get_scenario
 from .traffic import (PhaseSpec, RequestSpec, TrafficTrace, burst, constant,
-                      diurnal, hot_tenant)
-from .worker import SimEngineModel, SimWorker, WorkerProfile
+                      diurnal, hot_tenant, phased)
+from .worker import PrefillPool, SimEngineModel, SimWorker, WorkerProfile
 
 __all__ = [
     "VirtualClock", "FleetController", "FleetSim", "run_scenario",
     "RequestRecord", "SloScorer", "SloTargets", "percentile",
     "SCENARIOS", "FaultEvent", "Scenario", "get_scenario",
     "PhaseSpec", "RequestSpec", "TrafficTrace", "burst", "constant",
-    "diurnal", "hot_tenant",
-    "SimEngineModel", "SimWorker", "WorkerProfile",
+    "diurnal", "hot_tenant", "phased",
+    "PrefillPool", "SimEngineModel", "SimWorker", "WorkerProfile",
 ]

@@ -110,6 +110,28 @@ class FleetController:
                                 "desired": int(adv["desired_replicas"]),
                                 "workers": []})
                 continue
+            if adv.get("kind") == "pd_shift":
+                # dynaslo P/D rebalance: flip ONE worker's role in place
+                # (newest of the donor role first — mirrors newest-first
+                # scale-down); the scheduler honors the flip on its next
+                # scrape, total replica count unchanged
+                frm, to = adv.get("shift_from"), adv.get("shift_to")
+                donors = [w for w in self.live if w.model.role == frm]
+                if donors:
+                    w = donors[-1]
+                    w.set_role(to)
+                    log.info("fleet controller pd-shift: %s %s->%s",
+                             w.name, frm, to)
+                    actions.append({"action": f"pd-shift:{frm}->{to}",
+                                    "desired":
+                                        int(adv["desired_replicas"]),
+                                    "workers": [w.name]})
+                else:
+                    actions.append({"action": "pd-shift-no-donor",
+                                    "desired":
+                                        int(adv["desired_replicas"]),
+                                    "workers": []})
+                continue
             desired = min(int(adv["desired_replicas"]), self.max_workers)
             live = self.live
             if desired > len(live):

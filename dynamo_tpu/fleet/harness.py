@@ -49,13 +49,14 @@ from ..runtime.component import Client
 from ..runtime.config import env_float
 from ..runtime.dcp_client import pack, unpack
 from ..runtime.runtime import DistributedRuntime
+from ..runtime.slo import GoodputTracker, SloRegistry, collapse_roles
 from ..runtime.tasks import spawn_tracked
 from .clock import VirtualClock
 from .controller import FleetController
 from .k8s_dryrun import K8sDryRun
 from .report import SloScorer
 from .scenarios import Scenario
-from .worker import SimWorker
+from .worker import PrefillPool, SimWorker
 
 log = logging.getLogger("dynamo_tpu.fleet")
 
@@ -91,6 +92,20 @@ class FleetSim:
                 range(scenario.device_pool_size))
         self._sharding_events: List[dict] = []
         self._max_devices_in_use = 0
+        # dynaslo: shared prefill capacity pool (remote_prefill
+        # profiles), explicit SLO registry (objectives evaluated on the
+        # virtual clock inside the aggregator's SloEngine), worker role
+        # assignment sequence, and per-step merged latency snapshots for
+        # the report's per-phase per-role quantiles
+        self.prefill_pool: Optional[PrefillPool] = (
+            PrefillPool() if scenario.profile.remote_prefill else None)
+        self.slo_registry = (
+            SloRegistry.parse(scenario.slo_objectives,
+                              fast_fraction=scenario.slo_fast_fraction,
+                              burn_threshold=scenario.slo_burn_threshold)
+            if scenario.slo_objectives else SloRegistry())
+        self._role_seq = 0
+        self._slo_step_hists: Dict[int, dict] = {}
         # dynarevive: SLO-aware shed controller (wired in setup() when
         # the scenario sets shed_queue_depth)
         self.admission: Optional[revive.AdmissionController] = None
@@ -127,7 +142,9 @@ class FleetSim:
                                scrape_interval=1.0, seed=self.seed)
         await self.router.start(run_loop=False)
 
-        self.agg = MetricsAggregator(self.drt, NAMESPACE, COMPONENT)
+        self.agg = MetricsAggregator(self.drt, NAMESPACE, COMPONENT,
+                                     slo_registry=self.slo_registry,
+                                     slo_clock=self.clock.now)
         await self.agg.start(run_loop=False)
 
         self.planner = Planner(
@@ -138,7 +155,10 @@ class FleetSim:
                          service=COMPONENT,
                          config=sc.planner)],
             apply=sc.k8s_dry_run,
-            clock=self.clock.now, wall_clock=self.clock.now)
+            clock=self.clock.now, wall_clock=self.clock.now,
+            # dynaslo advisory input: the aggregator's SLO engine burn
+            # rates (virtual clock) feed the P/D rebalance policy
+            pressure_source=self.agg.slo.pressures)
         await self.planner.start(run_loop=False)
 
         if sc.k8s_dry_run:
@@ -198,11 +218,21 @@ class FleetSim:
                                            in_use)
             submesh = idx
         drt = await DistributedRuntime.attach(self.drt.dcp.address)
+        # dynaslo P/D roles: in remote-prefill scenarios the first
+        # initial_prefill_workers spawned are the prefill side, every
+        # later spawn (scale-up, join) lands decode-side; the planner's
+        # pd policy then re-ratios by flipping roles
+        role = "unified"
+        if self.prefill_pool is not None:
+            role = ("prefill"
+                    if self._role_seq < self.scenario.initial_prefill_workers
+                    else "decode")
+        self._role_seq += 1
         worker = SimWorker(
             drt, NAMESPACE, COMPONENT, name, self.scenario.profile,
             self.scenario.block_size, self.clock.now,
             lambda rid, ev, vt, n=name: self._lifecycle(n, rid, ev, vt),
-            submesh=submesh)
+            submesh=submesh, role=role, prefill_pool=self.prefill_pool)
         await worker.start()
         return worker
 
@@ -303,6 +333,15 @@ class FleetSim:
         return list(self.controller.workers.values())
 
     async def _advance_workers(self) -> None:
+        if self.prefill_pool is not None:
+            # shared prefill capacity this step = the prefill-role side
+            # of the fleet (role flips change this one step later — the
+            # actuation latency the rebalance loop pays)
+            capacity = sum(
+                self.scenario.profile.prefill_tokens_per_step
+                for w in self.controller.live
+                if w.model.role == "prefill")
+            self.prefill_pool.step(capacity)
         for worker in self._workers_in_order():
             events = worker.model.step()
             if events and not worker.draining:
@@ -351,6 +390,11 @@ class FleetSim:
                     self.scorer.worker_event(vt, "spawn", name)
                 elif act["action"] == "scale-down":
                     self.scorer.worker_event(vt, "drain", name)
+                elif act["action"].startswith("pd-shift"):
+                    # dynaslo role flip: record it on the worker
+                    # timeline (no discovery churn — the flip is a
+                    # stats-plane label the scheduler honors next scrape)
+                    self.scorer.worker_event(vt, act["action"], name)
         if actions:
             await self._sync_discovery()
         if self.k8s is not None:
@@ -448,6 +492,10 @@ class FleetSim:
             await self._inject(step)
         await self._advance_workers()
         await self._scrape()
+        # dynaslo: per-step fleet-merged latency snapshot (fresh
+        # Histogram objects each call) — the report diffs these at phase
+        # boundaries into per-phase per-role quantiles
+        self._slo_step_hists[step] = self.agg.merged_latency()
         await self.planner.tick()
         await self._actuate()
         self._fleet_sample()
@@ -531,6 +579,8 @@ class FleetSim:
                 "drains": [e for e in self.scorer.worker_events
                            if e["event"] == "drain"],
             }
+        if self.slo_registry.objectives or self.prefill_pool is not None:
+            extra["dynaslo"] = self._dynaslo_block()
         if self.device_pool is not None:
             # dynashard plane: the submesh-assignment story of the run —
             # every partition/release with its virtual timestamp, the
@@ -574,6 +624,118 @@ class FleetSim:
             "queue_wait_seconds_total": round(
                 sum(m.queue_wait_seconds_total for m in wm), 6),
         }
+
+    def _phase_role_quantiles(self) -> Dict[str, dict]:
+        """Per-phase, per-role latency quantiles from the mergeable
+        histograms: phase window = snapshot at the phase's last step
+        minus the snapshot before its first (the FINAL phase extends
+        through the drain tail so late observations land somewhere).
+        Counters are monotonic, so diffs are exact."""
+        steps_rec = sorted(self._slo_step_hists)
+        if not steps_rec:
+            return {}
+        last = steps_rec[-1]
+        empty: Dict[str, dict] = {}
+        out: Dict[str, dict] = {}
+        phases = self.trace.phases
+        for i, phase in enumerate(phases):
+            top_step = last if i == len(phases) - 1 \
+                else min(phase.end - 1, last)
+            top = self._slo_step_hists.get(top_step, empty)
+            base = self._slo_step_hists.get(phase.start - 1, empty)
+            rows: Dict[str, dict] = {}
+            for role in sorted(top):
+                per = {}
+                for metric, h in sorted(top[role].items()):
+                    b = base.get(role, {}).get(metric)
+                    d = h.diff(b) if b is not None else h
+                    if d.count == 0:
+                        continue
+                    per[metric] = {"p50": d.quantile(0.5),
+                                   "p95": d.quantile(0.95),
+                                   "p99": d.quantile(0.99),
+                                   "count": d.count}
+                if per:
+                    rows[role] = per
+            out[phase.name] = rows
+        return out
+
+    def _dynaslo_block(self) -> dict:
+        """The dynaslo story of the run: objective evaluation + alert
+        timeline off the aggregator's SLO engine (virtual clock),
+        goodput over the request records, per-phase per-role quantiles,
+        the prefill pool's totals, and the post-rebalance verdict the
+        pd_rebalance scenario regression-gates (final-phase TTFT p95 and
+        ITL p99 vs their objective thresholds)."""
+        gp = GoodputTracker(self.slo_registry)
+        for rec in self.scorer.records.values():
+            if rec.status != "ok":
+                gp.observe_failed()
+                continue
+            metrics: Dict[str, float] = {}
+            if rec.ttft is not None:
+                metrics["ttft"] = rec.ttft
+            if rec.queue_wait is not None:
+                metrics["queue_wait"] = rec.queue_wait
+            if rec.done_vt is not None and rec.arrival_vt is not None:
+                metrics["e2e"] = rec.done_vt - rec.arrival_vt
+            gp.observe_request(metrics)
+        phase_q = self._phase_role_quantiles()
+        block = {
+            "registry": self.slo_registry.to_dict(),
+            "evaluation": self.agg.slo.evaluate(),
+            "alerts": list(self.agg.slo.alert_events),
+            "pressures": self.agg.slo.pressures(),
+            "goodput": gp.snapshot(),
+            "phase_role_quantiles": phase_q,
+        }
+        if self.prefill_pool is not None:
+            block["prefill_pool"] = {
+                "enqueued": self.prefill_pool.enqueued_total,
+                "completed": self.prefill_pool.completed_total,
+                "final_depth": self.prefill_pool.depth,
+            }
+            block["roles_final"] = {
+                name: w.model.role
+                for name, w in sorted(self.controller.workers.items())}
+        # post-rebalance verdict: final-phase quantiles (role-collapsed)
+        # against the ttft/itl objective thresholds
+        if self.trace.phases and phase_q:
+            final = self.trace.phases[-1].name
+            rows = phase_q.get(final, {})
+            hists: Dict[str, dict] = {}
+            for role, per in rows.items():
+                hr = {}
+                for m in per:
+                    h = self._hist_for(final, role, m)
+                    if h is not None:
+                        hr[m] = h
+                hists[role] = hr
+            merged = collapse_roles(hists)
+            verdict: Dict[str, object] = {"phase": final}
+            for metric, q, tag in (("ttft", 0.95, "ttft_p95_s"),
+                                   ("itl", 0.99, "itl_p99_s")):
+                h = merged.get(metric)
+                val = h.quantile(q) if h is not None and h.count else None
+                verdict[tag] = val
+                objs = self.slo_registry.for_metric(metric)
+                if objs:
+                    verdict[f"{metric}_met"] = (
+                        val is not None and val <= objs[0].threshold_s)
+            block["post_rebalance"] = verdict
+        return block
+
+    def _hist_for(self, phase_name: str, role: str, metric: str):
+        """The final-phase window histogram for (role, metric) — same
+        diff _phase_role_quantiles renders quantiles from."""
+        steps_rec = sorted(self._slo_step_hists)
+        last = steps_rec[-1]
+        phase = next(p for p in self.trace.phases if p.name == phase_name)
+        top = self._slo_step_hists[last].get(role, {}).get(metric)
+        base = self._slo_step_hists.get(
+            phase.start - 1, {}).get(role, {}).get(metric)
+        return top.diff(base) if (top is not None and base is not None) \
+            else top
 
     def _cache_block(self) -> dict:
         """Predicted (router overlap scoring) vs realized (worker-side

@@ -15,10 +15,10 @@ JSON on any host. The report carries:
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from ..runtime.slo import nearest_rank
 from .traffic import TrafficTrace
 
 
@@ -56,13 +56,10 @@ class RequestRecord:
         return self.first_token_vt - self.arrival_vt
 
 
-def percentile(values: List[float], q: float) -> Optional[float]:
-    """Deterministic nearest-rank percentile (q in [0, 100])."""
-    if not values:
-        return None
-    vs = sorted(values)
-    rank = max(int(math.ceil(q / 100.0 * len(vs))), 1)
-    return vs[rank - 1]
+# One property-tested percentile implementation everywhere (dynaslo):
+# the former ad-hoc copy here moved to runtime/slo.py, where the
+# mergeable histogram's bucket quantiles are tested against it.
+percentile = nearest_rank
 
 
 @dataclass

@@ -16,9 +16,9 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from ..planner.policy import PlannerConfig
+from ..planner.policy import PdConfig, PlannerConfig
 from .report import SloTargets
-from .traffic import TrafficTrace, burst, constant, diurnal, hot_tenant
+from .traffic import TrafficTrace, burst, constant, diurnal, hot_tenant, phased
 from .worker import WorkerProfile
 
 
@@ -74,6 +74,16 @@ class Scenario:
     # seeded jittered Retry-After) once the fleet-wide admission queue
     # exceeds this many waiting requests PER live worker. 0 = off.
     shed_queue_depth: int = 0
+    # dynaslo: how many of the FIRST spawned workers take the prefill
+    # role (only meaningful with profile.remote_prefill — the shared-
+    # prefill-pool P/D scenarios); later spawns land decode-side
+    initial_prefill_workers: int = 0
+    # dynaslo: SLO objectives for the run (DYN_SLO_OBJECTIVES grammar,
+    # windows in VIRTUAL seconds), evaluated by the aggregator's
+    # SloEngine on the virtual clock; None = no objectives
+    slo_objectives: Optional[str] = None
+    slo_fast_fraction: float = 0.1
+    slo_burn_threshold: float = 2.0
 
 
 def _smoke() -> Scenario:
@@ -277,6 +287,54 @@ def _failover() -> Scenario:
     )
 
 
+def _pd_rebalance() -> Scenario:
+    """dynaslo closed loop (ROADMAP item 4): a fleet of 2 prefill + 4
+    decode workers shares a prefill pool. Mid-run the trace turns
+    prefill-heavy (same request rate, much longer prompts), the pool
+    backlogs, TTFT burns its error budget and the multi-window alert
+    fires; the planner's pd policy answers with a decode→prefill role
+    shift (total replicas unchanged), the scheduler stops routing to the
+    flipped worker, pool capacity rises and TTFT p95 recovers to SLO —
+    with decode headroom sized so ITL p99 never regresses past its own
+    objective. Byte-identical per seed like every scenario."""
+    phases = [
+        {"name": "balanced", "steps": 10, "rate": 2.0, "prompt_words": 15},
+        {"name": "prefill-heavy", "steps": 20, "rate": 2.0,
+         "prompt_words": 40},
+        {"name": "rebalanced", "steps": 18, "rate": 2.0,
+         "prompt_words": 40},
+    ]
+    steps = sum(p["steps"] for p in phases)
+    return Scenario(
+        name="pd_rebalance", steps=steps,
+        traffic=lambda seed: phased(seed, phases=phases, max_tokens=12),
+        initial_workers=6,
+        initial_prefill_workers=2,
+        profile=WorkerProfile(slots=6, total_slots=32,
+                              tokens_per_step=4,
+                              remote_prefill=True,
+                              prefill_tokens_per_step=200,
+                              decode_budget_per_step=24),
+        # replica scaling disabled (0-thresholds) — this scenario isolates
+        # the role-shift loop; the pd policy is the only actuator
+        planner=PlannerConfig(min_replicas=6, max_replicas=6,
+                              cache_high_water=0.0,
+                              cache_low_water=-1.0,
+                              waiting_per_worker_high=0.0,
+                              queue_depth_per_worker_high=0.0,
+                              pd=PdConfig(enabled=True,
+                                          ttft_burn_high=1.5,
+                                          itl_burn_high=1.5,
+                                          min_prefill=1, min_decode=2,
+                                          shift_cooldown_s=8.0)),
+        slo_objectives="ttft<=2.5@0.95/16;itl<=0.25@0.95/16",
+        slo_fast_fraction=0.25,
+        slo_burn_threshold=1.5,
+        slo=SloTargets(ttft_p95=4.0, queue_wait_p95=3.0),
+        disturb_end_step=30,
+    )
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "smoke": _smoke,
     "burst": _burst,
@@ -288,6 +346,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "join": _join,
     "sharded": _sharded,
     "failover": _failover,
+    "pd_rebalance": _pd_rebalance,
 }
 
 

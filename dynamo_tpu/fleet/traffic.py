@@ -146,6 +146,34 @@ def diurnal(seed: int, *, steps: int, low_rate: float, peak_rate: float,
     return _materialize(seed, rates, phases, max_tokens=max_tokens)
 
 
+def phased(seed: int, *, phases: List[dict],
+           max_tokens: int = 16) -> TrafficTrace:
+    """Piecewise trace where each named phase sets its own arrival rate
+    AND prompt length — the dynaslo P/D-rebalance shape: a window whose
+    prompts grow long turns the workload prefill-heavy at constant
+    request rate (TTFT pressure without ITL pressure).
+
+    ``phases``: ``[{"name", "steps", "rate", "prompt_words",
+    "max_tokens"?}, ...]`` applied back to back."""
+    rng = random.Random(seed)
+    reqs: List[RequestSpec] = []
+    phase_specs: List[PhaseSpec] = []
+    n = 0
+    step0 = 0
+    for ph in phases:
+        end = step0 + int(ph["steps"])
+        phase_specs.append(PhaseSpec(ph["name"], step0, end))
+        for step in range(step0, end):
+            for _ in range(_arrivals(rng, float(ph["rate"]))):
+                reqs.append(RequestSpec(
+                    rid=f"r{n:05d}", step=step,
+                    prompt=_prompt(rng, int(ph["prompt_words"])),
+                    max_tokens=int(ph.get("max_tokens", max_tokens))))
+                n += 1
+        step0 = end
+    return TrafficTrace(requests=reqs, phases=phase_specs, seed=seed)
+
+
 def hot_tenant(seed: int, *, steps: int, rate: float,
                hot_share: float = 0.7, prefix_words: int = 48,
                max_tokens: int = 16) -> TrafficTrace:

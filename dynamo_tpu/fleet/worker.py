@@ -47,6 +47,7 @@ from ..llm.protocols.common import EngineOutput, PreprocessedRequest
 from ..runtime.dcp_client import pack
 from ..runtime.engine import Context
 from ..runtime.runtime import DistributedRuntime
+from ..runtime.slo import LatencyRecorder
 
 log = logging.getLogger("dynamo_tpu.fleet.worker")
 
@@ -63,13 +64,29 @@ class WorkerProfile:
     tokens_per_step: int = 8        # decode tokens released per step
     kv_total_blocks: int = 4096
     publish_kv_events: bool = True  # feed the router's radix index
+    # dynaslo P/D modeling (all default-off: the legacy scenarios'
+    # behavior is bit-identical when unset):
+    # remote_prefill — admitted requests enqueue their prompt into the
+    # harness's shared PrefillPool instead of counting local
+    # prefill_steps; the first token releases once the pool has
+    # processed the prompt (disagg: prefill capacity is fleet-shared).
+    remote_prefill: bool = False
+    # tokens of shared prefill capacity ONE prefill-role worker
+    # contributes to the pool per virtual step
+    prefill_tokens_per_step: int = 0
+    # shared decode-token budget per worker per step, split evenly over
+    # in-decode requests (each still capped by tokens_per_step) — decode
+    # contention now shows up as ITL, not just queue wait. 0 = legacy
+    # fixed tokens_per_step per request.
+    decode_budget_per_step: int = 0
 
 
 class _SimRequest:
     """One request inside the model."""
 
     __slots__ = ("rid", "token_ids", "max_tokens", "prompt_tokens",
-                 "prefill_left", "tokens_left", "events", "finished")
+                 "prefill_left", "tokens_left", "events", "finished",
+                 "arrival_vt", "pool_left", "pool_done", "last_tok_vt")
 
     def __init__(self, rid: str, token_ids: List[int], max_tokens: int,
                  prefill_steps: int):
@@ -81,6 +98,52 @@ class _SimRequest:
         self.tokens_left = max(max_tokens, 1)
         self.events: asyncio.Queue = asyncio.Queue()
         self.finished = False
+        # dynaslo: virtual-time stamps for the worker-side latency
+        # histograms + shared-prefill-pool state (remote_prefill mode)
+        self.arrival_vt: float = 0.0
+        self.pool_left: int = 0
+        self.pool_done = False
+        self.last_tok_vt: Optional[float] = None
+
+
+class PrefillPool:
+    """Shared prefill capacity (dynaslo P/D modeling): prefill-role
+    workers pool their ``prefill_tokens_per_step`` and prompts drain
+    FIFO — exactly the disagg shared-queue shape, so shifting a worker
+    decode→prefill raises fleet prefill throughput one step later."""
+
+    def __init__(self) -> None:
+        self.jobs: Deque[_SimRequest] = deque()
+        self.enqueued_total = 0
+        self.completed_total = 0
+
+    def enqueue(self, req: _SimRequest) -> None:
+        req.pool_left = max(req.prompt_tokens, 1)
+        self.jobs.append(req)
+        self.enqueued_total += 1
+
+    @property
+    def depth(self) -> int:
+        return len(self.jobs)
+
+    def backlog_tokens(self) -> int:
+        return sum(r.pool_left for r in self.jobs)
+
+    def step(self, capacity: int) -> None:
+        """Drain up to ``capacity`` prompt tokens FIFO; jobs whose
+        request finished meanwhile (crash/abandon) are skipped free."""
+        while self.jobs and capacity > 0:
+            job = self.jobs[0]
+            if job.finished:
+                self.jobs.popleft()
+                continue
+            take = min(capacity, job.pool_left)
+            job.pool_left -= take
+            capacity -= take
+            if job.pool_left <= 0:
+                job.pool_done = True
+                self.jobs.popleft()
+                self.completed_total += 1
 
 
 class SimEngineModel:
@@ -88,7 +151,9 @@ class SimEngineModel:
 
     def __init__(self, name: str, profile: WorkerProfile, block_size: int,
                  clock: Callable[[], float],
-                 on_lifecycle: Callable[[str, str, float], None]):
+                 on_lifecycle: Callable[[str, str, float], None],
+                 role: str = "unified",
+                 pool: Optional[PrefillPool] = None):
         """``clock`` is the shared virtual clock; ``on_lifecycle(rid,
         event, vt)`` with events ``enqueued|admitted|first_token|done|
         crashed`` feeds the scorer."""
@@ -102,6 +167,12 @@ class SimEngineModel:
         # engine exports (the aggregator's `replica` gauge label)
         self.worker_label = name
         self.mesh_devices = 1
+        # dynaslo: serving role + per-role latency histograms in virtual
+        # time (deterministic), riding the same FPM fields as the real
+        # engine; the shared PrefillPool models disagg prefill capacity
+        self.role = role
+        self.pool = pool
+        self.latency = LatencyRecorder(role)
         self.queue: Deque[_SimRequest] = deque()
         self.active: List[_SimRequest] = []
         self.crashed = False
@@ -118,12 +189,21 @@ class SimEngineModel:
 
     # ------------------------------------------------------------ intake
 
+    def set_role(self, role: str) -> None:
+        """dynaslo P/D rebalance: flip this worker's serving role live.
+        The KV scheduler stops/starts offering it decode work from the
+        next scrape; in-flight requests run to completion; latency
+        observations before the flip stay attributed to the old role."""
+        self.role = role
+        self.latency.role = role
+
     def submit(self, rid: str, token_ids: List[int],
                max_tokens: int) -> _SimRequest:
         if self.crashed:
             raise RuntimeError(f"worker {self.name} crashed")
         req = _SimRequest(rid, token_ids, max_tokens,
                           self.profile.prefill_steps)
+        req.arrival_vt = self.clock()
         self.queue.append(req)
         self.on_lifecycle(rid, "enqueued", self.clock())
         return req
@@ -150,6 +230,12 @@ class SimEngineModel:
             req = self.queue.popleft()
             self.active.append(req)
             self.on_lifecycle(req.rid, "admitted", vt)
+            self.latency.observe("queue_wait", vt - req.arrival_vt)
+            if self.profile.remote_prefill and self.pool is not None:
+                # disagg shape: the prompt's prefill is fleet-shared —
+                # this request decodes once the pool has chewed through
+                # its prompt tokens (FIFO across all decode workers)
+                self.pool.enqueue(req)
             if self.profile.publish_kv_events and req.token_ids:
                 hashes = chain_hashes(req.token_ids, self.block_size)
                 if hashes:
@@ -169,15 +255,50 @@ class SimEngineModel:
                     self._stored_blocks = min(
                         self._stored_blocks + len(hashes),
                         self.profile.kv_total_blocks)
-        # advance in-service requests
+        # advance in-service requests: pass 1 resolves prefill (local
+        # countdown, or the shared pool's verdict in remote mode) and
+        # collects the decode-ready set
+        in_decode: List[_SimRequest] = []
         for req in list(self.active):
-            if req.prefill_left > 0:
-                req.prefill_left -= 1
+            if self.profile.remote_prefill and self.pool is not None:
+                if not req.pool_done:
+                    continue          # prompt still in the shared pool
                 if req.prefill_left > 0:
-                    continue
-                # prefill completed this step → first token batch
-                self.on_lifecycle(req.rid, "first_token", vt)
-            n = min(self.profile.tokens_per_step, req.tokens_left)
+                    # pool finished since last step → first-token boundary
+                    req.prefill_left = 0
+                    self.on_lifecycle(req.rid, "first_token", vt)
+                    self.latency.observe("ttft", vt - req.arrival_vt)
+            else:
+                if req.prefill_left > 0:
+                    req.prefill_left -= 1
+                    if req.prefill_left > 0:
+                        continue
+                    # prefill completed this step → first token batch
+                    self.on_lifecycle(req.rid, "first_token", vt)
+                    self.latency.observe("ttft", vt - req.arrival_vt)
+            in_decode.append(req)
+        # pass 2 releases decode tokens. Legacy (budget 0): every request
+        # gets its full tokens_per_step. Budget mode: the worker's shared
+        # decode throughput splits evenly (deterministic remainder order),
+        # still per-request capped — contention degrades ITL, the signal
+        # the P/D rebalance loop must NOT regress.
+        budget = self.profile.decode_budget_per_step
+        if budget > 0 and in_decode:
+            base, rem = divmod(budget, len(in_decode))
+            grants = [base + (1 if i < rem else 0)
+                      for i in range(len(in_decode))]
+        else:
+            grants = [self.profile.tokens_per_step] * len(in_decode)
+        for req, grant in zip(in_decode, grants):
+            n = min(self.profile.tokens_per_step, grant, req.tokens_left)
+            if n <= 0:
+                continue              # budget-starved this step
+            if req.last_tok_vt is not None:
+                # n per-token gaps of (gap / n): window size never skews
+                # the per-token ITL distribution
+                self.latency.observe(
+                    "itl", (vt - req.last_tok_vt) / n, n)
+            req.last_tok_vt = vt
             req.tokens_left -= n
             done = req.tokens_left <= 0
             req.events.put_nowait((n, "length" if done else None))
@@ -186,6 +307,7 @@ class SimEngineModel:
                 self.active.remove(req)
                 self.served_total += 1
                 self.on_lifecycle(req.rid, "done", vt)
+                self.latency.observe("e2e", vt - req.arrival_vt)
         return kv_events
 
     # ------------------------------------------------------------ faults
@@ -217,6 +339,11 @@ class SimEngineModel:
         return ForwardPassMetrics(
             worker_label=self.worker_label,
             mesh_devices=self.mesh_devices,
+            # dynaslo: role gates the KV scheduler (prefill-role workers
+            # take no routed decode work) and labels the merged latency
+            # histograms in the aggregator
+            role=self.role,
+            latency_hist=self.latency.to_wire(),
             request_active_slots=len(self.active),
             request_total_slots=p.total_slots,
             kv_active_blocks=blocks,
@@ -255,7 +382,9 @@ class SimWorker:
                  block_size: int, clock: Callable[[], float],
                  on_lifecycle: Callable[[str, str, float], None],
                  endpoint: str = "generate_tokens",
-                 submesh: Optional[List[int]] = None):
+                 submesh: Optional[List[int]] = None,
+                 role: str = "unified",
+                 prefill_pool: Optional[PrefillPool] = None):
         self.drt = drt
         self.namespace = namespace
         self.component = component
@@ -266,7 +395,8 @@ class SimWorker:
         # the unsharded fleet scenarios)
         self.submesh = list(submesh) if submesh else None
         self.model = SimEngineModel(name, profile, block_size, clock,
-                                    on_lifecycle)
+                                    on_lifecycle, role=role,
+                                    pool=prefill_pool)
         if self.submesh:
             self.model.mesh_devices = len(self.submesh)
         self.kv_subject = f"{namespace}.{component}.{KV_EVENT_SUBJECT}"
@@ -276,6 +406,9 @@ class SimWorker:
     @property
     def instance_id(self) -> int:
         return self.drt.instance_id
+
+    def set_role(self, role: str) -> None:
+        self.model.set_role(role)
 
     async def start(self) -> None:
         comp = self.drt.namespace(self.namespace).component(self.component)

@@ -48,6 +48,11 @@ class DisaggDecodeEngine:
                  prefill_timeout: Optional[float] = None,
                  max_dispatches: Optional[int] = None):
         self.engine = engine
+        if hasattr(engine, "set_role"):
+            # dynaslo: the wrapped engine serves the decode side of the
+            # disagg split — its TTFT/ITL histograms merge under
+            # role="decode" fleet-wide
+            engine.set_role("decode")
         self.queue = queue
         self.transfer = transfer
         self.router = router

@@ -45,6 +45,11 @@ class PrefillWorker:
 
         self.drt = drt
         self.engine = engine
+        if hasattr(engine, "set_role"):
+            # dynaslo: this engine serves prefill-only — its latency
+            # histograms (queue wait of pulled jobs, prefill-side
+            # timings) merge under role="prefill" fleet-wide
+            engine.set_role("prefill")
         self.namespace = namespace
         # int8-compress shipped pages (~half the DCN bytes; lossy —
         # engine/kv_compress.py). Opt-in: arg, else DYN_KV_TRANSFER_INT8

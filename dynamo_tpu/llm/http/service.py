@@ -80,6 +80,7 @@ class HttpService:
             web.get("/v1/traces", self._traces),
             web.get("/v1/traces/{request_id}", self._trace_one),
             web.get("/debug/cache", self._debug_cache),
+            web.get("/debug/slo", self._debug_slo),
             web.get("/debug/profile", self._debug_profile),
             web.get("/debug/profile/stacks", self._debug_stacks),
             web.post("/debug/profile/start", self._profile_start),
@@ -211,6 +212,13 @@ class HttpService:
         prefix chains, restore queue — plus the KV router's calibration
         counters when a router runs here."""
         return web.json_response({"caches": profiling.caches_snapshot()})
+
+    async def _debug_slo(self, request: web.Request) -> web.Response:
+        """dynaslo snapshot: the registered objectives, their windowed
+        attainment / error budget / fast+slow burn rates / alert state,
+        the planner-facing pressure signals, and goodput (per-request
+        met-all-objectives accounting)."""
+        return web.json_response(self.metrics.slo_snapshot())
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """One-stop profiling snapshot: loop lag + stall-watchdog stats,
@@ -383,6 +391,12 @@ class HttpService:
                 log.exception("request %s failed", ctx.id)
                 return _error_response(500, repr(e), hdrs)
             finally:
+                # dynaslo goodput: streams record their full
+                # ttft/itl/e2e set in _sse; everything else that entered
+                # serving (unary, 5xx) is judged on e2e alone
+                if not getattr(mguard, "slo_observed", False):
+                    self.metrics.observe_request_slo(
+                        {"e2e": time.monotonic() - mguard.t0})
                 mguard.done()
 
     def _retry_after(self) -> int:
@@ -408,10 +422,14 @@ class HttpService:
         errored = False
         saw_first_token = False
         last_token_t: Optional[float] = None
+        # dynaslo goodput inputs for this request (mean ITL over the gaps)
+        ttft_s: Optional[float] = None
+        itl_total, itl_n = 0.0, 0
 
         async def _write_chunk(chunk) -> bool:
             """Writes one stream item; returns False to stop the stream."""
             nonlocal errored, saw_first_token, last_token_t
+            nonlocal ttft_s, itl_total, itl_n
             if chunk is None:
                 return True
             if isinstance(chunk, Annotated) and chunk.event and chunk.data is None:
@@ -431,11 +449,14 @@ class HttpService:
                 return True
             now = time.monotonic()
             if not saw_first_token:
-                self.metrics.observe_ttft(req.model, now - t0)
+                ttft_s = now - t0
+                self.metrics.observe_ttft(req.model, ttft_s)
                 saw_first_token = True
             elif last_token_t is not None:
                 # inter-token latency: gap between successive data chunks
                 self.metrics.observe_itl(req.model, now - last_token_t)
+                itl_total += now - last_token_t
+                itl_n += 1
             last_token_t = now
             await resp.write(b"data: " + json.dumps(data).encode() + b"\n\n")
             return True
@@ -482,6 +503,16 @@ class HttpService:
                                  json.dumps(repr(e)).encode() + b"\n\n")
             except (ConnectionError, RuntimeError):
                 pass
+        # dynaslo goodput: one verdict per stream that ran to a close
+        # (clean, timeout or error — a failed stream is a bad-latency
+        # observation, not a skipped one); disconnects re-raised above
+        req_slo = {"e2e": time.monotonic() - t0}
+        if ttft_s is not None:
+            req_slo["ttft"] = ttft_s
+        if itl_n:
+            req_slo["itl"] = itl_total / itl_n
+        self.metrics.observe_request_slo(req_slo)
+        mguard.slo_observed = True
         await resp.write_eof()
         return resp
 

@@ -26,6 +26,18 @@ class ForwardPassMetrics:
     worker_label: str = ""
     mesh_shape: str = ""
     mesh_devices: int = 1
+    # dynaslo: the worker's serving role (prefill|decode|unified). The
+    # KV scheduler never routes token requests to a prefill-role worker
+    # (disagg prefill capacity is fed from the shared queue, not the
+    # router), the planner's P/D rebalance policy counts roles, and the
+    # aggregator labels every merged latency histogram with it.
+    role: str = "unified"
+    # dynaslo: per-role mergeable latency histograms
+    # ({role: {ttft|itl|queue_wait|e2e: wire histogram}}) recorded by
+    # the worker and MERGED by the metrics aggregator into the first
+    # fleet-wide latency quantiles (runtime/slo.py fixed bucket grid:
+    # lossless merge, nearest-bucket quantiles).
+    latency_hist: dict = field(default_factory=dict)
     # dynarevive graceful drain: 1 while the worker is finishing its
     # in-flight sequences after withdrawing from discovery. Draining ≠
     # dead — the stats plane keeps answering (no breaker opens) and the

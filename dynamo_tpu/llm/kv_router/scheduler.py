@@ -102,6 +102,12 @@ class KvScheduler:
                 continue
             if getattr(w.metrics, "draining", 0):
                 continue
+            if getattr(w.metrics, "role", "") == "prefill":
+                # dynaslo P/D roles: a prefill-role worker takes its work
+                # from the shared prefill queue, never routed decode
+                # requests (the fleet P/D rebalance flips roles live —
+                # the next scrape moves it out of the candidate set)
+                continue
             if w.saturated():
                 continue
             overlap = min(overlaps.scores.get(wid, 0), isl_blocks)

@@ -14,7 +14,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Dict, Optional
+import time
+from typing import Callable, Dict, Optional
 
 from ..llm.kv_router.protocols import KV_HIT_RATE_SUBJECT, ForwardPassMetrics
 from ..runtime.component import Client, EndpointAddress
@@ -22,6 +23,8 @@ from ..runtime.config import env_str
 from ..runtime import wire
 from ..runtime.dcp_client import unpack
 from ..runtime.runtime import DistributedRuntime
+from ..runtime.slo import (Histogram, SloEngine, SloRegistry, collapse_roles,
+                           merge_latency_wire, render_role_histograms)
 from ..runtime.tasks import backoff_interval, cancel_join, spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.metrics")
@@ -32,7 +35,9 @@ class MetricsAggregator:
 
     def __init__(self, drt: DistributedRuntime, namespace: str,
                  component: str, endpoint: str = "generate_tokens",
-                 interval: float = 2.0):
+                 interval: float = 2.0,
+                 slo_registry: Optional[SloRegistry] = None,
+                 slo_clock: Callable[[], float] = time.monotonic):
         self.drt = drt
         self.namespace = namespace
         self.address = EndpointAddress(namespace, component, endpoint)
@@ -47,6 +52,17 @@ class MetricsAggregator:
         # the exposition instead of only the logs)
         self.scrape_failures_total = 0
         self.consecutive_scrape_failures = 0
+        # dynaslo: fold each scraped worker's per-role latency histograms
+        # into a run-long per-worker view (a drained worker's histogram
+        # leaves worker_metrics with it, but its observations happened)
+        # and evaluate the SLO registry over the fleet-merged result on
+        # every scrape. The clock is injectable: wall time in serving,
+        # virtual time in the fleet simulator.
+        self._latency_seen: Dict[int, dict] = {}  # guarded-by: loop
+        self.slo = SloEngine(
+            slo_registry if slo_registry is not None
+            else SloRegistry.from_env(),
+            source=self.merged_latency_all_roles, clock=slo_clock)
         self._client: Optional[Client] = None
         self._task: Optional[asyncio.Task] = None
         self._sid: Optional[int] = None
@@ -112,6 +128,36 @@ class MetricsAggregator:
             if wid not in live and (wid not in self._client.instances
                                     or wid in evicted):
                 del self.worker_metrics[wid]
+        # dynaslo: per-worker histograms are monotonic counters, so the
+        # newest scrape simply overwrites; departed workers keep their
+        # last-seen contribution (fleet totals never regress on a drain)
+        for wid, m in self.worker_metrics.items():
+            if m.latency_hist:
+                self._latency_seen[wid] = m.latency_hist
+        self.slo.tick()
+
+    # ----------------------------------------------------- dynaslo merging
+
+    def merged_latency(self) -> Dict[str, Dict[str, Histogram]]:
+        """Fleet-wide ``{role: {metric: Histogram}}`` — every worker's
+        latency histograms losslessly merged (the first cross-worker
+        latency view; per-worker gauges could never aggregate)."""
+        return merge_latency_wire(self._latency_seen.values())
+
+    def merged_latency_all_roles(self) -> Dict[str, Histogram]:
+        """Role-collapsed merge — the SLO engine's evaluation source."""
+        return collapse_roles(self.merged_latency())
+
+    def slo_snapshot(self) -> dict:
+        """The aggregator-side /debug/slo payload: registry, evaluation,
+        pressures, alert timeline, plus merged per-role quantiles."""
+        snap = self.slo.snapshot()
+        snap["quantiles"] = {
+            role: {metric: {"p50": h.quantile(0.5), "p95": h.quantile(0.95),
+                            "p99": h.quantile(0.99), "count": h.count}
+                   for metric, h in sorted(per.items())}
+            for role, per in sorted(self.merged_latency().items())}
+        return snap
 
     # ------------------------------------------------------------- render
 
@@ -329,6 +375,15 @@ class MetricsAggregator:
         lines.append("# TYPE dyn_metrics_evicted_instances gauge")
         lines.append(f'dyn_metrics_evicted_instances{{namespace="{ns}"}} '
                      f'{evicted}')
+        # dynaslo plane: fleet-merged per-role latency histograms (the
+        # first cross-worker TTFT/ITL/queue-wait/e2e quantiles) plus the
+        # SLO registry's attainment / error-budget / burn-rate / alert /
+        # pressure gauges
+        if getattr(self, "_latency_seen", None) is not None:
+            nslabel = f'namespace="{ns}"'
+            lines.extend(render_role_histograms(self.merged_latency(),
+                                                labels=nslabel))
+            lines.extend(self.slo.render_prom_lines(labels=nslabel))
         # dynaguard plane: per-endpoint breaker state gauges + counters
         from ..runtime import guard
 

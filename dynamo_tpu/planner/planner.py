@@ -32,7 +32,8 @@ from ..runtime.dcp_client import pack, unpack
 from ..runtime.runtime import DistributedRuntime
 from ..runtime.tasks import cancel_join, spawn_tracked
 from .policy import (PLANNER_ADVISORY_SUBJECT, PLANNER_KV_PREFIX,
-                     ComponentSnapshot, PlannerConfig, ScaleAdvisory, decide)
+                     ComponentSnapshot, PlannerConfig, ScaleAdvisory, decide,
+                     decide_pd)
 
 from ..admin.store import DEPLOYMENT_PREFIX
 
@@ -55,7 +56,8 @@ class Planner:
     def __init__(self, drt: DistributedRuntime, namespace: str = "dynamo",
                  targets: Optional[List[WatchTarget]] = None,
                  interval: float = 5.0, apply: bool = False,
-                 clock=time.monotonic, wall_clock=time.time):
+                 clock=time.monotonic, wall_clock=time.time,
+                 pressure_source=None):
         self.drt = drt
         self.namespace = namespace
         self.targets = targets or []
@@ -65,9 +67,15 @@ class Planner:
         # ``at`` on the wire: injectable so simulated runs (fleet sim) get
         # advisory timestamps on the same virtual clock as everything else
         self.wall_clock = wall_clock
+        # dynaslo advisory input: a zero-arg callable returning the SLO
+        # engine's pressure dict ({"ttft_pressure": burn, ...}) — the
+        # P/D rebalance policy (PlannerConfig.pd) consumes it. None
+        # disables P/D decisions regardless of config.
+        self.pressure_source = pressure_source
         self._clients: Dict[str, Client] = {}
         self._last_up: Dict[str, float] = {}
         self._last_down: Dict[str, float] = {}
+        self._last_shift: Dict[str, float] = {}
         self._task: Optional[asyncio.Task] = None
         self.advisories: List[ScaleAdvisory] = []   # emitted this lifetime
 
@@ -137,16 +145,31 @@ class Planner:
                 last_up_at=self._last_up.get(t.component, float("-inf")),
                 last_down_at=self._last_down.get(
                     t.component, float("-inf")))
-            if adv is None:
-                continue
-            adv.at = self.wall_clock()   # wall time on the wire
-            if adv.direction == "up":
-                self._last_up[t.component] = now
-            elif adv.direction == "down":
-                self._last_down[t.component] = now
-            await self._emit(t, adv)
-            out.append(adv)
-            self.advisories.append(adv)
+            if adv is not None:
+                adv.at = self.wall_clock()   # wall time on the wire
+                if adv.direction == "up":
+                    self._last_up[t.component] = now
+                elif adv.direction == "down":
+                    self._last_down[t.component] = now
+                await self._emit(t, adv)
+                out.append(adv)
+                self.advisories.append(adv)
+            # dynaslo P/D rebalance: a second, independent decision per
+            # tick — shift one worker between prefill and decode roles
+            # when one side's SLO error budget burns while the other has
+            # slack (pressures come from the SLO engine's fast windows)
+            if (t.config.pd is not None and t.config.pd.enabled
+                    and self.pressure_source is not None):
+                shift = decide_pd(
+                    snap, t.config.pd, self.pressure_source(), now=now,
+                    last_shift_at=self._last_shift.get(
+                        t.component, float("-inf")))
+                if shift is not None:
+                    shift.at = self.wall_clock()
+                    self._last_shift[t.component] = now
+                    await self._emit(t, shift)
+                    out.append(shift)
+                    self.advisories.append(shift)
         return out
 
     async def _emit(self, t: WatchTarget, adv: ScaleAdvisory) -> None:
@@ -161,7 +184,10 @@ class Planner:
         # between "scaled to zero" and "briefly unobservable" (rolling
         # restart / scrape timeout), and shrinking a live deployment to
         # min_replicas on a scrape blip would be destructive
-        if self.apply and t.deployment and adv.current_replicas > 0:
+        # pd_shift advisories keep the replica count — nothing to apply
+        # to the deployment spec; the fleet controller actuates the flip
+        if (self.apply and t.deployment and adv.kind == "scale"
+                and adv.current_replicas > 0):
             await self._apply(t, adv)
 
     async def _apply(self, t: WatchTarget, adv: ScaleAdvisory,

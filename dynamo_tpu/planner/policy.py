@@ -35,6 +35,29 @@ PLANNER_KV_PREFIX = "planner/advisories/"
 
 
 @dataclass
+class PdConfig:
+    """P/D rebalance policy knobs (dynaslo → ROADMAP item 4).
+
+    The planner shifts one worker between the prefill and decode roles
+    (total replicas unchanged) when ONE side's SLO error budget is
+    burning (pressure = the dynaslo fast-window burn rate of that
+    metric's objective) while the other side has slack. TTFT pressure =
+    prefill capacity short; ITL pressure = decode capacity short."""
+
+    enabled: bool = False
+    # pressure (fast burn rate) above which a shift toward that side is
+    # warranted; 1.0 = burning exactly the error budget
+    ttft_burn_high: float = 1.0
+    itl_burn_high: float = 1.0
+    # never shift a side below these floors
+    min_prefill: int = 1
+    min_decode: int = 1
+    # hysteresis between shifts (role flips churn in-flight work less
+    # than spawns, but flapping still wastes warm capacity)
+    shift_cooldown_s: float = 20.0
+
+
+@dataclass
 class PlannerConfig:
     min_replicas: int = 1
     max_replicas: int = 8
@@ -47,6 +70,8 @@ class PlannerConfig:
     # hysteresis
     scale_up_cooldown_s: float = 30.0
     scale_down_cooldown_s: float = 180.0
+    # dynaslo P/D rebalance (None/disabled = replica scaling only)
+    pd: Optional[PdConfig] = None
 
     def clamp(self, n: int) -> int:
         return max(self.min_replicas, min(self.max_replicas, n))
@@ -75,6 +100,25 @@ class ComponentSnapshot:
     def total_waiting(self) -> int:
         return sum(m.num_requests_waiting for m in self.metrics.values())
 
+    def role_counts(self) -> Dict[str, int]:
+        """Workers per serving role (dynaslo P/D rebalance input; a
+        legacy worker without the role field counts as unified)."""
+        out: Dict[str, int] = {}
+        for m in self.metrics.values():
+            role = getattr(m, "role", "") or "unified"
+            out[role] = out.get(role, 0) + 1
+        return out
+
+    @property
+    def prefill_replicas(self) -> int:
+        return self.role_counts().get("prefill", 0)
+
+    @property
+    def decode_replicas(self) -> int:
+        """Decode-capable workers (decode + unified)."""
+        rc = self.role_counts()
+        return rc.get("decode", 0) + rc.get("unified", 0)
+
 
 @dataclass
 class ScaleAdvisory:
@@ -86,6 +130,13 @@ class ScaleAdvisory:
     desired_replicas: int
     reason: str
     at: float = 0.0
+    # dynaslo P/D rebalance: kind="pd_shift" advisories keep the replica
+    # count but move one worker shift_from → shift_to ("prefill"/
+    # "decode"). kind="scale" (the default) is the classic replica
+    # advisory; absent fields on the wire = legacy scale advisory.
+    kind: str = "scale"
+    shift_from: str = ""
+    shift_to: str = ""
 
     @property
     def direction(self) -> str:
@@ -96,18 +147,25 @@ class ScaleAdvisory:
         return "hold"
 
     def to_dict(self) -> dict:
-        return {"component": self.component,
-                "current_replicas": self.current_replicas,
-                "desired_replicas": self.desired_replicas,
-                "reason": self.reason, "at": self.at,
-                "direction": self.direction}
+        d = {"component": self.component,
+             "current_replicas": self.current_replicas,
+             "desired_replicas": self.desired_replicas,
+             "reason": self.reason, "at": self.at,
+             "direction": self.direction, "kind": self.kind}
+        if self.kind == "pd_shift":
+            d["shift_from"] = self.shift_from
+            d["shift_to"] = self.shift_to
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "ScaleAdvisory":
         return cls(component=d["component"],
                    current_replicas=int(d["current_replicas"]),
                    desired_replicas=int(d["desired_replicas"]),
-                   reason=d["reason"], at=float(d.get("at", 0.0)))
+                   reason=d["reason"], at=float(d.get("at", 0.0)),
+                   kind=d.get("kind", "scale"),
+                   shift_from=d.get("shift_from", ""),
+                   shift_to=d.get("shift_to", ""))
 
 
 def decide(snap: ComponentSnapshot, cfg: PlannerConfig, *, now: float,
@@ -179,4 +237,42 @@ def decide(snap: ComponentSnapshot, cfg: PlannerConfig, *, now: float,
             f"cache usage {usage:.2f} < {cfg.cache_low_water:.2f}, "
             f"idle queue", at=now)
 
+    return None
+
+
+def decide_pd(snap: ComponentSnapshot, pd: PdConfig,
+              pressures: Dict[str, float], *, now: float,
+              last_shift_at: float = float("-inf")
+              ) -> Optional[ScaleAdvisory]:
+    """P/D rebalance decision (pure, like :func:`decide`).
+
+    ``pressures`` is the dynaslo pressure dict
+    ({"ttft_pressure": fast burn, "itl_pressure": fast burn, ...}): TTFT
+    burning while ITL has slack → convert one decode worker to prefill;
+    the mirror image converts one back. One shift per cooldown, floors
+    respected, and the DOMINANT pressure wins a tie so the loop cannot
+    oscillate inside a single evaluation."""
+    if not pd.enabled or snap.replicas == 0:
+        return None
+    if now - last_shift_at < pd.shift_cooldown_s:
+        return None
+    ttft_p = pressures.get("ttft_pressure", 0.0)
+    itl_p = pressures.get("itl_pressure", 0.0)
+    n = snap.replicas
+    if (ttft_p > pd.ttft_burn_high and ttft_p >= itl_p
+            and snap.decode_replicas > pd.min_decode):
+        return ScaleAdvisory(
+            snap.component, n, n,
+            f"ttft burn {ttft_p:.2f} > {pd.ttft_burn_high:.2f} "
+            f"(itl burn {itl_p:.2f}): shift decode->prefill",
+            at=now, kind="pd_shift",
+            shift_from="decode", shift_to="prefill")
+    if (itl_p > pd.itl_burn_high and itl_p > ttft_p
+            and snap.prefill_replicas > pd.min_prefill):
+        return ScaleAdvisory(
+            snap.component, n, n,
+            f"itl burn {itl_p:.2f} > {pd.itl_burn_high:.2f} "
+            f"(ttft burn {ttft_p:.2f}): shift prefill->decode",
+            at=now, kind="pd_shift",
+            shift_from="prefill", shift_to="decode")
     return None

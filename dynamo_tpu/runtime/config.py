@@ -141,6 +141,25 @@ register_env("DYN_SHED_RETRY_CAP_S", "8", "runtime",
              "dynarevive admission control: ceiling (seconds) on the "
              "load-derived, jittered Retry-After answered with shed / "
              "no-capacity 503s.")
+register_env("DYN_SLO_BURN_THRESHOLD", "2.0", "runtime",
+             "dynaslo: error-budget burn rate BOTH the fast and slow "
+             "windows must exceed before an objective's multi-window "
+             "alert fires (1.0 = spending exactly the budget).")
+register_env("DYN_SLO_FAST_FRACTION", "0.1", "runtime",
+             "dynaslo: the fast alert window as a fraction of each "
+             "objective's window (SRE multi-window burn-rate pattern: "
+             "the fast window catches the spike, the slow window proves "
+             "it is sustained).")
+register_env("DYN_SLO_FILE", None, "runtime",
+             "dynaslo: path to a file of SLO objectives, one per line "
+             "('#' comments), same grammar as DYN_SLO_OBJECTIVES. "
+             "Ignored when DYN_SLO_OBJECTIVES is set.")
+register_env("DYN_SLO_OBJECTIVES", None, "runtime",
+             "dynaslo: ';'-separated SLO objectives, grammar "
+             "[name=]metric<=threshold_s@target/window_s over metrics "
+             "ttft|itl|queue_wait|e2e — e.g. 'ttft<=0.5@0.95/300;"
+             "itl<=0.05@0.99/300'. Unset = no objectives (latency "
+             "histograms still recorded and rendered).")
 register_env("DYN_STATS_TIMEOUT", "2.0", "runtime",
              "Per-instance stats-plane scrape probe timeout in seconds.")
 register_env("DYN_STEP_TIMELINE", "512", "runtime",

@@ -149,7 +149,7 @@ def test_crash_scenario_evicts_and_rescales(run_async):
 
 @pytest.mark.slow
 @pytest.mark.parametrize("name", ["diurnal", "hot-tenant", "blackout",
-                                  "join"])
+                                  "join", "pd_rebalance"])
 def test_scenario_sweep(run_async, name):
     report = run_async(run_scenario(get_scenario(name), seed=1))
     assert report["requests"]["completed"] > 0
