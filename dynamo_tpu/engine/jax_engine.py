@@ -1173,6 +1173,7 @@ class JaxEngine:
                 self.prefix_hit_tokens_total += seq.computed
                 self.prompt_tokens_total += seq.num_prompt
                 self._hit_window.append((seq.computed, seq.num_prompt))
+            # proto: request.lifecycle admitted->prefill
             self.prefilling.append(seq)
 
     # ------------------------------------------------------- KV tier drain
@@ -1320,6 +1321,7 @@ class JaxEngine:
                 # resumed sequence fully covered by the prefix cache
                 self.prefilling.remove(seq)
                 seq.last_token = seq.tokens[-1]
+                # proto: request.lifecycle prefill->decode
                 self.running.append(seq)
                 continue
             if (self.long_prefill_fn is not None
@@ -1487,10 +1489,12 @@ class JaxEngine:
             self._append_token(seq, int(np.asarray(toks_d)[0]),
                                lp=self._lp_entry(seq, aux, 0))
             if seq.finished is None:
+                # proto: request.lifecycle prefill->decode
                 self.running.append(seq)
         else:
             # resumed after preemption: next token already sampled
             seq.last_token = seq.tokens[-1]
+            # proto: request.lifecycle prefill->decode
             self.running.append(seq)
 
     def _long_bucket(self, extent: int) -> int:
@@ -1518,10 +1522,12 @@ class JaxEngine:
                 self._append_token(seq, int(toks[i]),
                                    lp=self._lp_entry(seq, aux, i))
                 if seq.finished is None:
+                    # proto: request.lifecycle prefill->decode
                     self.running.append(seq)
             else:
                 # resumed after preemption: last token already sampled
                 seq.last_token = seq.tokens[-1]
+                # proto: request.lifecycle prefill->decode
                 self.running.append(seq)
 
     # -------------------------------------------------------------- decode
@@ -1559,6 +1565,7 @@ class JaxEngine:
                 self.running.remove(victim)
                 self._release(victim)
                 victim.computed = 0  # keep tokens/generated: resume not redo
+                # proto: request.lifecycle decode->admitted
                 self.waiting.insert(0, victim)
                 if victim is seq:
                     break
@@ -2089,6 +2096,7 @@ class JaxEngine:
         if seq in self.running:
             self.running.remove(seq)
         if seq.finished is None:
+            # proto: request.lifecycle prefill|decode->finished|timeout|cancelled
             seq.finished = reason
         self._release_or_defer(seq)
 
@@ -2104,6 +2112,7 @@ class JaxEngine:
 
     def _finish(self, seq: Sequence, reason: str) -> None:
         if seq.finished is None:
+            # proto: request.lifecycle admitted->finished|timeout|cancelled
             seq.finished = reason
         self._emit_finish(seq)
 

@@ -119,6 +119,7 @@ class FleetController:
                 donors = [w for w in self.live if w.model.role == frm]
                 if donors:
                     w = donors[-1]
+                    # proto: planner.pd_shift advisory->actuated
                     w.set_role(to)
                     log.info("fleet controller pd-shift: %s %s->%s",
                              w.name, frm, to)
@@ -127,6 +128,7 @@ class FleetController:
                                         int(adv["desired_replicas"]),
                                     "workers": [w.name]})
                 else:
+                    # proto: planner.pd_shift advisory->idle
                     actions.append({"action": "pd-shift-no-donor",
                                     "desired":
                                         int(adv["desired_replicas"]),

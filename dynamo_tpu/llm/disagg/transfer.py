@@ -332,6 +332,7 @@ class KvTransferServer:
                 msg = await st.queue.get()  # dynalint: disable=unbounded-await
                 if msg is None:  # sender abort
                     self.streams_failed += 1
+                    # proto: kv_transfer.stream streaming->aborted
                     self._fail_waiter(request_id, RuntimeError(
                         "sender aborted transfer mid-stream"))
                     return
@@ -349,7 +350,7 @@ class KvTransferServer:
                     # per-chunk late-write guard: the decode side may have
                     # timed out and released these pages — they can belong
                     # to another request now, so drop the payload
-                    st.failed = True
+                    st.failed = True  # proto: kv_transfer.stream streaming->failed
                     st.error = "unknown/cancelled request"
                     log.warning("dropping KV chunk %d for unknown/cancelled "
                                 "request %s", chunk_idx, request_id)
@@ -360,7 +361,7 @@ class KvTransferServer:
                     except Exception as exc:  # noqa: BLE001 — report + fail fast
                         log.exception("KV ingest failed for %s chunk %d",
                                       request_id, chunk_idx)
-                        st.failed = True
+                        st.failed = True  # proto: kv_transfer.stream streaming->failed
                         st.error = str(exc)
                         self.streams_failed += 1
                         self._fail_waiter(request_id, exc)
@@ -370,7 +371,7 @@ class KvTransferServer:
                         fut = self._waiters.pop(request_id, None)
                         if fut is not None and not fut.done():
                             fut.set_result(int(h["first_token"]))
-                        st.committed = True
+                        st.committed = True  # proto: kv_transfer.stream streaming->committed
                         ack["committed"] = True
                         if h.get("trace"):
                             # receiver-side stage span, joined to the
@@ -383,7 +384,7 @@ class KvTransferServer:
                                             "bytes": st.bytes,
                                             "chunks": st.received})
                     else:
-                        st.failed = True
+                        st.failed = True  # proto: kv_transfer.stream streaming->failed
                         st.error = (f"incomplete stream: {st.received}"
                                     f"/{n_chunks} chunks")
                         self.streams_failed += 1
@@ -401,6 +402,7 @@ class KvTransferServer:
         except asyncio.CancelledError:
             if not st.committed:
                 self.streams_failed += 1
+                # proto: kv_transfer.stream streaming->failed
                 self._fail_waiter(request_id, ConnectionError(
                     "KV transfer connection dropped mid-stream"))
             raise

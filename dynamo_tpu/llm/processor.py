@@ -129,6 +129,7 @@ class _RemoteTokenEngine:
         session = revive.ReviveSession(request, context)
         # a killed (abandoned) request must not leak its journal entry
         # until the generator finalizer runs
+        # proto: revive.journal open->closed
         context.on_kill(session.close)
         attempt_req = request
         target = self.worker_id
@@ -154,6 +155,7 @@ class _RemoteTokenEngine:
                         yield session.synthetic_finish()
                         return
                     session.mark_resume()
+                    # proto: request.lifecycle resumed->prefill
                     attempt_req = session.resume_request()
                     target = await self._pick_resume_target(
                         attempt_req, context, target)
@@ -164,7 +166,7 @@ class _RemoteTokenEngine:
                         f"{target:x}" if target is not None
                         else "round-robin", session.resumes)
         finally:
-            session.close()
+            session.close()  # proto: revive.journal open->closed
 
     async def _pick_resume_target(self, request: PreprocessedRequest,
                                   context: Context,

@@ -254,6 +254,8 @@ def decide_pd(snap: ComponentSnapshot, pd: PdConfig,
     oscillate inside a single evaluation."""
     if not pd.enabled or snap.replicas == 0:
         return None
+    # the cooldown gate readmits the next shift decision
+    # proto: planner.pd_shift actuated->idle
     if now - last_shift_at < pd.shift_cooldown_s:
         return None
     ttft_p = pressures.get("ttft_pressure", 0.0)
@@ -261,6 +263,7 @@ def decide_pd(snap: ComponentSnapshot, pd: PdConfig,
     n = snap.replicas
     if (ttft_p > pd.ttft_burn_high and ttft_p >= itl_p
             and snap.decode_replicas > pd.min_decode):
+        # proto: planner.pd_shift idle->advisory
         return ScaleAdvisory(
             snap.component, n, n,
             f"ttft burn {ttft_p:.2f} > {pd.ttft_burn_high:.2f} "
@@ -269,6 +272,7 @@ def decide_pd(snap: ComponentSnapshot, pd: PdConfig,
             shift_from="decode", shift_to="prefill")
     if (itl_p > pd.itl_burn_high and itl_p > ttft_p
             and snap.prefill_replicas > pd.min_prefill):
+        # proto: planner.pd_shift idle->advisory
         return ScaleAdvisory(
             snap.component, n, n,
             f"itl burn {itl_p:.2f} > {pd.itl_burn_high:.2f} "

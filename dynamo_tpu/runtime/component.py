@@ -18,7 +18,7 @@ from dataclasses import dataclass
 from typing import (Any, AsyncIterator, Awaitable, Callable, Dict, List,
                     Optional, Tuple)
 
-from . import guard, tracing, wire
+from . import guard, proto, tracing, wire
 from .codec import TwoPartMessage
 from .config import env_float, env_int
 from .dcp_client import DcpClient, Message, NoRespondersError, pack, unpack
@@ -202,12 +202,16 @@ class ServeHandle:
         self._sids: List[int] = []
         self._inflight: Dict[str, Context] = {}
         self._stopped = asyncio.Event()
-        # dynarevive lifecycle: draining = discovery record withdrawn,
-        # new requests nacked, in-flight streams finishing, stats plane
+        # dynarevive lifecycle (declared as `serve_handle.drain` in
+        # runtime/proto.py): draining = discovery record withdrawn, new
+        # requests nacked, in-flight streams finishing, stats plane
         # still answering (draining ≠ dead). dead = a worker.kill chaos
         # rule fired — the wedged-process shape (lease + discovery record
-        # stay, nothing answers).
+        # stay, nothing answers). _drain_started makes begin_drain
+        # idempotent while keeping the nack flag OFF until the discovery
+        # delete has completed (delete-before-nack ordering).
         self.draining = False
+        self._drain_started = False
         self._dead = False
 
     async def _start(self) -> None:
@@ -234,14 +238,16 @@ class ServeHandle:
 
     async def stop(self) -> None:
         drt = self.endpoint.drt
-        self._stopped.set()
+        self._stopped.set()  # proto: serve_handle.drain live|draining->stopped
         # claim the subscriptions before the awaits: a concurrent
         # stop()/drain() interleaving must not double-unsubscribe
         sids, self._sids = self._sids, []
         for sid in sids:
             try:
                 await drt.dcp.unsubscribe(sid)
-            except Exception:
+            # teardown sweep: every subscription must be attempted even
+            # when one fails; no request path runs through here
+            except Exception:  # dynalint: disable=typed-error-swallow
                 log.debug("unsubscribe %d failed during stop", sid,
                           exc_info=True)
         await self._withdraw_discovery()
@@ -253,25 +259,36 @@ class ServeHandle:
                            self.instance.endpoint, self.instance.instance_id)
         try:
             await self.endpoint.drt.dcp.kv_delete(key)
-        except Exception:
+        # best-effort withdraw on the way out: the lease expiry is the
+        # backstop; no client response rides on this path
+        except Exception:  # dynalint: disable=typed-error-swallow
             log.debug("discovery withdraw failed for %s",
                       self.instance.subject, exc_info=True)
 
     # ------------------------------------------------- dynarevive: drain
 
     async def begin_drain(self) -> None:
-        """Enter the draining state: delete the discovery record (every
-        watching client drops this instance; routers stop picking it),
-        nack any request that still reaches the subjects, keep answering
-        stats with ``draining=1``, and let in-flight streams finish.
-        Draining ≠ dead: nothing errors, no breaker opens."""
-        if self.draining:
-            return
-        self.draining = True
+        """Enter the draining state: delete the discovery record FIRST
+        (every watching client drops this instance; routers stop picking
+        it), only then nack any request that still reaches the subjects,
+        keep answering stats with ``draining=1``, and let in-flight
+        streams finish. Draining ≠ dead: nothing errors, no breaker
+        opens.
+
+        Ordering is load-bearing (model-checked `delete-before-nack`
+        invariant of the `serve_handle.drain` machine): flipping the
+        nack flag before the delete lands would have clients re-picking
+        this still-discoverable instance into repeated nacks until
+        their retry budget dies."""
+        if self._drain_started:  # claim-before-await: double begin_drain
+            return               # must not double-withdraw (draining=True
+        self._drain_started = True  # implies _drain_started)
         log.info("draining %s (instance %x, %d in flight)",
                  self.endpoint.path, self.instance.instance_id,
                  len(self._inflight))
-        await self._withdraw_discovery()
+        await self._withdraw_discovery()  # proto: serve_handle.drain live->live
+        proto.step("serve_handle.drain", "live", "draining")
+        self.draining = True
 
     async def wait_idle(self, timeout_s: float) -> bool:
         """Wall-bounded wait for the in-flight set to empty. Returns
@@ -308,7 +325,7 @@ class ServeHandle:
         and every in-flight context is killed so engine pages free."""
         if self._dead:
             return
-        self._dead = True
+        self._dead = True  # proto: serve_handle.drain live|draining->dead
         log.warning("chaos worker.kill: instance %x of %s is now dead "
                     "(lease and discovery record left behind)",
                     self.instance.instance_id, self.endpoint.path)
@@ -316,7 +333,9 @@ class ServeHandle:
         for sid in sids:
             try:
                 await self.endpoint.drt.dcp.unsubscribe(sid)
-            except Exception:
+            # chaos-kill teardown: a wedged process answers nothing, so
+            # nothing here can owe a typed error to a client
+            except Exception:  # dynalint: disable=typed-error-swallow
                 log.debug("unsubscribe during chaos kill failed",
                           exc_info=True)
         for ctx in self._inflight.values():
@@ -376,6 +395,7 @@ class ServeHandle:
         if self.draining:
             # drain admits nothing new: a typed nack the Client maps to
             # "request rejected" (retry lands on a live sibling)
+            # proto: serve_handle.drain draining->draining
             if msg.needs_reply:
                 await msg.respond(pack(wire.checked(wire.DCP_REQUEST_ACK, {
                     "accepted": False,
@@ -445,13 +465,19 @@ class ServeHandle:
         except asyncio.CancelledError:
             if callhome:
                 await callhome.error("worker cancelled")
-        except Exception as e:  # noqa: BLE001
+        # not a swallow: the exception crosses the wire as an err frame
+        # whose `kind` is the exception class name — AsyncResponseStream
+        # re-raises DeadlineExceeded/NoCapacity/NoRespondersError typed
+        # on the caller side, so the 504/503 mappers still see them
+        except Exception as e:  # noqa: BLE001  # dynalint: disable=typed-error-swallow
             log.exception("handler failed for %s", req_id)
             if callhome:
                 try:
                     await callhome.error(str(e), kind=type(e).__name__)
-                except Exception:
-                    pass
+                except (ConnectionError, RuntimeError):
+                    # conn already dead: the caller sees the drop anyway
+                    log.debug("error frame for %s not delivered", req_id,
+                              exc_info=True)
         finally:
             self._inflight.pop(req_id, None)
             if callhome:

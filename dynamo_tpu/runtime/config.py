@@ -95,6 +95,12 @@ register_env("DYN_PROF_STALL_MS", "250", "runtime",
              "dynaprof: loop-callback overrun (ms) past which the stall "
              "watchdog captures the event-loop thread's Python stack "
              "into the flamegraph ring; 0 disables the watchdog thread.")
+register_env("DYN_PROTO_VALIDATE", "0", "runtime",
+             "Debug mode: validate every proto.step(...) lifecycle "
+             "anchor against the runtime/proto.py protocol registry at "
+             "transition time (1/true). Default off — the static "
+             "dynaproto pass (DL019/DL020) and the model checker are "
+             "the production gates.")
 register_env("DYN_REQUEST_DEADLINE_MS", "0", "runtime",
              "Default end-to-end request deadline in milliseconds, "
              "applied at the HTTP frontend when the request carries "

@@ -73,6 +73,7 @@ from dataclasses import dataclass, field
 from typing import (Any, AsyncIterator, Awaitable, Callable, Dict, List,
                     Optional, Tuple)
 
+from . import proto
 from .config import env_float, env_int, env_str
 
 log = logging.getLogger("dynamo_tpu.guard")
@@ -329,17 +330,18 @@ class CircuitBreaker:
             return True
         if self.state == BREAKER_HALF_OPEN:
             if not self._probe_inflight:
-                self._probe_inflight = True
+                self._probe_inflight = True  # proto: breaker half_open->half_open
                 return True
             return False
         # OPEN
-        self.denied_since_open += 1
+        self.denied_since_open += 1  # proto: breaker open->open
         due = (self.cfg.probe_every > 0
                and self.denied_since_open % self.cfg.probe_every == 0)
         if self.cfg.reset_after_s > 0 and \
                 self.clock() - self.opened_at >= self.cfg.reset_after_s:
             due = True
         if due:
+            proto.step("breaker", "open", "half_open")
             self.state = BREAKER_HALF_OPEN
             self._probe_inflight = True
             return True
@@ -349,9 +351,10 @@ class CircuitBreaker:
         """A half-open permit was granted but the caller chose a
         different instance: hand the single probe slot back."""
         if self.state == BREAKER_HALF_OPEN:
-            self._probe_inflight = False
+            self._probe_inflight = False  # proto: breaker half_open->half_open
 
     def record_success(self) -> None:
+        # proto: breaker closed|open|half_open->closed
         self.state = BREAKER_CLOSED
         self.failures = 0
         self.denied_since_open = 0
@@ -367,7 +370,7 @@ class CircuitBreaker:
             self._open()
 
     def _open(self) -> None:
-        self.state = BREAKER_OPEN
+        self.state = BREAKER_OPEN  # proto: breaker closed|half_open->open
         self.opened_at = self.clock()
         self.opened_total += 1
         self.denied_since_open = 0

@@ -80,7 +80,7 @@ class JournalEntry:
         if not token_ids:
             return
         if len(self.tokens) + len(token_ids) > self._bound:
-            self.resumable = False
+            self.resumable = False  # proto: revive.journal open->open
             return
         self.tokens.extend(token_ids)
 
@@ -110,11 +110,14 @@ class ReviveJournal:
         self.opened_total += 1
         while len(self._entries) > self.capacity:
             _, old = self._entries.popitem(last=False)
-            old.resumable = False
+            old.resumable = False  # proto: revive.journal open->open
             self.evicted_total += 1
         return entry
 
     def close(self, request_id: str) -> None:
+        # idempotent pop = the close-exactly-once contract (model-checked
+        # `closes` counter of the revive.journal machine)
+        # proto: revive.journal open->closed
         self._entries.pop(request_id, None)
 
     def get(self, request_id: str) -> Optional[JournalEntry]:
@@ -206,7 +209,7 @@ class ReviveSession:
             # eager ring close: downstream consumers abandon the stream
             # at the finish chunk, so waiting for the generator finalizer
             # would leak the entry until GC
-            self.close()
+            self.close()  # proto: revive.journal open->closed
 
     def close(self) -> None:
         self.ring.close(self.entry.request_id)
@@ -231,12 +234,16 @@ class ReviveSession:
         if isinstance(exc, (guard.DeadlineExceeded, guard.NoCapacity)):
             return False
         if self.context.stopped:
-            return False  # client gone / budget spent: nothing to save
+            # client gone / budget spent: nothing to save — the guard
+            # behind the model-checked no-resume-after-kill invariant
+            # proto: request.lifecycle resumed->cancelled
+            return False
         if not self.entry.resumable:
             return False
         return self.entry.resumes < self.limit
 
     def mark_resume(self) -> None:
+        # proto: request.lifecycle prefill|decode->resumed
         self.entry.resumes += 1
         self.ring.resumed_total += 1
         guard.counter_inc("dyn_revive_resumes_total")

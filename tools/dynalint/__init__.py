@@ -43,15 +43,20 @@ from .callgraph import DEFAULT_DL008_DEPTH, CallGraph, module_name
 from .dynaflow import (FrameSchema, analyze_project, analyze_tree,
                        load_wire_schemas)
 from .dynajit import JitInfo, analyze_jit, collect_jits
+from .dynaproto import (ProtoSchema, analyze_protocols, collect_anchors,
+                        load_protocols, protocols_to_dot)
 from .dynarace import (RaceModel, analyze_races, build_race_model,
                        check_transitive_host_sync, scan_modules)
+from .modelcheck import check_models, check_protocol_models, explore
 
 __all__ = [
     "RULES", "CallGraph", "DEFAULT_DL008_DEPTH", "FrameSchema",
-    "JitInfo", "ModuleSource", "RaceModel", "Violation", "analyze_jit",
-    "analyze_paths", "analyze_project", "analyze_races", "analyze_source",
-    "analyze_tree", "apply_baseline", "build_race_model",
-    "check_transitive_host_sync", "collect_jits", "format_entry",
-    "iter_py_files", "load_source", "load_sources", "load_wire_schemas",
-    "load_baseline", "module_name", "parse_module", "scan_modules",
+    "JitInfo", "ModuleSource", "ProtoSchema", "RaceModel", "Violation",
+    "analyze_jit", "analyze_paths", "analyze_project", "analyze_protocols",
+    "analyze_races", "analyze_source", "analyze_tree", "apply_baseline",
+    "build_race_model", "check_models", "check_protocol_models",
+    "check_transitive_host_sync", "collect_anchors", "collect_jits",
+    "explore", "format_entry", "iter_py_files", "load_protocols",
+    "load_source", "load_sources", "load_wire_schemas", "load_baseline",
+    "module_name", "parse_module", "protocols_to_dot", "scan_modules",
 ]

@@ -6,10 +6,13 @@
 Runs the per-file rules (DL001-DL007, DL011) AND the whole-program
 passes — dynaflow (DL008 call-graph blocking propagation, DL009/DL010
 wire-schema conformance), dynarace (DL012-DL014 concurrency rules +
-interprocedural DL005) and dynajit (DL015-DL017 compilation-stability /
-device-residency rules + the warmup-coverage check) — over one shared
-parse of the tree. ``--all`` is the CI spelling: the default tree,
-every pass.
+interprocedural DL005), dynajit (DL015-DL017 compilation-stability /
+device-residency rules + the warmup-coverage check) and dynaproto
+(DL019/DL020 lifecycle-protocol conformance + the explicit-state model
+checker over the declared machines, DL021 typed-error-swallow) — over
+one shared parse of the tree. ``--all`` is the CI spelling: the default
+tree, every pass; its ``--json`` carries a ``protocols`` block with the
+per-machine state-space counts the model checker explored.
 
 Exit status: 0 when every violation is baselined (stale baseline
 entries still warn on stderr), 1 when new violations exist.
@@ -19,6 +22,10 @@ Tooling extras:
                                 graph: async defs, blocking reach,
                                 concurrency roots and shared-state
                                 touchers annotated
+    --proto-dot machines.dot    Graphviz export of every declared
+                                lifecycle machine with anchored-edge
+                                coverage coloring (green = anchored,
+                                red = drifted)
     --wire-schemas FILE         regenerate docs/wire_schemas.md from the
                                 runtime/wire.py registry
     --write-env-docs FILE       regenerate docs/env_vars.md
@@ -77,6 +84,10 @@ def main(argv=None) -> int:
                     help="write a Graphviz export of the project call "
                          "graph (async defs filled, blocking reach in "
                          "red) and exit")
+    ap.add_argument("--proto-dot", metavar="PATH", default=None,
+                    help="write a Graphviz export of every declared "
+                         "lifecycle machine (runtime/proto.py) with "
+                         "anchored-edge coverage coloring and exit")
     ap.add_argument("--dl008-depth", type=int, default=DEFAULT_DL008_DEPTH,
                     help="max sync call frames between an async def and a "
                          "blocking primitive for DL008 (default %(default)s)")
@@ -126,11 +137,26 @@ def main(argv=None) -> int:
               f"({len(graph.functions)} functions)")
         return 0
 
+    if args.proto_dot:
+        from .dynaproto import analyze_protocols, protocols_to_dot
+
+        sources = load_sources(paths, root=REPO_ROOT)
+        anchors_out: dict = {}
+        analyze_protocols(sources, anchors_out=anchors_out)
+        schemas = anchors_out.get("schemas") or {}
+        with open(args.proto_dot, "w", encoding="utf-8") as f:
+            f.write(protocols_to_dot(schemas,
+                                     anchors_out.get("anchors") or []))
+        print(f"wrote {args.proto_dot} ({len(schemas)} machines)")
+        return 0
+
     t0 = time.perf_counter()
     timings: dict = {}
+    proto_report: dict = {}
     violations = analyze_tree(paths, root=REPO_ROOT,
                               dl008_depth=args.dl008_depth,
-                              timings=timings)
+                              timings=timings,
+                              proto_report=proto_report)
     wall = time.perf_counter() - t0
 
     if args.write_baseline:
@@ -157,7 +183,8 @@ def main(argv=None) -> int:
                           "stale_baseline": stale,
                           "wall_seconds": round(wall, 3),
                           "rule_counts": dict(sorted(rule_counts.items())),
-                          "passes": timings}, indent=2))
+                          "passes": timings,
+                          "protocols": proto_report}, indent=2))
     else:
         for v in violations:
             print(v.render())

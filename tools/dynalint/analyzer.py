@@ -98,6 +98,26 @@ RULES: Dict[str, Tuple[str, str]] = {
               "is off, so every deliberate sync (block_until_ready, "
               "perf_counter-bracketed readback) must sit under an "
               "`if <...sampl.../enabled/active...>:` guard"),
+    # DL019-DL021 are the dynaproto lifecycle-protocol rules
+    # (dynaproto.py + modelcheck.py): they check anchors and mutation
+    # sites against the declared state machines in runtime/proto.py and
+    # model-check the declared invariants, so analyze_source never emits
+    # them — analyze_tree does.
+    "DL019": ("undeclared-transition",
+              "protocol-state mutation or anchor that matches no "
+              "declared edge of its lifecycle machine in "
+              "runtime/proto.py: every transition of a declared state "
+              "machine must name the edge it implements"),
+    "DL020": ("protocol-coverage",
+              "declared protocol edge with no anchoring code site, an "
+              "edge out of a terminal state, a transition breaking the "
+              "machine's declared lock discipline, or a model-checked "
+              "invariant violated in a reachable interleaving"),
+    "DL021": ("typed-error-swallow",
+              "broad except on an HTTP/ServeHandle-reachable await path "
+              "swallows the typed guard errors (DeadlineExceeded, "
+              "NoCapacity, NoRespondersError) that must reach the "
+              "504/503 mappers — peel them off or re-raise"),
 }
 
 NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}

@@ -611,12 +611,16 @@ def analyze_project(sources: Sequence[ModuleSource],
 
 def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
                  dl008_depth: int = DEFAULT_DL008_DEPTH,
-                 timings: Optional[dict] = None) -> List[Violation]:
+                 timings: Optional[dict] = None,
+                 proto_report: Optional[dict] = None) -> List[Violation]:
     """Per-file rules + whole-program dynaflow rules + the dynarace
-    concurrency passes over one tree; the shared parse cache means each
-    file is read and parsed exactly once per run. Pass ``timings={}``
-    to receive per-pass wall seconds (``per_file``/``dynaflow``/
-    ``dynarace``)."""
+    concurrency passes + the dynaproto lifecycle-protocol passes (and
+    their model checker) over one tree; the shared parse cache means
+    each file is read and parsed exactly once per run. Pass
+    ``timings={}`` to receive per-pass wall seconds (``per_file``/
+    ``dynaflow``/``dynarace``/``dynajit``/``dynaproto``/``modelcheck``)
+    and ``proto_report={}`` for the per-machine model-checker stats
+    (``--json``'s ``protocols`` block)."""
     import time as _time
 
     from .analyzer import analyze_module
@@ -654,16 +658,28 @@ def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
     t2 = _time.perf_counter()
     from .dynarace import analyze_races
 
-    out.extend(analyze_races(sources, graph=graph))
+    race_out: dict = {}
+    out.extend(analyze_races(sources, graph=graph, model_out=race_out))
     t3 = _time.perf_counter()
     from .dynajit import analyze_jit
 
     out.extend(analyze_jit(sources, graph=graph))
     t4 = _time.perf_counter()
+    from .dynaproto import analyze_protocols
+
+    out.extend(analyze_protocols(sources, graph=graph,
+                                 race_model=race_out.get("model")))
+    t5 = _time.perf_counter()
+    from .modelcheck import check_protocol_models
+
+    out.extend(check_protocol_models(sources, report_out=proto_report))
+    t6 = _time.perf_counter()
     if timings is not None:
         timings["per_file"] = round(t1 - t0, 3)
         timings["dynaflow"] = round(t2 - t1, 3)
         timings["dynarace"] = round(t3 - t2, 3)
         timings["dynajit"] = round(t4 - t3, 3)
+        timings["dynaproto"] = round(t5 - t4, 3)
+        timings["modelcheck"] = round(t6 - t5, 3)
     out.sort(key=lambda v: (v.path, v.line, v.code))
     return out

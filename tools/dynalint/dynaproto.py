@@ -1,0 +1,847 @@
+"""dynaproto: static lifecycle-protocol conformance (DL019-DL021).
+
+The protocol registry in ``dynamo_tpu/runtime/proto.py`` declares each
+failure-handling state machine once as a pure literal (same contract as
+PR 5's wire registry: this pass parses the file with ``ast.literal_eval``
+and never imports the runtime package). Code sites *anchor* their
+transitions either with a call::
+
+    proto.step("breaker", "open", "half_open")
+
+or with a comment on the mutation line (or the line directly above)::
+
+    self.state = BREAKER_OPEN   # proto: breaker closed|half_open->open
+
+``|`` separates alternative states (the full cross product must be
+declared); ``,`` separates several transitions in one anchor.
+
+Rules, all tier-1-enforced with an EMPTY baseline:
+
+- **DL019 undeclared-transition** — an anchor naming an unknown machine,
+  an unknown state, or a (from, to) pair that is not a declared edge;
+  and a store to a declared protocol-state attribute (the machine's
+  ``owners`` list) outside ``__init__`` that carries no anchor: every
+  protocol-state mutation must say which declared edge it implements.
+- **DL020 unreachable/missing-coverage** — a declared edge no code site
+  anchors (the model and the code have drifted); an edge declared out
+  of a terminal state (flagged at the registration); and — via
+  dynarace's concurrency-root inference — an anchored transition
+  reachable from ≥2 concurrent roots that breaks the machine's declared
+  ``lock`` discipline (``"loop"``: the anchored statement must not
+  straddle an ``await``; ``"self.<attr>"``: the anchor must hold that
+  lock). Model-checker invariant violations (``modelcheck.py``) are
+  also reported under this code, at the machine's registration line.
+- **DL021 typed-error-swallow** — a broad ``except Exception`` /
+  ``except BaseException`` on a path reachable from an HTTP handler or
+  a ``ServeHandle`` whose try body awaits, with no re-raise, no earlier
+  typed clause, and no mention of the typed guard errors
+  (``DeadlineExceeded``, ``NoCapacity``, ``NoRespondersError``) in the
+  handler: those must reach the 504/503 mappers, never collapse into a
+  generic 500.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .analyzer import RULES, ModuleSource, Violation, dotted
+from .callgraph import CallGraph
+
+PROTO_MODULE_REL = "dynamo_tpu/runtime/proto.py"
+
+# comment anchor:  # proto: <machine> <from>[|<from>...]-><to>[|<to>...]
+#                  [, <from>-><to> ...]
+PROTO_COMMENT_RE = re.compile(r"#\s*proto:\s*([\w.\-]+)\s+([^#]+)")
+_TRANSITION_RE = re.compile(
+    r"^\s*([\w|]+)\s*->\s*([\w|]+)\s*$")
+
+# DL021: the typed guard errors that must reach the HTTP error mappers,
+# plus the broader names whose presence in an earlier except clause or
+# the handler body proves the typed path is handled before/inside the
+# broad catch.
+TYPED_GUARD_ERRORS = frozenset({
+    "DeadlineExceeded", "NoCapacity", "NoRespondersError"})
+TYPED_HANDLED_NAMES = TYPED_GUARD_ERRORS | frozenset({
+    "TimeoutError", "CancelledError"})
+
+
+# ------------------------------------------------------------------ schemas
+
+@dataclass(frozen=True)
+class ProtoSchema:
+    """Statically-extracted twin of runtime ``proto.ProtoMachine``."""
+
+    name: str
+    states: Tuple[str, ...]
+    initial: str
+    terminal: Tuple[str, ...]
+    lock: Optional[str]
+    owners: Tuple[Tuple[str, str], ...]
+    edges: Tuple[dict, ...]               # normalized edge dicts
+    vars: Tuple[Tuple[str, tuple], ...]
+    init: Tuple[Tuple[str, object], ...]
+    env: Tuple[dict, ...]
+    invariants: Tuple[dict, ...]
+    depth: int
+    line: int                             # registration line
+    const: str                            # bound module constant
+
+    @property
+    def edge_pairs(self) -> frozenset:
+        return frozenset((e["from"], e["to"]) for e in self.edges)
+
+
+def _norm_schema_edge(e: dict, env: bool = False) -> dict:
+    when = {}
+    for k, v in (e.get("when") or {}).items():
+        when[k] = tuple(v) if isinstance(v, (tuple, list)) else (v,)
+    return {
+        "from": "" if env else e["from"], "to": "" if env else e["to"],
+        "name": e.get("name") or f"{e.get('from')}->{e.get('to')}",
+        "when": when, "set": dict(e.get("set") or {}),
+        "doc": e.get("doc", "")}
+
+
+def load_protocols(ms: ModuleSource
+                   ) -> Tuple[Dict[str, ProtoSchema], List[Violation]]:
+    """Parse ``register_protocol`` declarations out of the proto module.
+    Non-literal declarations fail loudly (they would silently fall out
+    of the static pass); structural errors (edges out of terminal
+    states, undeclared states) are DL020 at the registration line."""
+    schemas: Dict[str, ProtoSchema] = {}
+    bad: List[Violation] = []
+    d19, d20 = RULES["DL019"][0], RULES["DL020"][0]
+    for node in ms.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "register_protocol"):
+            continue
+        const = node.targets[0].id
+        call = node.value
+        try:
+            name = ast.literal_eval(call.args[0])
+            kw = {k.arg: ast.literal_eval(k.value) for k in call.keywords}
+        except (ValueError, SyntaxError):
+            bad.append(Violation(
+                ms.path, node.lineno, node.col_offset, "DL019", d19,
+                f"register_protocol({const}) uses non-literal arguments: "
+                f"the static conformance pass cannot see this machine",
+                "<module>"))
+            continue
+        states = tuple(kw.get("states", ()))
+        terminal = tuple(kw.get("terminal", ()))
+        edges = tuple(_norm_schema_edge(e) for e in kw.get("edges", ()))
+        for e in edges:
+            if e["from"] not in states or e["to"] not in states:
+                bad.append(Violation(
+                    ms.path, node.lineno, 0, "DL019", d19,
+                    f"machine `{name}` edge `{e['name']}` uses "
+                    f"undeclared state(s) "
+                    f"`{e['from']}`->`{e['to']}`", name))
+            elif e["from"] in terminal:
+                bad.append(Violation(
+                    ms.path, node.lineno, 0, "DL020", d20,
+                    f"machine `{name}` edge `{e['name']}` leaves "
+                    f"terminal state `{e['from']}`", name))
+        schemas[name] = ProtoSchema(
+            name=name, states=states,
+            initial=kw.get("initial", ""), terminal=terminal,
+            lock=kw.get("lock"),
+            owners=tuple((str(m), str(a))
+                         for m, a in kw.get("owners", ())),
+            edges=edges,
+            vars=tuple(sorted((k, tuple(v)) for k, v in
+                              (kw.get("vars") or {}).items())),
+            init=tuple(sorted((kw.get("init") or {}).items())),
+            env=tuple(_norm_schema_edge(e, env=True)
+                      for e in kw.get("env", ())),
+            invariants=tuple(dict(i) for i in kw.get("invariants", ())),
+            depth=int(kw.get("depth", 64)),
+            line=node.lineno, const=const)
+    return schemas, bad
+
+
+# ------------------------------------------------------------------ anchors
+
+@dataclass
+class Anchor:
+    """One code site declaring protocol transitions."""
+
+    machine: str
+    transitions: List[Tuple[str, str]]    # (from, to) cross product
+    path: str
+    line: int
+    func_key: Optional[str]               # '<module>:<qualname>' or None
+    kind: str                             # 'call' | 'comment'
+    raw: str = ""
+    has_await: bool = False               # statement straddles an await
+    locks: frozenset = frozenset()        # normalized lock ids held
+
+
+@dataclass
+class OwnerStore:
+    """A store to a declared protocol-state attribute."""
+
+    machine: str
+    attr: str
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class _ProtoScanOut:
+    anchors: List[Anchor] = field(default_factory=list)
+    stores: List[OwnerStore] = field(default_factory=list)
+    bad: List[Violation] = field(default_factory=list)
+
+
+def _parse_comment_anchor(text: str
+                          ) -> Optional[Tuple[str, List[Tuple[str, str]],
+                                              List[str]]]:
+    """Parse the transitions of one comment anchor. Returns
+    (machine, [(from, to), ...], errors); None when the line carries no
+    anchor at all."""
+    m = PROTO_COMMENT_RE.search(text)
+    if m is None:
+        return None
+    machine = m.group(1)
+    body = m.group(2).strip()
+    transitions: List[Tuple[str, str]] = []
+    errors: List[str] = []
+    for part in (p.strip() for p in body.split(",") if p.strip()):
+        tm = _TRANSITION_RE.match(part)
+        if tm is None:
+            errors.append(f"malformed transition {part!r} "
+                          f"(want from[|from]->to[|to])")
+            continue
+        froms = [s for s in tm.group(1).split("|") if s]
+        tos = [s for s in tm.group(2).split("|") if s]
+        for f in froms:
+            for t in tos:
+                transitions.append((f, t))
+    return machine, transitions, errors
+
+
+class _AnchorScan(ast.NodeVisitor):
+    """Collect call anchors, owner-attribute stores and per-statement
+    await/lock context for one module."""
+
+    def __init__(self, ms: ModuleSource, schemas: Dict[str, ProtoSchema],
+                 modname: str):
+        from .analyzer import LOCK_NAME_RE
+
+        self.ms = ms
+        self.schemas = schemas
+        self.modname = modname
+        self.out = _ProtoScanOut()
+        self._lock_re = LOCK_NAME_RE
+        self._classes: List[str] = []
+        self._funcs: List[str] = []
+        self._locks: List[str] = []
+        self._step_imported = False   # `from ...proto import step`
+        # lines whose enclosing statement contains an Await
+        self._await_lines: Set[int] = set()
+        for node in ast.walk(ms.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                 ast.Expr, ast.Return)):
+                if any(isinstance(sub, ast.Await) for sub in ast.walk(node)):
+                    end = getattr(node, "end_lineno", node.lineno)
+                    self._await_lines.update(range(node.lineno, end + 1))
+        # lexical lock extents, for attributing held locks to comment
+        # anchors (call anchors use the live stack instead)
+        self.lock_spans: List[Tuple[int, int, str]] = []
+        for node in ast.walk(ms.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = self._lock_id(item.context_expr)
+                    if lid is not None:
+                        self.lock_spans.append(
+                            (node.lineno,
+                             getattr(node, "end_lineno", node.lineno), lid))
+        # machine owner lookup for this module: attr -> machine name
+        norm = ms.path.replace("\\", "/")
+        self._owner_attrs: Dict[str, str] = {}
+        for schema in schemas.values():
+            for mod_suffix, attr in schema.owners:
+                if norm.endswith(mod_suffix):
+                    self._owner_attrs[attr] = schema.name
+
+    def locks_at(self, line: int) -> frozenset:
+        return frozenset(lid for lo, hi, lid in self.lock_spans
+                         if lo <= line <= hi)
+
+    # ------------------------------------------------------------- scoping
+
+    def _scope(self) -> str:
+        parts = self._classes + self._funcs
+        return ".".join(parts) if parts else "<module>"
+
+    def _func_key(self) -> Optional[str]:
+        if not (self._classes or self._funcs):
+            return None
+        return f"{self.modname}:{self._scope()}"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._classes.append(node.name)
+        self.generic_visit(node)
+        self._classes.pop()
+
+    def _visit_func(self, node) -> None:
+        self._funcs.append(node.name)
+        self.generic_visit(node)
+        self._funcs.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if (node.module or "").endswith("proto") or node.level:
+            for alias in node.names:
+                if alias.name == "step" and alias.asname is None:
+                    self._step_imported = True
+
+    # --------------------------------------------------------------- locks
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        d = dotted(expr)
+        if d is None or not self._lock_re.search(d.rsplit(".", 1)[-1]):
+            return None
+        if d.startswith("self.") and self._classes:
+            return f"self.{d[5:]}"
+        return d
+
+    def _visit_with(self, node) -> None:
+        acquired = [lid for item in node.items
+                    if (lid := self._lock_id(item.context_expr))]
+        self._locks.extend(acquired)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._locks.pop()
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # --------------------------------------------------------------- sites
+
+    def _mk_anchor(self, machine: str, transitions, node: ast.AST,
+                   kind: str, raw: str = "") -> Anchor:
+        return Anchor(
+            machine=machine, transitions=list(transitions),
+            path=self.ms.path, line=node.lineno,
+            func_key=self._func_key(), kind=kind, raw=raw,
+            has_await=node.lineno in self._await_lines,
+            locks=frozenset(self._locks))
+
+    def _is_step_call(self, node: ast.Call) -> bool:
+        """``proto.step(...)`` (any alias whose dotted base ends in
+        `proto`) or a bare ``step(...)`` imported from the proto
+        module — never an unrelated `.step()` method."""
+        d = dotted(node.func)
+        if d is None:
+            return False
+        if d == "step":
+            return self._step_imported
+        parts = d.split(".")
+        return parts[-1] == "step" and parts[-2].endswith("proto")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        """``proto.step("machine", frm, to)`` call anchors; frm may be a
+        string or a tuple of strings (all pairs must be declared)."""
+        if self._is_step_call(node) and len(node.args) >= 3:
+            try:
+                machine = ast.literal_eval(node.args[0])
+                frm = ast.literal_eval(node.args[1])
+                to = ast.literal_eval(node.args[2])
+            except (ValueError, SyntaxError):
+                machine = None
+            if isinstance(machine, str):
+                froms = [frm] if isinstance(frm, str) else list(frm)
+                self.out.anchors.append(self._mk_anchor(
+                    machine, [(f, to) for f in froms], node, "call",
+                    raw=ast.unparse(node.func)))
+        self.generic_visit(node)
+
+    def _store_target(self, t: ast.AST) -> None:
+        if isinstance(t, ast.Attribute) and isinstance(t.ctx, ast.Store):
+            machine = self._owner_attrs.get(t.attr)
+            if machine is not None and "__init__" not in self._funcs:
+                self.out.stores.append(OwnerStore(
+                    machine=machine, attr=t.attr, path=self.ms.path,
+                    line=t.lineno, scope=self._scope()))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._store_target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._store_target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._store_target(node.target)
+        self.generic_visit(node)
+
+
+def _comment_anchors(ms: ModuleSource, schemas: Dict[str, ProtoSchema],
+                     scan: "_AnchorScan") -> Tuple[List[Anchor],
+                                                   List[Violation]]:
+    """Comment anchors, found via ``tokenize`` so `# proto:` examples
+    inside docstrings never count. A trailing comment binds to its own
+    (code) line; a standalone comment line binds to the line below."""
+    import io
+    import tokenize
+
+    d19 = RULES["DL019"][0]
+    anchors: List[Anchor] = []
+    bad: List[Violation] = []
+    if "proto:" not in ms.src:
+        return anchors, bad
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(ms.src).readline))
+    except tokenize.TokenizeError:
+        return anchors, bad
+    lines = ms.src.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        parsed = _parse_comment_anchor(tok.string)
+        if parsed is None:
+            continue
+        machine, transitions, errors = parsed
+        i = tok.start[0]
+        for err in errors:
+            bad.append(Violation(
+                ms.path, i, 0, "DL019", d19,
+                f"{RULES['DL019'][1]}: {err}", "<module>"))
+        standalone = not lines[i - 1][:tok.start[1]].strip()
+        code_line = i + 1 if standalone else i
+        anchors.append(Anchor(
+            machine=machine, transitions=transitions, path=ms.path,
+            line=i, func_key=None,
+            kind="comment", raw=tok.string.strip(),
+            has_await=code_line in scan._await_lines,
+            locks=scan.locks_at(code_line)))
+    return anchors, bad
+
+
+def _attribute_comment_scopes(ms: ModuleSource, modname: str,
+                              anchors: List[Anchor]) -> None:
+    """Fill func_key for comment anchors by walking the AST's function
+    extents (lineno..end_lineno)."""
+    if not anchors:
+        return
+    spans: List[Tuple[int, int, str]] = []
+
+    def walk(node, classes: List[str], funcs: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, classes + [child.name], funcs)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = ".".join(classes + funcs + [child.name])
+                spans.append((child.lineno,
+                              getattr(child, "end_lineno", child.lineno),
+                              qual))
+                walk(child, classes, funcs + [child.name])
+            else:
+                walk(child, classes, funcs)
+
+    walk(ms.tree, [], [])
+    for a in anchors:
+        best: Optional[Tuple[int, str]] = None
+        for lo, hi, qual in spans:
+            if lo <= a.line <= hi:
+                if best is None or lo > best[0]:
+                    best = (lo, qual)
+        if best is not None:
+            a.func_key = f"{modname}:{best[1]}"
+
+
+def collect_anchors(sources: Sequence[ModuleSource],
+                    schemas: Dict[str, ProtoSchema]
+                    ) -> Tuple[List[Anchor], List[OwnerStore],
+                               List[Violation]]:
+    """Every anchor (call + comment) and owner-attribute store in the
+    scanned tree, plus malformed-anchor violations."""
+    from .callgraph import module_name
+
+    anchors: List[Anchor] = []
+    stores: List[OwnerStore] = []
+    bad: List[Violation] = []
+    for ms in sources:
+        modname = module_name(ms.path)
+        scan = _AnchorScan(ms, schemas, modname)
+        scan.visit(ms.tree)
+        canchors, cbad = _comment_anchors(ms, schemas, scan)
+        _attribute_comment_scopes(ms, modname, canchors)
+        anchors.extend(scan.out.anchors)
+        anchors.extend(canchors)
+        stores.extend(scan.out.stores)
+        bad.extend(scan.out.bad)
+        bad.extend(cbad)
+    return anchors, stores, bad
+
+
+# ------------------------------------------------------------------- DL019
+
+def _suppressed(ms: ModuleSource, line: int, code: str) -> bool:
+    name = RULES[code][0]
+    for probe in (line, line - 1):
+        tags = ms.suppressed.get(probe)
+        if tags and (code in tags or name in tags or "all" in tags):
+            return True
+    return False
+
+
+def check_transitions(sources: Sequence[ModuleSource],
+                      schemas: Dict[str, ProtoSchema],
+                      anchors: List[Anchor],
+                      stores: List[OwnerStore]) -> List[Violation]:
+    """DL019: anchors must name declared machines/states/edges; owner
+    stores must be anchored."""
+    out: List[Violation] = []
+    name, summary = RULES["DL019"]
+    by_path = {ms.path: ms for ms in sources}
+
+    for a in anchors:
+        ms = by_path.get(a.path)
+        if ms is not None and _suppressed(ms, a.line, "DL019"):
+            continue
+        schema = schemas.get(a.machine)
+        if schema is None:
+            out.append(Violation(
+                a.path, a.line, 0, "DL019", name,
+                f"{summary}: anchor names unknown machine "
+                f"`{a.machine}`", a.machine))
+            continue
+        for frm, to in a.transitions:
+            if frm not in schema.states or to not in schema.states:
+                out.append(Violation(
+                    a.path, a.line, 0, "DL019", name,
+                    f"{summary}: anchor on `{a.machine}` names unknown "
+                    f"state in `{frm}`->`{to}`", a.machine))
+            elif (frm, to) not in schema.edge_pairs:
+                out.append(Violation(
+                    a.path, a.line, 0, "DL019", name,
+                    f"{summary}: `{frm}`->`{to}` is not a declared edge "
+                    f"of `{a.machine}` — declare it in runtime/proto.py "
+                    f"or fix the site", a.machine))
+
+    # owner stores: an anchor for the owning machine on the store line
+    # or the line above (comment) / same line (call)
+    anchored_lines: Dict[Tuple[str, str], Set[int]] = {}
+    for a in anchors:
+        key = (a.path, a.machine)
+        anchored_lines.setdefault(key, set()).add(a.line)
+    for st in stores:
+        lines = anchored_lines.get((st.path, st.machine), set())
+        if st.line in lines or (st.line - 1) in lines:
+            continue
+        ms = by_path.get(st.path)
+        if ms is not None and _suppressed(ms, st.line, "DL019"):
+            continue
+        out.append(Violation(
+            st.path, st.line, 0, "DL019", name,
+            f"{summary}: store to protocol-state attr `.{st.attr}` of "
+            f"machine `{st.machine}` carries no anchor — add "
+            f"`# proto: {st.machine} <from>-><to>` naming the declared "
+            f"edge this mutation implements", st.scope))
+    return out
+
+
+# ------------------------------------------------------------------- DL020
+
+def check_coverage(sources: Sequence[ModuleSource],
+                   schemas: Dict[str, ProtoSchema],
+                   anchors: List[Anchor],
+                   proto_path: str,
+                   stores: Optional[List[OwnerStore]] = None,
+                   race_model=None) -> List[Violation]:
+    """DL020: every declared edge anchored; lock discipline on anchored
+    transitions (via the dynarace concurrency model when provided)."""
+    out: List[Violation] = []
+    name, summary = RULES["DL020"]
+    by_path = {ms.path: ms for ms in sources}
+    proto_ms = by_path.get(proto_path)
+    # anchors that annotate an actual protocol-state mutation: the lock
+    # discipline applies to THOSE (an anchored effect edge — a discovery
+    # delete, a flush — is legitimately an await)
+    store_lines: Set[Tuple[str, str, int]] = set()
+    for st in stores or []:
+        store_lines.add((st.path, st.machine, st.line))
+
+    def _is_mutation_anchor(a: Anchor) -> bool:
+        return ((a.path, a.machine, a.line) in store_lines
+                or (a.path, a.machine, a.line + 1) in store_lines)
+
+    covered: Dict[Tuple[str, str, str], int] = {}
+    for a in anchors:
+        if a.machine not in schemas:
+            continue
+        schema = schemas[a.machine]
+        for pair in a.transitions:
+            if pair in schema.edge_pairs:
+                covered[(a.machine, pair[0], pair[1])] = \
+                    covered.get((a.machine, pair[0], pair[1]), 0) + 1
+
+    for schema in schemas.values():
+        for e in schema.edges:
+            if (schema.name, e["from"], e["to"]) in covered:
+                continue
+            if proto_ms is not None and \
+                    _suppressed(proto_ms, schema.line, "DL020"):
+                continue
+            out.append(Violation(
+                proto_path, schema.line, 0, "DL020", name,
+                f"{summary}: edge `{e['name']}` "
+                f"(`{e['from']}`->`{e['to']}`) of machine "
+                f"`{schema.name}` has no anchoring code site — the "
+                f"model and the code have drifted", schema.name))
+
+    # lock discipline on anchored transitions
+    for a in anchors:
+        schema = schemas.get(a.machine)
+        if schema is None:
+            continue
+        ms = by_path.get(a.path)
+        if ms is not None and _suppressed(ms, a.line, "DL020"):
+            continue
+        if schema.lock == "loop":
+            if a.has_await and _is_mutation_anchor(a):
+                out.append(Violation(
+                    a.path, a.line, 0, "DL020", name,
+                    f"{summary}: machine `{a.machine}` declares "
+                    f"event-loop atomicity (lock=\"loop\") but this "
+                    f"anchored transition straddles an await — the "
+                    f"state can be observed mid-flight",
+                    a.func_key.split(":", 1)[1] if a.func_key
+                    else "<module>"))
+        elif schema.lock and schema.lock.startswith("self."):
+            if _is_mutation_anchor(a) and schema.lock not in a.locks:
+                out.append(Violation(
+                    a.path, a.line, 0, "DL020", name,
+                    f"{summary}: machine `{a.machine}` declares lock "
+                    f"`{schema.lock}` but this anchored transition does "
+                    f"not hold it",
+                    a.func_key.split(":", 1)[1] if a.func_key
+                    else "<module>"))
+        elif schema.lock is None and race_model is not None \
+                and a.func_key is not None:
+            roots = race_model.func_roots.get(a.func_key, set())
+            reentrant = any(race_model.roots[r].reentrant for r in roots)
+            if len(roots) >= 2 or reentrant:
+                out.append(Violation(
+                    a.path, a.line, 0, "DL020", name,
+                    f"{summary}: transition of `{a.machine}` is "
+                    f"reachable from "
+                    f"{'a reentrant root' if reentrant and len(roots) < 2 else f'{len(roots)} concurrent roots'} "
+                    f"but the machine declares no lock — declare "
+                    f"lock=\"loop\" (and keep transitions "
+                    f"single-statement) or a real lock",
+                    a.func_key.split(":", 1)[1]))
+    return out
+
+
+# ------------------------------------------------------------------- DL021
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        d = dotted(n)
+        if d in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _names_in(node: Optional[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    if node is None:
+        return out
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+    return out
+
+
+def check_typed_error_swallow(sources: Sequence[ModuleSource],
+                              graph: CallGraph) -> List[Violation]:
+    """DL021 over functions reachable from the HTTP handler plane or
+    ServeHandle. Roots: aiohttp route handlers registered in llm/http
+    modules + every ServeHandle method."""
+    name, summary = RULES["DL021"]
+    roots: Set[str] = set()
+    for fi in graph.functions.values():
+        norm = fi.path.replace("\\", "/")
+        if "llm/http/" in norm:
+            for hr in fi.handler_refs:
+                if hr.target:
+                    roots.add(hr.target)
+        if norm.endswith("runtime/component.py") and \
+                fi.qualname.startswith("ServeHandle."):
+            roots.add(fi.key)
+
+    reached: Set[str] = set(roots)
+    stack = list(roots)
+    while stack:
+        fi = graph.functions.get(stack.pop())
+        if fi is None:
+            continue
+        for cs in fi.calls:
+            if cs.target and cs.target in graph.functions \
+                    and cs.target not in reached:
+                reached.add(cs.target)
+                stack.append(cs.target)
+
+    # function key -> (module, function extent) for locating handlers;
+    # a nested def's body is walked from its enclosing function too, so
+    # dedupe findings by (path, line)
+    out: List[Violation] = []
+    seen_sites: Set[Tuple[str, int]] = set()
+    by_mod: Dict[str, ModuleSource] = {ms.path: ms for ms in sources}
+    for key in sorted(reached):
+        fi = graph.functions[key]
+        ms = by_mod.get(fi.path)
+        if ms is None:
+            continue
+        fnode = _find_func_node(ms.tree, fi)
+        if fnode is None:
+            continue
+        for node in ast.walk(fnode):
+            if not isinstance(node, ast.Try):
+                continue
+            # only try bodies that await can raise the typed guard
+            # errors (they surface from bounded waits / routed hops)
+            body_awaits = any(
+                isinstance(sub, ast.Await)
+                for stmt in node.body for sub in ast.walk(stmt))
+            if not body_awaits:
+                continue
+            earlier: Set[str] = set()
+            for handler in node.handlers:
+                if not _handler_is_broad(handler):
+                    earlier |= _names_in(handler.type)
+                    continue
+                if earlier & TYPED_HANDLED_NAMES:
+                    break  # typed errors peeled off before the broad catch
+                if any(isinstance(sub, ast.Raise)
+                       for sub in ast.walk(handler)):
+                    break  # re-raises (conditionally or not)
+                body_names: Set[str] = set()
+                for stmt in handler.body:
+                    body_names |= _names_in(stmt)
+                if body_names & TYPED_GUARD_ERRORS:
+                    break  # maps/branches on the typed errors inline
+                if _suppressed(ms, handler.lineno, "DL021"):
+                    break
+                if (fi.path, handler.lineno) in seen_sites:
+                    break
+                seen_sites.add((fi.path, handler.lineno))
+                out.append(Violation(
+                    fi.path, handler.lineno, handler.col_offset,
+                    "DL021", name,
+                    f"{summary}: broad except on an awaiting try body "
+                    f"reachable from the HTTP/ServeHandle plane (via "
+                    f"`{fi.qualname}`) — peel off "
+                    f"DeadlineExceeded/NoCapacity/NoRespondersError "
+                    f"first or re-raise them", fi.qualname))
+                break
+    return out
+
+
+def _find_func_node(tree: ast.AST, fi) -> Optional[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == fi.name and node.lineno == fi.lineno:
+            return node
+    return None
+
+
+# ------------------------------------------------------------------- driver
+
+def analyze_protocols(sources: Sequence[ModuleSource],
+                      schemas: Optional[Dict[str, ProtoSchema]] = None,
+                      graph: Optional[CallGraph] = None,
+                      race_model=None,
+                      proto_path: str = PROTO_MODULE_REL,
+                      anchors_out: Optional[dict] = None
+                      ) -> List[Violation]:
+    """Run the dynaproto conformance passes (DL019/DL020/DL021) over
+    already-loaded modules. The protocol registry defaults to the
+    scanned ``dynamo_tpu/runtime/proto.py``; pass ``schemas`` for
+    fixture trees. ``anchors_out={}`` receives the collected anchors
+    and schemas (the --proto-dot exporter and the --json protocols
+    report reuse them)."""
+    out: List[Violation] = []
+    if schemas is None:
+        proto_ms = next((m for m in sources if m.path == proto_path), None)
+        if proto_ms is None:
+            return out
+        schemas, bad = load_protocols(proto_ms)
+        out.extend(bad)
+    if graph is None:
+        graph = CallGraph.build(sources)
+    anchors, stores, bad = collect_anchors(sources, schemas)
+    out.extend(bad)
+    out.extend(check_transitions(sources, schemas, anchors, stores))
+    out.extend(check_coverage(sources, schemas, anchors, proto_path,
+                              stores=stores, race_model=race_model))
+    out.extend(check_typed_error_swallow(sources, graph))
+    if anchors_out is not None:
+        anchors_out["schemas"] = schemas
+        anchors_out["anchors"] = anchors
+        anchors_out["stores"] = stores
+    out.sort(key=lambda v: (v.path, v.line, v.code))
+    return out
+
+
+# --------------------------------------------------------------- dot export
+
+def protocols_to_dot(schemas: Dict[str, ProtoSchema],
+                     anchors: Sequence[Anchor]) -> str:
+    """Graphviz export of every declared machine: one cluster per
+    machine, terminal states double-circled, anchored edges green with
+    their site count, unanchored edges red — the drift is visible."""
+    covered: Dict[Tuple[str, str, str], int] = {}
+    for a in anchors:
+        for frm, to in a.transitions:
+            covered[(a.machine, frm, to)] = \
+                covered.get((a.machine, frm, to), 0) + 1
+    lines = ["digraph dynaproto {",
+             '  rankdir=LR; fontname="Helvetica";',
+             '  node [fontname="Helvetica"]; '
+             'edge [fontname="Helvetica", fontsize=10];']
+    for i, name in enumerate(sorted(schemas)):
+        s = schemas[name]
+        lines.append(f'  subgraph cluster_{i} {{')
+        lines.append(f'    label="{name}";')
+        for st in s.states:
+            shape = "doublecircle" if st in s.terminal else "ellipse"
+            style = ', style=bold' if st == s.initial else ""
+            lines.append(f'    "{name}.{st}" [label="{st}", '
+                         f'shape={shape}{style}];')
+        for e in s.edges:
+            n = covered.get((name, e["from"], e["to"]), 0)
+            color = "forestgreen" if n else "red"
+            label = f'{e["name"]} ({n})' if n else f'{e["name"]} (0!)'
+            lines.append(f'    "{name}.{e["from"]}" -> '
+                         f'"{name}.{e["to"]}" '
+                         f'[label="{label}", color={color}];')
+        lines.append("  }")
+    lines.append("}")
+    return "\n".join(lines) + "\n"

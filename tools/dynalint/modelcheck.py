@@ -1,0 +1,289 @@
+"""modelcheck: exhaustive async-interleaving exploration of the declared
+lifecycle protocols (the TLA-style half of dynaproto).
+
+Each machine declared in ``dynamo_tpu/runtime/proto.py`` is a finite
+transition system: the ``state`` variable plus its declared auxiliary
+vars, protocol edges (guarded, with updates — every one anchored to a
+real code site by DL020) and environment transitions (client kills,
+worker deaths, message loss — the nondeterminism the protocol must
+survive). This module explores EVERY reachable interleaving by
+deterministic breadth-first search, bounded by the machine's declared
+``depth``, and checks the declared invariants:
+
+- ``never`` — the predicate holds in **no** reachable state;
+- ``never_stable`` — the predicate holds in no *quiescent* state (one
+  with no enabled protocol edge): the bounded form of "eventually" —
+  e.g. a finished request whose journal entry is still open is fine
+  only while a close edge is still enabled.
+
+A violated invariant is reported as a DL020 violation at the machine's
+registration line, with a counterexample trace (the transition names
+from the initial state to the offending one). The per-machine
+exploration report — state count, transition count, whether the search
+exhausted the space inside the depth bound — feeds ``--json``'s
+``protocols`` block and the model↔code sync-gate test.
+
+Everything is stdlib and deterministic: vars are sorted, transitions
+fire in declaration order, states are canonical tuples — two runs over
+one registry are byte-identical.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .analyzer import RULES, Violation
+from .dynaproto import PROTO_MODULE_REL, ProtoSchema
+
+State = Tuple[object, ...]   # values ordered by the machine's var order
+
+
+@dataclass
+class ModelResult:
+    machine: str
+    var_names: Tuple[str, ...]
+    states_explored: int = 0
+    transitions_fired: int = 0
+    exhausted: bool = True        # False when the depth bound cut BFS off
+    quiescent_states: int = 0
+    violations: List[dict] = field(default_factory=list)
+    # {invariant, state: {var: val}, trace: [transition names]}
+
+
+def _var_order(schema: ProtoSchema) -> Tuple[str, ...]:
+    return ("state",) + tuple(k for k, _dom in schema.vars)
+
+
+def _domains(schema: ProtoSchema) -> Dict[str, tuple]:
+    doms = {"state": tuple(schema.states)}
+    doms.update({k: tuple(v) for k, v in schema.vars})
+    return doms
+
+
+def _initial(schema: ProtoSchema, order: Tuple[str, ...]) -> State:
+    init = {"state": schema.initial}
+    init.update(dict(schema.init))
+    return tuple(init.get(v) for v in order)
+
+
+def _enabled(tr: dict, state: State, idx: Dict[str, int]) -> bool:
+    frm = tr.get("from")
+    if frm:
+        if state[idx["state"]] != frm:
+            return False
+    for var, allowed in tr["when"].items():
+        if var not in idx or state[idx[var]] not in allowed:
+            return False
+    return True
+
+
+def _apply(tr: dict, state: State, idx: Dict[str, int],
+           doms: Dict[str, tuple]) -> Optional[State]:
+    """Successor state, or None when an update leaves a var's domain
+    (the explored counter invariants catch that as `never` on the max
+    value instead — see the `+1` convention)."""
+    out = list(state)
+    if tr.get("to"):
+        out[idx["state"]] = tr["to"]
+    for var, val in sorted(tr["set"].items()):
+        if var not in idx:
+            continue
+        if val == "+1":
+            cur = out[idx[var]]
+            val = (cur + 1) if isinstance(cur, int) else cur
+        elif val == "-1":
+            cur = out[idx[var]]
+            val = (cur - 1) if isinstance(cur, int) else cur
+        if val not in doms[var]:
+            return None   # clamped off the domain edge: not a new state
+        out[idx[var]] = val
+    return tuple(out)
+
+
+def _pred_holds(pred: dict, state: State, idx: Dict[str, int]) -> bool:
+    for var, want in pred.items():
+        if var not in idx:
+            return False
+        allowed = tuple(want) if isinstance(want, (tuple, list)) else (want,)
+        if state[idx[var]] not in allowed:
+            return False
+    return True
+
+
+def explore(schema: ProtoSchema) -> ModelResult:
+    """Deterministic BFS over one machine composed with its declared
+    environment."""
+    order = _var_order(schema)
+    idx = {v: i for i, v in enumerate(order)}
+    doms = _domains(schema)
+    init = _initial(schema, order)
+    result = ModelResult(machine=schema.name, var_names=order)
+
+    protocol = list(schema.edges)
+    transitions = protocol + list(schema.env)
+
+    # predecessor map for counterexample traces
+    parent: Dict[State, Tuple[Optional[State], str]] = {init: (None, "")}
+    frontier = deque([init])
+    depth = 0
+    seen = {init}
+    while frontier and depth < schema.depth:
+        depth += 1
+        for _ in range(len(frontier)):
+            st = frontier.popleft()
+            for tr in transitions:
+                if not _enabled(tr, st, idx):
+                    continue
+                nxt = _apply(tr, st, idx, doms)
+                if nxt is None:
+                    continue
+                result.transitions_fired += 1
+                if nxt not in seen:
+                    seen.add(nxt)
+                    parent[nxt] = (st, tr["name"])
+                    frontier.append(nxt)
+    if frontier:
+        result.exhausted = False
+    result.states_explored = len(seen)
+
+    def trace(state: State) -> List[str]:
+        names: List[str] = []
+        cur: Optional[State] = state
+        while cur is not None:
+            prev, name = parent[cur]
+            if name:
+                names.append(name)
+            cur = prev
+        return list(reversed(names))
+
+    def fmt(state: State) -> Dict[str, object]:
+        return {v: state[idx[v]] for v in order}
+
+    ordered = sorted(seen)
+    quiescent = []
+    for st in ordered:
+        if not any(_enabled(tr, st, idx) for tr in protocol):
+            quiescent.append(st)
+    result.quiescent_states = len(quiescent)
+
+    edges_by_name = {e["name"]: e for e in protocol}
+    for inv in schema.invariants:
+        name = inv.get("name", "?")
+        if "never_fire" in inv:
+            # transition-level: no listed edge may be ENABLED in any
+            # reachable state satisfying the predicate (guard checking:
+            # "no resume is ever dispatched after a client kill")
+            spec = inv["never_fire"]
+            targets = spec.get("edges") or ()
+            if isinstance(targets, str):
+                targets = (targets,)
+            pred = spec.get("when") or {}
+            hit = None
+            for st in ordered:
+                for ename in targets:
+                    e = edges_by_name.get(ename)
+                    if e is None:
+                        continue
+                    if _enabled(e, st, idx) and _pred_holds(pred, st, idx):
+                        hit = (st, ename)
+                        break
+                if hit:
+                    break
+            if hit:
+                result.violations.append({
+                    "invariant": name, "state": fmt(hit[0]),
+                    "stable": False, "edge": hit[1],
+                    "trace": trace(hit[0])})
+            continue
+        if "never" in inv:
+            pred, pool = inv["never"], ordered
+        elif "never_stable" in inv:
+            pred, pool = inv["never_stable"], quiescent
+        else:
+            continue
+        for st in pool:
+            if _pred_holds(pred, st, idx):
+                result.violations.append({
+                    "invariant": name, "state": fmt(st),
+                    "stable": "never_stable" in inv,
+                    "trace": trace(st)})
+                break   # one counterexample per invariant is enough
+    return result
+
+
+def check_models(schemas: Dict[str, ProtoSchema],
+                 proto_path: str = PROTO_MODULE_REL,
+                 suppressed: Optional[Dict[int, set]] = None,
+                 report_out: Optional[dict] = None) -> List[Violation]:
+    """Explore every registered machine; invariant violations become
+    DL020 findings at the machine's registration line. ``report_out``
+    receives the per-machine exploration stats for ``--json``."""
+    out: List[Violation] = []
+    name, summary = RULES["DL020"]
+    report: Dict[str, dict] = {}
+    for mname in sorted(schemas):
+        schema = schemas[mname]
+        res = explore(schema)
+        report[mname] = {
+            "states": len(schema.states),
+            "edges": len(schema.edges),
+            "env_transitions": len(schema.env),
+            "invariants": len(schema.invariants),
+            "model_states": res.states_explored,
+            "model_transitions": res.transitions_fired,
+            "quiescent_states": res.quiescent_states,
+            "exhausted": res.exhausted,
+        }
+        if not res.exhausted:
+            sup = (suppressed or {}).get(schema.line) or \
+                (suppressed or {}).get(schema.line - 1)
+            if not (sup and ({"DL020", name, "all"} & sup)):
+                out.append(Violation(
+                    proto_path, schema.line, 0, "DL020", name,
+                    f"{summary}: machine `{mname}` state space not "
+                    f"exhausted within depth {schema.depth} "
+                    f"({res.states_explored} states reached) — raise "
+                    f"`depth` or shrink a var domain", mname))
+        for v in res.violations:
+            sup = (suppressed or {}).get(schema.line) or \
+                (suppressed or {}).get(schema.line - 1)
+            if sup and ({"DL020", name, "all"} & sup):
+                continue
+            if v.get("edge"):
+                kind = f"reachable with edge `{v['edge']}` enabled"
+            elif v["stable"]:
+                kind = "holds in a quiescent state"
+            else:
+                kind = "reachable"
+            out.append(Violation(
+                proto_path, schema.line, 0, "DL020", name,
+                f"{summary}: machine `{mname}` invariant "
+                f"`{v['invariant']}` violated — forbidden state "
+                f"{v['state']} is {kind} via "
+                f"[{' -> '.join(v['trace']) or '<initial>'}]", mname))
+    if report_out is not None:
+        report_out.update(report)
+    out.sort(key=lambda v: (v.path, v.line, v.code))
+    return out
+
+
+def check_protocol_models(sources: Sequence,
+                          schemas: Optional[Dict[str, ProtoSchema]] = None,
+                          proto_path: str = PROTO_MODULE_REL,
+                          report_out: Optional[dict] = None
+                          ) -> List[Violation]:
+    """Driver twin of dynaproto.analyze_protocols: load the registry
+    from the scanned tree (or use ``schemas``) and model-check it."""
+    from .dynaproto import load_protocols
+
+    suppressed = None
+    if schemas is None:
+        proto_ms = next((m for m in sources if m.path == proto_path), None)
+        if proto_ms is None:
+            return []
+        schemas, _bad = load_protocols(proto_ms)
+        suppressed = proto_ms.suppressed
+    return check_models(schemas, proto_path=proto_path,
+                        suppressed=suppressed, report_out=report_out)
