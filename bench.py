@@ -84,6 +84,14 @@ def metric_name(args) -> str:
                 f"mid-stream failover (2 workers, ISL~{args.isl}/OSL "
                 f"{args.osl}, {args.requests} reqs) + shed rate under 2x "
                 f"overload ({_model_tag(args)} llama, {smoke})")
+    if args.scenario == "hotpath":
+        smoke = "cpu smoke" if getattr(args, "cpu", False) else "1 chip"
+        arm = ("legacy" if getattr(args, "hotpath_legacy", False)
+               else "overhauled")
+        return (f"ITL raw-chunk p99 ms, decode-heavy hot path ({arm} arm, "
+                f"ISL~{args.isl}/OSL {args.osl}, {args.requests} reqs, "
+                f"conc {args.concurrency}, K={args.decode_steps}, "
+                f"{_model_tag(args)} llama, {smoke})")
     return ("output tokens/s, synthetic ShareGPT "
             f"(ISL~{args.isl}/OSL {args.osl}, {args.requests} reqs, "
             f"conc {args.concurrency}, {_model_tag(args)} llama, 1 chip)")
@@ -97,8 +105,8 @@ def metric_unit(args) -> str:
     if getattr(args, "spec", False) or getattr(args, "sweep", None):
         return "tok/s"
     return {"multiturn": "ms", "disagg": "ratio", "shared": "rate",
-            "sharded": "tok/s", "failover": "tok/s"}.get(args.scenario,
-                                                         "tok/s")
+            "sharded": "tok/s", "failover": "tok/s",
+            "hotpath": "ms"}.get(args.scenario, "tok/s")
 
 
 def emit_unavailable(args, reason: str) -> None:
@@ -196,7 +204,7 @@ def parse_args():
                     help="fused decode window (amortizes dispatch latency)")
     ap.add_argument("--scenario", default="sharegpt",
                     choices=["sharegpt", "multiturn", "disagg", "shared",
-                             "sharded", "failover"],
+                             "sharded", "failover", "hotpath"],
                     help="multiturn = conversations with growing shared "
                          "prefixes (the KV-offload TTFT scenario, "
                          "reference docs/architecture.md:91-96); "
@@ -218,7 +226,20 @@ def parse_args():
                          "worker killed mid-burst (goodput under churn + "
                          "resume-stall p99 via mid-stream failover) and a "
                          "2x-overload wave against SLO-aware admission "
-                         "control (shed rate + admitted TTFT p99)")
+                         "control (shed rate + admitted TTFT p99); "
+                         "hotpath = dynaturbo decode hot-path record: "
+                         "decode-heavy/small-batch/long-generation mix "
+                         "reporting itl_raw_chunk_p99_ms + the per-bucket "
+                         "cost table + loop-lag p99 + the compile fence "
+                         "in ONE record (forces --prof-sample 2 when "
+                         "unset); --hotpath-legacy runs the same workload "
+                         "with every hot-path optimization off for A/B")
+    ap.add_argument("--hotpath-legacy", action="store_true",
+                    help="hotpath scenario A/B arm: disable the dynaturbo "
+                         "optimizations (idle-prefill overlap, coalesced "
+                         "window emissions, sampler-param cache, in-step "
+                         "admission, async detok) and restore the legacy "
+                         "per-iteration event-loop yield")
     ap.add_argument("--mesh", default=None,
                     help="sharded scenario: per-replica mesh as 'axis=N' "
                          "pairs (e.g. 'model=2'; default DYN_MESH_SHAPE "
@@ -358,6 +379,14 @@ def engine_setup(args):
         ecfg.spec_tokens = args.spec_tokens
     if args.prefill_token_budget is not None:
         ecfg.prefill_token_budget = args.prefill_token_budget
+    if getattr(args, "hotpath_legacy", False):
+        # dynaturbo A/B "before" arm: every hot-path toggle off (the env
+        # side — DYN_LOOP_YIELD / DYN_ASYNC_DETOK — is set in main()
+        # before the engine loop starts)
+        ecfg.overlap_idle_prefill = False
+        ecfg.coalesce_window_emissions = False
+        ecfg.cache_sampler_params = False
+        ecfg.admit_in_step = False
     if args.scenario == "multiturn":
         # size the HBM pool BELOW the conversation working set so turns
         # evict each other; the host tier is what keeps TTFT low
@@ -1552,6 +1581,34 @@ async def run_bench(args):
     return report
 
 
+async def run_hotpath(args):
+    """dynaturbo hot-path record: a decode-heavy, small-batch,
+    long-generation mix (ITL is decided by per-token host work, not
+    FLOPs, in this regime) with profiling forced on, so ONE record
+    carries the honest client metric (``itl_raw_chunk_p99_ms``), the
+    per-bucket dispatch/device cost table, loop-lag p99 and the compile
+    fence. Two invocations (±``--hotpath-legacy``) diff with
+    ``python -m tools.cost_diff``."""
+    # decode-heavy defaults wherever the caller left the global ones:
+    # short prompts, long generations, small concurrency
+    if args.isl == 512:
+        args.isl = 96
+    if args.osl == 128:
+        args.osl = 192
+    if args.requests == 64:
+        args.requests = 16
+    if args.concurrency == 32:
+        args.concurrency = 4
+    if not getattr(args, "prof_sample", 0):
+        # the record is useless as hot-path evidence without the cost
+        # table; sample every other iteration
+        args.prof_sample = 2
+    report = await run_bench(args)
+    report["hotpath_legacy"] = bool(getattr(args, "hotpath_legacy",
+                                            False))
+    return report
+
+
 async def run_disagg(args):
     """Disagg vs agg A/B on the same workload — the BASELINE.md north-star
     (reference docs/architecture.md:57-61 claims +30%/GPU at 1 node).
@@ -1839,6 +1896,12 @@ def _run_sweep(args) -> dict:
 
 def main():
     args = parse_args()
+    if getattr(args, "hotpath_legacy", False):
+        # legacy arm env half: restore the per-iteration loop yield and
+        # inline detokenization (must land before the engine loop and
+        # the first Backend.generate read them)
+        os.environ["DYN_LOOP_YIELD"] = "1"
+        os.environ["DYN_ASYNC_DETOK"] = "0"
     watchdog = None
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -1950,6 +2013,12 @@ def _run_scenario(args) -> dict:
         report = asyncio.run(run_failover(args))
         return {"metric": metric_name(args),
                 "value": report["churn"]["goodput_tok_per_s"],
+                "unit": metric_unit(args), "vs_baseline": 1.0,
+                "detail": report}
+    if args.scenario == "hotpath":
+        report = asyncio.run(run_hotpath(args))
+        return {"metric": metric_name(args),
+                "value": report["itl_raw_chunk_p99_ms"],
                 "unit": metric_unit(args), "vs_baseline": 1.0,
                 "detail": report}
     report = asyncio.run(run_bench(args))

@@ -46,10 +46,10 @@ from ..models.config import ModelConfig
 from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
 from ..runtime import guard, profiling, slo, tracing
-from ..runtime.config import env_int
+from ..runtime.config import env_flag, env_int
 from ..runtime.engine import Context
 from .jit_fence import CompileFence
-from .kv_manager import PageManager
+from .kv_manager import ChainHashCache, PageManager
 from .profiler import EngineProfiler, memory_snapshot
 from .sampling import (SamplingBatch, logprob_aux, sample_tokens,
                        update_penalty_state, verify_greedy_draft)
@@ -166,6 +166,33 @@ class EngineConfig:
     prefill_buckets: Tuple[int, ...] = (16, 64, 512)
     page_buckets: Tuple[int, ...] = (8, 64)
     watermark_pages: int = 4  # keep-free headroom before admitting
+    # ── decode hot-path toggles ──────────────────────────────────────
+    # each gates exactly ONE hot-path change so its cost-table delta can
+    # be measured in isolation (tools/cost_diff.py; docs/hot_path.md)
+    #
+    # prefill-priority iterations where the prefill sweep dispatched
+    # NOTHING (every candidate restore-gated / cancelled / cache-covered)
+    # still dispatch a decode window instead of idling the device for a
+    # whole iteration. TTFT semantics unchanged: iterations that actually
+    # dispatch a prefill batch still skip the window.
+    overlap_idle_prefill: bool = True
+    # read the window's on-device per-row emitted counts and emit each
+    # row's tokens as ONE chunk: one EngineOutput + one event-loop wakeup
+    # per row-window instead of per token, and one bulk page commit. Rows
+    # whose stop-id set exceeds max_eos_ids keep the per-token host path
+    # (the device stop table can't represent them).
+    coalesce_window_emissions: bool = True
+    # reuse the uploaded sampler-param/page-table device arrays across
+    # decode-window dispatches while the batch composition is unchanged,
+    # skipping the per-dispatch host→device re-upload. NOTE: freezes the
+    # per-dispatch reseed of UNSEEDED sampled rows for the cached span
+    # (seeded rows and greedy rows are bit-identical either way).
+    cache_sampler_params: bool = True
+    # run _admit inside _step right after the decode-window dispatch, so
+    # its host work (bucketing, page reservation, prefix-cache hashing)
+    # overlaps the window's device compute instead of serializing ahead
+    # of the dispatch on the event-loop thread
+    admit_in_step: bool = True
 
     def __post_init__(self) -> None:
         if self.prefill_chunk % self.page_size != 0:
@@ -269,6 +296,11 @@ class Sequence:
     # leaves the engine) — TTFT on the first emission, per-token ITL on
     # every later gap, e2e at finish (all host clock reads, no syncs)
     last_emit_t: Optional[float] = None
+    # incremental chained-hash state over `tokens` (kv_manager
+    # ChainHashCache, engine-lazily created): admission's prefix match
+    # and every page-boundary publish extend it instead of re-hashing
+    # the whole sequence
+    hash_cache: Optional[ChainHashCache] = None
 
     def max_new(self) -> int:
         mt = self.req.stop.max_tokens
@@ -291,6 +323,7 @@ class _PendingWindow:
 
     batch: List[Sequence]
     toks: jax.Array                 # [B, K] sampled tokens
+    emitted: jax.Array              # [B] on-device valid-token counts
     carry: tuple                    # (tok, pos, done, steps, remaining)
     index: Dict[int, int] = field(default_factory=dict)  # id(seq) → row
     aux: Optional[tuple] = None     # (lp [B,K], tv [B,K,N], ti [B,K,N])
@@ -460,6 +493,12 @@ class JaxEngine:
         self._pending: Optional[_PendingWindow] = None
         self._pending_prefill: Optional[_PendingPrefill] = None
         self._deferred_free: List[Sequence] = []
+        # cache_sampler_params: (key, SamplingBatch, device arrays) of the
+        # last decode-window dispatch. The key holds the batch list itself
+        # (Sequence is identity-eq), so a stale hit after id() reuse is
+        # impossible — the cached refs keep those Sequences alive until
+        # the next composition change replaces the entry.
+        self._samp_cache: Optional[tuple] = None
         # tiered-KV overlap state: offload gathers dispatched but not yet
         # copied to the host pool (device arrays + target slots), and HBM
         # pages whose host→HBM restore is still queued (their sequences
@@ -611,7 +650,7 @@ class JaxEngine:
                         # cache distinguishes explicit static kwargs from
                         # omitted defaults (compile-fence finding, same
                         # class as the penalties=None note above)
-                        (toks, _carry, self.kv_k,
+                        (toks, _emitted, _carry, self.kv_k,
                          self.kv_v) = self.decode_multi_fn(
                             self.params, jnp.zeros(B, jnp.int32),
                             jnp.zeros(B, jnp.int32) - 1,
@@ -636,7 +675,7 @@ class JaxEngine:
                             # warm that variant; save it for the
                             # merge-combo loop below.
                             carries[B] = _carry
-                            (toks, _carry, self.kv_k,
+                            (toks, _emitted, _carry, self.kv_k,
                              self.kv_v) = self.decode_multi_fn(
                                 self.params, *_carry, self.kv_k,
                                 self.kv_v, tableB, jnp.zeros(B),
@@ -975,6 +1014,14 @@ class JaxEngine:
 
     async def _loop(self) -> None:
         loop = asyncio.get_running_loop()
+        # `await run_in_executor` suspends this coroutine at least once
+        # per iteration (the step future is never done at await time), so
+        # the event loop already drains its ready queue every step. The
+        # historical unconditional `asyncio.sleep(0)` on top of that only
+        # bought a second scheduling round-trip per iteration — measured
+        # loop-lag p99 before/after in docs/hot_path.md. DYN_LOOP_YIELD=1
+        # restores it for A/B.
+        extra_yield = env_flag("DYN_LOOP_YIELD")
         while not self._stopped:
             if not (self.waiting or self.prefilling or self.running
                     or self._inflight or self._pending_prefill):
@@ -990,14 +1037,17 @@ class JaxEngine:
                 # free of the coroutine when no chaos is configured.
                 await guard.chaos_point("engine.stall")
             try:
-                self._admit()
+                if not self.ecfg.admit_in_step:
+                    # legacy placement: admission host work serializes
+                    # ahead of the step on the event-loop thread
+                    self._admit()
                 await loop.run_in_executor(self._exec, self._step)
                 self._reap()
             except Exception:  # noqa: BLE001 — engine loop must survive
                 log.exception("engine step failed")
                 await loop.run_in_executor(self._exec, self._abort_all)
-            # yield to the event loop so queues drain / new requests land
-            await asyncio.sleep(0)
+            if extra_yield:
+                await asyncio.sleep(0)
         # shutdown: drain in-flight windows so no client hangs on a queue
         if self._inflight or self._pending_prefill:
             try:
@@ -1015,11 +1065,15 @@ class JaxEngine:
         self.profiler.tick()  # dynaprof: one compare at sample=0
         self._drain_kv_tier()
         if self.verify_fn is not None:
+            if self.ecfg.admit_in_step:
+                self._admit_in_step()
             self._step_spec()
             return
         if self.ecfg.decode_steps <= 1:
             # single-step decode: fully synchronous; budgeted mixing
             # interleaves a decode step behind the trimmed prefill batch
+            if self.ecfg.admit_in_step:
+                self._admit_in_step()
             budget = self.ecfg.prefill_token_budget
             if self.prefilling:
                 pf = self._dispatch_prefill(budget)
@@ -1032,6 +1086,8 @@ class JaxEngine:
                 self._decode_step_single()
             return
         if not self.ecfg.pipeline_decode:
+            if self.ecfg.admit_in_step:
+                self._admit_in_step()
             budget = self.ecfg.prefill_token_budget
             if self.prefilling:
                 pf = self._dispatch_prefill(budget)
@@ -1051,14 +1107,33 @@ class JaxEngine:
         budget = self.ecfg.prefill_token_budget
         if (budget is None and self.ecfg.prefill_priority
                 and self.prefilling):
-            self._pending = None
+            # prefill-priority: prompt batches drain at full cadence. But
+            # when the sweep dispatches NOTHING (every candidate
+            # restore-gated, cancelled, or cache-covered) the device
+            # would idle a whole iteration — fill the bubble with a
+            # decode window (overlap_idle_prefill). TTFT is untouched:
+            # iterations that actually ship a prefill still skip it.
+            self._pending_prefill = self._dispatch_prefill(budget)
+            if (self._pending_prefill is None
+                    and self.ecfg.overlap_idle_prefill):
+                self._pending = self._dispatch_decode_window()
+            else:
+                self._pending = None
         else:
             # budgeted mixing (or prefill_priority off): decode windows
             # keep their cadence even while prompts are prefilling
             self._pending = self._dispatch_decode_window()
-            if self._pending is not None and self.prefilling:
+            self._pending_prefill = self._dispatch_prefill(budget)
+            if (self._pending is not None
+                    and self._pending_prefill is not None):
                 self.mixed_dispatches += 1
-        self._pending_prefill = self._dispatch_prefill(budget)
+        if self.ecfg.admit_in_step:
+            # admission lands AFTER the dispatches: its host work
+            # (bucketing, page reservation, prefix hashing) overlaps the
+            # in-flight window's device compute instead of serializing
+            # ahead of the dispatch on the event-loop thread. Admitted
+            # sequences enter prefilling for the next iteration's sweep.
+            self._admit_in_step()
         if prev is not None:
             self._process_window(prev)
         if prev_pf is not None:
@@ -1137,8 +1212,9 @@ class JaxEngine:
                          f"context capacity {self.cap_tokens}"))
                 self._finish(seq, "error")
                 continue
+            chain = self._chain(seq)
             with self._pm_lock:
-                alloc = self.pm.allocate_sequence(seq.tokens)
+                alloc = self.pm.allocate_sequence(seq.tokens, chain=chain)
                 if (alloc is None
                         or self.pm.available < self.ecfg.watermark_pages):
                     if alloc is not None:
@@ -1175,6 +1251,17 @@ class JaxEngine:
                 self._hit_window.append((seq.computed, seq.num_prompt))
             # proto: request.lifecycle admitted->prefill
             self.prefilling.append(seq)
+
+    def _admit_in_step(self) -> None:
+        """Admission on the executor thread (admit_in_step), bracketed as
+        its own cost-table row so the host segment it moves off the
+        event-loop thread stays visible under --prof-sample. The guard
+        keeps the common no-waiters iteration at one compare."""
+        if not self.waiting:
+            return
+        at0 = self.profiler.begin()
+        self._admit()
+        self.profiler.end(at0, "admit", ("host",))
 
     # ------------------------------------------------------- KV tier drain
 
@@ -1430,7 +1517,12 @@ class JaxEngine:
                 self.prefilling.remove(seq)
                 finishing.append((i, seq))
         if not finishing:
-            return None
+            # a chunk dispatch with nothing to read back still returns a
+            # (finishing-empty) marker: _step must distinguish
+            # "dispatched, mid-prompt" from "dispatched nothing" so
+            # prefill-priority only skips the decode window on iterations
+            # that actually shipped prefill work
+            return _PendingPrefill(finishing=[], sampled=None)
         # one on-device sampling pass over the full bucket (avoids a fresh
         # compile per finishing-count); skipped entirely when every
         # finishing row is a preemption-resume (next token already sampled)
@@ -1830,22 +1922,46 @@ class JaxEngine:
         B = self.ecfg.bucket_batch(len(batch))
         P = self.ecfg.bucket_pages(max(len(s.pages) for s in batch))
         E = self.ecfg.max_eos_ids
-        table = np.zeros((B, P), np.int32)
+        # cache_sampler_params: while the batch composition (rows, page
+        # counts, bucket shape) is unchanged, the page table, stop table
+        # and sampler params are bit-identical — reuse last dispatch's
+        # device arrays instead of rebuilding + re-uploading them. The key
+        # holds the Sequence objects themselves (identity compare), so no
+        # stale hit is possible. NOTE: a hit also freezes the build-time
+        # random seeds of UNSEEDED sampled rows for the cached span.
+        key = ((B, P, list(batch), [len(s.pages) for s in batch])
+               if self.ecfg.cache_sampler_params else None)
+        cached = self._samp_cache
+        if key is not None and cached is not None and cached[0] == key:
+            sb, (d_table, d_temp, d_topk, d_topp, d_seeds,
+                 d_eos) = cached[1], cached[2]
+        else:
+            table = np.zeros((B, P), np.int32)
+            eos = np.full((B, E), -1, np.int32)
+            for i, seq in enumerate(batch):
+                table[i, :len(seq.pages)] = seq.pages
+                ids: List[int] = []
+                if not seq.req.stop.ignore_eos:
+                    ids.extend(seq.req.eos_token_ids or [])
+                ids.extend(seq.req.stop.stop_token_ids or [])
+                if ids:
+                    eos[i, :min(len(ids), E)] = ids[:E]
+            sb = SamplingBatch.build([s.req.sampling for s in batch], B)
+            d_table, d_eos = jnp.asarray(table), jnp.asarray(eos)
+            d_temp = jnp.asarray(sb.temperature)
+            d_topk = jnp.asarray(sb.top_k)
+            d_topp = jnp.asarray(sb.top_p)
+            d_seeds = jnp.asarray(sb.seeds)
+            if key is not None:
+                self._samp_cache = (key, sb, (d_table, d_temp, d_topk,
+                                              d_topp, d_seeds, d_eos))
         from_carry = np.zeros(B, bool)
         src = np.zeros(B, np.int32)
         ntok = np.zeros(B, np.int32)
         npos = np.full(B, -1, np.int32)
         nsteps = np.zeros(B, np.int32)
         nrem = np.ones(B, np.int32)
-        eos = np.full((B, E), -1, np.int32)
         for i, seq in enumerate(batch):
-            table[i, :len(seq.pages)] = seq.pages
-            ids: List[int] = []
-            if not seq.req.stop.ignore_eos:
-                ids.extend(seq.req.eos_token_ids or [])
-            ids.extend(seq.req.stop.stop_token_ids or [])
-            if ids:
-                eos[i, :min(len(ids), E)] = ids[:E]
             if prev is not None and id(seq) in prev.index:
                 from_carry[i] = True
                 src[i] = prev.index[id(seq)]
@@ -1864,21 +1980,18 @@ class JaxEngine:
             tok, pos = jnp.asarray(ntok), jnp.asarray(npos)
             done = jnp.zeros(B, bool)
             steps, rem = jnp.asarray(nsteps), jnp.asarray(nrem)
-        sb = SamplingBatch.build([s.req.sampling for s in batch], B)
         pen = self._penalty_args(batch, sb, B)
         topn = (self.ecfg.max_top_logprobs
                 if self._wants_logprobs(batch) else 0)
         pt0 = self.profiler.begin()
         out = self.decode_multi_fn(
             self.params, tok, pos, done, steps, rem, self.kv_k, self.kv_v,
-            jnp.asarray(table), jnp.asarray(sb.temperature),
-            jnp.asarray(sb.top_k), jnp.asarray(sb.top_p),
-            jnp.asarray(sb.seeds), jnp.asarray(eos), pen, k_steps=K,
-            logprobs_topn=topn)
+            d_table, d_temp, d_topk, d_topp, d_seeds, d_eos, pen,
+            k_steps=K, logprobs_topn=topn)
         if topn:
-            toks, aux, carry, self.kv_k, self.kv_v = out
+            toks, emitted, aux, carry, self.kv_k, self.kv_v = out
         else:
-            toks, carry, self.kv_k, self.kv_v = out
+            toks, emitted, carry, self.kv_k, self.kv_v = out
             aux = None
         # sampled window timing serializes THIS window's pipeline (the
         # drain waits out the in-flight overlap) — the documented
@@ -1887,8 +2000,8 @@ class JaxEngine:
                           tokens=len(batch) * K, sync_ref=toks)
         self._account_dispatch(batch)
         self.steps += 1
-        pend = _PendingWindow(batch=list(batch), toks=toks, carry=carry,
-                              aux=aux,
+        pend = _PendingWindow(batch=list(batch), toks=toks,
+                              emitted=emitted, carry=carry, aux=aux,
                               index={id(s): i for i, s in enumerate(batch)})
         self._inflight.append(pend)
         return pend
@@ -1905,14 +2018,31 @@ class JaxEngine:
         toks = np.asarray(pend.toks)
         aux = (tuple(np.asarray(a) for a in pend.aux)
                if pend.aux is not None else None)
+        coalesce = self.ecfg.coalesce_window_emissions
+        if coalesce:
+            # outputs of the same program as toks — ready the moment toks
+            # is, so these reads add no extra device sync. carry is never
+            # donated (warmup's merge-combo loop reuses one), so reading
+            # done here is safe even with the next window in flight.
+            counts = np.asarray(pend.emitted)
+            done = np.asarray(pend.carry[2])
         if pend in self._inflight:
             self._inflight.remove(pend)
         if self._pending is pend:
             self._pending = None
         K = toks.shape[1]
         emitted = 0
+        # host-segment bracket: pure bookkeeping time (emission, stop
+        # mirror, page publish) — the readback wait above is already
+        # visible as decode_window device_us
+        ht0 = self.profiler.begin()
         for i, seq in enumerate(pend.batch):
             if seq.finished is not None:
+                continue
+            if coalesce and not seq.context.stopped \
+                    and self._device_stops_complete(seq):
+                emitted += self._append_row(
+                    seq, toks[i], int(counts[i]), bool(done[i]), aux, i)
                 continue
             for j in range(K):
                 if seq.finished is not None or seq.context.stopped:
@@ -1921,10 +2051,72 @@ class JaxEngine:
                                    lp=self._lp_entry(seq, aux, i, j))
                 self.decode_tokens_total += 1
                 emitted += 1
+        self.profiler.end(ht0, "process_window", (len(pend.batch), K),
+                          tokens=emitted)
         self.step_timeline.add(
             "decode_window", batch=len(pend.batch), tokens=emitted,
             occupancy=len(self.running) + len(self.prefilling),
             waiting=len(self.waiting))
+
+    def _device_stops_complete(self, seq: Sequence) -> bool:
+        """True when the row's full stop-id set fit the on-device stop
+        table, so the window's done flag / emitted count are authoritative
+        and the host can bulk-append without per-token stop checks."""
+        n = 0
+        if not seq.req.stop.ignore_eos:
+            n += len(seq.req.eos_token_ids or [])
+        n += len(seq.req.stop.stop_token_ids or [])
+        return n <= self.ecfg.max_eos_ids
+
+    def _append_row(self, seq: Sequence, row: np.ndarray, n: int,
+                    dev_done: bool, aux, i: int) -> int:
+        """Bulk-append one window row using the device's valid-token
+        count: ONE EngineOutput (one cross-thread wakeup) for the whole
+        window instead of one per token, one page-publish sweep, and the
+        finish decision read off the device's done flag. Token identity
+        with the per-token path is pinned by test."""
+        n = min(n, row.shape[0])
+        if n <= 0:
+            if dev_done and seq.finished is None:
+                # row entered the window already frozen but never got its
+                # host-side finish (defensive: unreachable under FIFO
+                # window processing) — terminate so it can't re-dispatch
+                self._terminate(seq, FINISH_LENGTH)
+            return 0
+        ids = [int(t) for t in row[:n]]
+        prev_filled = len(seq.tokens)
+        seq.tokens.extend(ids)
+        seq.last_token = ids[-1]
+        seq.generated += n
+        self.decode_tokens_total += n
+        lps = tops = None
+        if aux is not None and seq.req.output.logprobs is not None:
+            entries = [self._lp_entry(seq, aux, i, j) for j in range(n)]
+            lps = [e[0] for e in entries]
+            tops = [e[1] for e in entries]
+        self._emit(seq, EngineOutput(
+            token_ids=ids, prompt_tokens=seq.num_prompt,
+            logprobs=lps, top_logprobs=tops))
+        # prefix-cache publish when the row crossed a page boundary (same
+        # len-1 publishable-extent rule as _append_token; commit_chain
+        # dedups blocks already published)
+        filled = len(seq.tokens)
+        ps = self.ecfg.page_size
+        if (filled - 1) // ps > max(prev_filled - 1, 0) // ps:
+            self.pm.commit_chain(seq.pages, seq.tokens, filled - 1,
+                                 chain=self._chain(seq))
+        if dev_done:
+            last = ids[-1]
+            hit = (not seq.req.stop.ignore_eos
+                   and last in seq.req.eos_token_ids) \
+                or last in (seq.req.stop.stop_token_ids or [])
+            self._terminate(seq, FINISH_EOS if hit else FINISH_LENGTH)
+        elif (seq.generated >= seq.max_new()
+              or len(seq.tokens) >= self.cap_tokens):
+            # host caps the device couldn't see at seed time (defensive
+            # mirror of _append_token's length cut)
+            self._terminate(seq, FINISH_LENGTH)
+        return n
 
     # -------------------------------------------- deferred page reclamation
 
@@ -2077,7 +2269,8 @@ class JaxEngine:
             # blocks): speculative accepts can append several tokens
             # between boundary checks, so commit everything the extent
             # covers, not just the newest block
-            self.pm.commit_chain(seq.pages, seq.tokens, filled - 1)
+            self.pm.commit_chain(seq.pages, seq.tokens, filled - 1,
+                                 chain=self._chain(seq))
         if eos:
             self._terminate(seq, FINISH_EOS)
         elif (seq.generated >= seq.max_new()
@@ -2100,8 +2293,16 @@ class JaxEngine:
             seq.finished = reason
         self._release_or_defer(seq)
 
+    def _chain(self, seq: Sequence) -> List[int]:
+        """Full-block hashes of seq.tokens via the per-sequence
+        incremental cache (created on first use)."""
+        if seq.hash_cache is None:
+            seq.hash_cache = ChainHashCache(self.ecfg.page_size)
+        return seq.hash_cache.extend(seq.tokens)
+
     def _commit_full_pages(self, seq: Sequence) -> None:
-        self.pm.commit_chain(seq.pages, seq.tokens, seq.prefill_extent)
+        self.pm.commit_chain(seq.pages, seq.tokens, seq.prefill_extent,
+                             chain=self._chain(seq))
 
     def _release(self, seq: Sequence) -> None:
         if seq.hold_pages:
@@ -2482,6 +2683,10 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
         tok, pos = tokens, positions
         toks = []
         lps, tvs, tis = [], [], []
+        # mirror of the llama window fn's per-row valid-token count: the
+        # host slices toks[i, :emitted[i]] instead of re-deriving stop
+        # semantics token by token
+        emitted = jnp.zeros((B,), jnp.int32)
         for i in range(k_steps):
             active = carry_active(done, pos)
             page = page_table[rows, jnp.clip(pos // ps, 0, P - 1)]
@@ -2497,6 +2702,7 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
                 lp, tv, ti = logprob_aux(logits, nxt, logprobs_topn)
                 lps.append(lp); tvs.append(tv); tis.append(ti)
             penalties = update_penalty_state(penalties, nxt, done)
+            emitted = emitted + active.astype(jnp.int32)
             tok, pos, done, steps, remaining = carry_step_update(
                 nxt, tok, pos, done, steps, remaining, eos_table)
             toks.append(tok)
@@ -2505,8 +2711,8 @@ def _make_decode_multi(model, cfg: ModelConfig, max_top_k: int,
         if logprobs_topn:
             aux = (jnp.stack(lps, axis=1), jnp.stack(tvs, axis=1),
                    jnp.stack(tis, axis=1))
-            return out_toks, aux, carry, kv_k, kv_v
-        return out_toks, carry, kv_k, kv_v
+            return out_toks, emitted, aux, carry, kv_k, kv_v
+        return out_toks, emitted, carry, kv_k, kv_v
 
     return decode_multi
 

@@ -61,6 +61,40 @@ def chain_hashes(token_ids: Sequence[int], page_size: int,
     return out
 
 
+class ChainHashCache:
+    """Incremental chained-hash state for ONE growing token sequence.
+
+    The chained construction (each block hash folds in its parent's)
+    makes hashes append-only: blocks already hashed stay valid as tokens
+    append, so the per-admission and per-commit full-prefix re-hash
+    (O(sequence) xxh3 work per call — on the decode hot path, once per
+    page-boundary crossing) collapses to hashing only NEW full blocks.
+    Callers must feed append-only extensions of the same sequence; a
+    shrunken input resets the cache (defensive, not expected)."""
+
+    __slots__ = ("page_size", "_hashes", "_ntok")
+
+    def __init__(self, page_size: int):
+        self.page_size = page_size
+        self._hashes: List[int] = []
+        self._ntok = 0
+
+    def extend(self, token_ids: Sequence[int]) -> List[int]:
+        """Hashes for every full block of ``token_ids`` (== what
+        ``chain_hashes(token_ids, page_size)`` returns), hashing only the
+        blocks not covered by earlier calls."""
+        if len(token_ids) < self._ntok:
+            self._hashes, self._ntok = [], 0
+        nblocks = len(token_ids) // self.page_size
+        h = self._hashes[-1] if self._hashes else 0
+        for i in range(len(self._hashes), nblocks):
+            h = hash_block(
+                h, token_ids[i * self.page_size:(i + 1) * self.page_size])
+            self._hashes.append(h)
+        self._ntok = len(token_ids)
+        return self._hashes[:nblocks]
+
+
 @dataclass
 class KvEvent:
     """Stored/Removed cache event (reference kv_router/protocols.rs
@@ -189,7 +223,9 @@ class PageManager:
     # ---------------------------------------------------------- allocation
 
     def allocate_sequence(self, token_ids: Sequence[int],
-                          extra_pages: int = 0) -> Optional[Alloc]:
+                          extra_pages: int = 0,
+                          chain: Optional[List[int]] = None
+                          ) -> Optional[Alloc]:
         """Claim pages for a prompt: reuse the longest cached prefix
         (HBM pages directly; host-tier blocks via a fresh page + queued
         restore copy), then fresh pages to cover the prompt (+extra_pages
@@ -197,14 +233,19 @@ class PageManager:
 
         Returns an :class:`Alloc` or None if out of memory. The last
         (partial) block is never matched (reference manager.rs
-        prepare_prefill_sequence semantics).
+        prepare_prefill_sequence semantics). ``chain`` optionally supplies
+        the precomputed full-block hashes of ``token_ids`` (a
+        :class:`ChainHashCache` product) so admission skips the O(prompt)
+        re-hash.
         """
         need_total = (len(token_ids) + self.page_size - 1) // self.page_size \
             + extra_pages
         # full-prompt hit: leave at least the final token to recompute so
         # prefill produces logits (cap reuse at len-1 tokens)
         max_reuse = max((len(token_ids) - 1) // self.page_size, 0)
-        chain = chain_hashes(token_ids, self.page_size)[:max_reuse]
+        if chain is None:
+            chain = chain_hashes(token_ids, self.page_size)
+        chain = chain[:max_reuse]
         # walk the chain across both tiers; device hit → reuse page,
         # host hit → fresh page + restore; stop at the first full miss
         plan: List[Tuple[Optional[int], Optional[int], int]] = []
@@ -325,17 +366,22 @@ class PageManager:
                                    token_ids=token_ids))
 
     def commit_chain(self, pages: List[int], token_ids: Sequence[int],
-                     extent: int) -> int:
+                     extent: int, chain: Optional[List[int]] = None) -> int:
         """Commit every FULL block covered by ``token_ids[:extent]`` in
         one call — the multi-token publish path. Prefill completion,
         decode-window boundary crossings, and speculative accepts (which
         can advance a sequence K+1 tokens — several page boundaries — in
         ONE step) all funnel through here so the chained-hash bookkeeping
         lives in one place. Idempotent per block (:meth:`commit` dedups
-        on hash); returns the number of full blocks covered."""
+        on hash); returns the number of full blocks covered. ``chain``
+        optionally supplies precomputed full-block hashes covering at
+        least ``extent`` so the publish skips the O(extent) re-hash."""
         nblocks = extent // self.page_size
-        hashes = chain_hashes(token_ids[:nblocks * self.page_size],
-                              self.page_size)
+        if chain is not None and len(chain) >= nblocks:
+            hashes = chain[:nblocks]
+        else:
+            hashes = chain_hashes(token_ids[:nblocks * self.page_size],
+                                  self.page_size)
         for i, h in enumerate(hashes):
             self.commit(pages[i], h,
                         parent_hash=hashes[i - 1] if i else None,

@@ -10,13 +10,36 @@ and stamps finish reasons.
 from __future__ import annotations
 
 import asyncio
+from concurrent.futures import ThreadPoolExecutor
 from typing import AsyncIterator, List, Optional
 
 from ..runtime import profiling
+from ..runtime.config import env_bool
 from ..runtime.engine import Context
 from .protocols.common import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP,
                                EngineOutput, PreprocessedRequest)
 from .tokenizer import Tokenizer
+
+# Shared detokenization executor (dynaturbo change 4): token→text work for
+# every stream runs here instead of on the event-loop thread, so a slow
+# decode never inflates OTHER streams' inter-chunk latency. Per-request
+# ordering needs no queue machinery: Backend.generate awaits each chunk's
+# decode before pulling the next engine chunk, so a request never has two
+# decodes in flight (an ordered queue of depth one); the DecodeStream's
+# state is therefore only ever touched by one thread at a time.
+_DETOK_EXEC: Optional[ThreadPoolExecutor] = None
+
+
+def _detok_executor() -> ThreadPoolExecutor:
+    global _DETOK_EXEC
+    if _DETOK_EXEC is None:
+        _DETOK_EXEC = ThreadPoolExecutor(max_workers=2,
+                                         thread_name_prefix="dyn-detok")
+    return _DETOK_EXEC
+
+
+def _decode_many(decode, ids: List[int]) -> str:
+    return "".join(p for p in map(decode.step, ids) if p)
 
 
 class StopSequenceJail:
@@ -128,6 +151,9 @@ class Backend:
             tail, _ = jail.feed(decode.flush())
             return released + tail + jail.flush()
 
+        offload = env_bool("DYN_ASYNC_DETOK")
+        loop = asyncio.get_running_loop() if offload else None
+
         agen = _aiter(self.engine.generate(request, context))
         async for raw in agen:
             out = raw if isinstance(raw, EngineOutput) else EngineOutput.from_dict(raw)
@@ -137,16 +163,18 @@ class Backend:
                 # process's /v1/traces/{rid} and usage extension work even
                 # when the engine ran in another process
                 profiling.record_attribution(context.id, out.cost)
+            # Stop checks are pure host arithmetic and stay inline: they
+            # decide which ids are even eligible for decoding (skipped
+            # eos under skip_special_tokens, nothing past the finish).
+            # Only the tokenizer work ships to the detok executor.
             emit_ids: List[int] = []
-            text_parts: List[str] = []
+            decode_ids: List[int] = []
             for tid in out.token_ids:
                 produced += 1
                 is_eos = tid in eos_ids and produced >= min_tokens
                 is_stop_tok = tid in stop_ids and produced >= min_tokens
                 if not (is_eos and request.output.skip_special_tokens):
-                    piece = decode.step(tid)
-                    if piece:
-                        text_parts.append(piece)
+                    decode_ids.append(tid)
                 emit_ids.append(tid)
                 if is_eos:
                     finished = FINISH_EOS
@@ -156,7 +184,15 @@ class Backend:
                     finished = FINISH_LENGTH
                 if finished:
                     break
-            text = "".join(text_parts)
+            if not decode_ids:
+                text = ""
+            elif offload:
+                # awaited before the next engine chunk is pulled — the
+                # per-request decode order is preserved by construction
+                text = await loop.run_in_executor(
+                    _detok_executor(), _decode_many, decode, decode_ids)
+            else:
+                text = _decode_many(decode, decode_ids)
             released, hit = jail.feed(text) if text else ("", False)
             if hit:
                 finished = finished or FINISH_STOP

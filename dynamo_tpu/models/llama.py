@@ -889,6 +889,10 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
         tok, pos = tokens, positions
         toks = []
         lps, tvs, tis = [], [], []
+        # per-row count of tokens this window actually produced: a row
+        # that freezes (stop token / budget) mid-window stops counting, so
+        # the host can slice toks[i, :emitted[i]] without a per-step scan
+        emitted = jnp.zeros((B,), jnp.int32)
         for i in range(k_steps):
             # frozen (done/pad) rows still flow through the matmuls — their
             # outputs are discarded and their KV never commits (commit mask
@@ -901,6 +905,7 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
                 lp, tv, ti = logprob_aux(logits, nxt, logprobs_topn)
                 lps.append(lp); tvs.append(tv); tis.append(ti)
             penalties = update_penalty_state(penalties, nxt, done)
+            emitted = emitted + carry_active(done, pos).astype(jnp.int32)
             tok, pos, done, steps, remaining = carry_step_update(
                 nxt, tok, pos, done, steps, remaining, eos_table)
             toks.append(tok)
@@ -922,8 +927,8 @@ def make_decode_window_fn(cfg: ModelConfig, allow_pallas: bool = True,
         if logprobs_topn:
             aux = (jnp.stack(lps, axis=1), jnp.stack(tvs, axis=1),
                    jnp.stack(tis, axis=1))
-            return out_toks, aux, carry, kv_k, kv_v
-        return out_toks, carry, kv_k, kv_v
+            return out_toks, emitted, aux, carry, kv_k, kv_v
+        return out_toks, emitted, carry, kv_k, kv_v
 
     return decode_window
 

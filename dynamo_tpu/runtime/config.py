@@ -206,6 +206,12 @@ register_env("DYN_REDISPATCH_MAX", "2", "llm/disagg",
              "re-enqueues after a fast transfer-plane failure, e.g. a "
              "prefill worker dying mid-transfer). 1 disables hedging.")
 
+register_env("DYN_ASYNC_DETOK", "1", "llm",
+             "dynaturbo: run Backend detokenization on a dedicated "
+             "executor thread instead of the event-loop thread. Chunks "
+             "of one request stay ordered (at most one in-flight decode "
+             "per request); 0 restores inline decoding for A/B.")
+
 register_env("DYN_CACHE_TOPK", "20", "engine",
              "dynacache: hot prefix chains reported per engine in "
              "GET /debug/cache (top-K cached block hashes by reuse "
@@ -216,6 +222,14 @@ register_env("DYN_CACHE_WINDOW", "256", "engine",
              "dyn_worker_prefix_cache_hit_rate gauge) reflect the last "
              "N admissions; the lifetime ratio and raw token totals are "
              "exported alongside.")
+
+register_env("DYN_LOOP_YIELD", None, "engine",
+             "dynaturbo A/B: restore the historical unconditional "
+             "asyncio.sleep(0) after each scheduler iteration. The "
+             "await run_in_executor(step) already suspends the loop "
+             "coroutine once per iteration, so the extra yield only "
+             "adds a second event-loop round-trip; set (any value) to "
+             "measure the difference with the loop-lag monitor.")
 
 register_env("DYN_JIT_FENCE", None, "engine",
              "Runtime compile fence: reaction to an XLA compile AFTER "

@@ -54,6 +54,9 @@ def record_of(fn, *a):
     ({"scenario": "sharded", "dp_replicas": 2, "mesh": "model=2"},
      "tok/s"),
     ({"scenario": "failover"}, "tok/s"),
+    ({"scenario": "hotpath", "decode_steps": 16}, "ms"),
+    ({"scenario": "hotpath", "decode_steps": 16,
+      "hotpath_legacy": True}, "ms"),
 ])
 def test_emit_unavailable_matches_metric_name(over, unit):
     """A chip-unavailable record must carry the SAME metric label (and a

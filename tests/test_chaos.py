@@ -816,6 +816,7 @@ def test_guard_metrics_exposed(run_async):
 # ------------------- the full stack under chaos: complete-or-fail, no hang
 
 
+@pytest.mark.slow  # heavyweight e2e: tier-1 wall budget (cheaper siblings stay in the gate)
 def test_full_stack_chaos_completes_or_fails_typed_within_deadline(run_async):
     """HTTP → processor → router → disagg decode → engine on CPU with the
     transfer plane severed under every send and a per-request deadline:

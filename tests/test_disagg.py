@@ -308,6 +308,7 @@ def test_disagg_fallback_on_no_prefill_worker(run_async):
     run_async(main())
 
 
+@pytest.mark.slow  # heavyweight e2e: tier-1 wall budget (cheaper siblings stay in the gate)
 def test_disagg_concurrent_mixed_fallback_completes(run_async):
     """The TPU-bench wedge scenario, deterministic on CPU: many concurrent
     requests racing remote prefills against a SLOW prefill worker under a

@@ -100,6 +100,7 @@ def test_moe_expert_parallel_sharding():
                                atol=2e-4)
 
 
+@pytest.mark.slow  # heavyweight e2e: tier-1 wall budget (cheaper siblings stay in the gate)
 def test_engine_serves_on_sharded_mesh(run_async):
     """JaxEngine with a TP x DP mesh: params/KV sharded, generation must
     match the unsharded engine token-for-token (greedy)."""

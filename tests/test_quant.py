@@ -262,6 +262,7 @@ def test_synthetic_int8_params_serve(run_async):
     assert len(toks) == 3 and all(0 <= t < cfg.vocab_size for t in toks)
 
 
+@pytest.mark.slow  # heavyweight e2e: tier-1 wall budget (cheaper siblings stay in the gate)
 def test_engine_tp_int8_matches_single_device(run_async):
     """JaxEngine under a 4-device data x model mesh with int8 weights:
     generation completes and matches the single-device int8 engine

@@ -380,6 +380,7 @@ def test_worker_kill_makes_handle_a_wedged_process(run_async):
 # --------------------------------------------- the failover e2e (tentpole)
 
 
+@pytest.mark.slow  # heavyweight e2e: tier-1 wall budget (cheaper siblings stay in the gate)
 def test_worker_kill_mid_decode_resumes_token_identical(run_async):
     """THE acceptance e2e: `worker.kill` chaos mid-decode on a 2-replica
     set → the client's greedy SSE stream completes token-identical to an
@@ -542,6 +543,7 @@ def test_worker_kill_mid_decode_resumes_token_identical(run_async):
 # ------------------------------------------------------------ drain e2e
 
 
+@pytest.mark.slow  # heavyweight e2e: tier-1 wall budget (cheaper siblings stay in the gate)
 def test_drain_finishes_inflight_refuses_new_and_router_avoids(run_async):
     """SIGTERM-shaped drain during active decode: the in-flight stream
     completes its full budget, new requests are refused with a typed

@@ -546,6 +546,7 @@ asyncio.run(main())
 '''
 
 
+@pytest.mark.slow  # heavyweight e2e: tier-1 wall budget (cheaper siblings stay in the gate)
 def test_sharded_serving_e2e_subprocess(device_subprocess):
     """The acceptance scenario, subprocess-isolated on a forced-8-device
     CPU host: concurrent HTTP requests through processor + KV router to

@@ -132,6 +132,7 @@ def test_spec_acceptance_positive_on_repetitive_prompt(run_async):
 # ------------------------------------------------------------- the bypass
 
 
+@pytest.mark.slow  # heavyweight e2e: tier-1 wall budget (cheaper siblings stay in the gate)
 def test_spec_bypass_for_sampled_penalty_logprobs(run_async):
     """Requests the greedy verify cannot reproduce — temperature
     sampling, count-state penalties, logprobs — bypass speculation

@@ -79,7 +79,7 @@ def test_sharded_window_kernel_matches_unsharded():
     kv_k, kv_v = prefill(kv_k, kv_v)
     ref_fn = llama.make_decode_window_fn(cfg, allow_pallas=False)
     a = _window_args(cfg, params, kv_k, kv_v, B, P)
-    ref_toks, ref_carry, _, _ = ref_fn(
+    ref_toks, _, ref_carry, _, _ = ref_fn(
         params, a["tokens"], a["positions"], a["done"], a["steps"],
         a["remaining"], a["kv_k"], a["kv_v"], a["page_table"],
         a["temperature"], a["top_k"], a["top_p"], a["seeds"],
@@ -96,7 +96,7 @@ def test_sharded_window_kernel_matches_unsharded():
     a = _window_args(cfg, sp, kv_k2, kv_v2, B, P)
     sb = shard_batch(mesh, tokens=a["tokens"], positions=a["positions"],
                      page_table=a["page_table"])
-    got_toks, got_carry, _, _ = tp_fn(
+    got_toks, _, got_carry, _, _ = tp_fn(
         sp, sb["tokens"], sb["positions"], a["done"], a["steps"],
         a["remaining"], kv_k2, kv_v2, sb["page_table"],
         a["temperature"], a["top_k"], a["top_p"], a["seeds"],
@@ -354,7 +354,7 @@ def test_sharded_window_kernel_gemma2_matches_xla(monkeypatch):
     kv_k, kv_v = prefill(*llama.init_kv_cache(cfg, spec))
     ref_fn = llama.make_decode_window_fn(cfg, allow_pallas=False)
     a = _window_args(cfg, params, kv_k, kv_v, B, P)
-    ref_toks, ref_carry, _, _ = ref_fn(
+    ref_toks, _, ref_carry, _, _ = ref_fn(
         params, a["tokens"], a["positions"], a["done"], a["steps"],
         a["remaining"], a["kv_k"], a["kv_v"], a["page_table"],
         a["temperature"], a["top_k"], a["top_p"], a["seeds"],
@@ -370,7 +370,7 @@ def test_sharded_window_kernel_gemma2_matches_xla(monkeypatch):
     a = _window_args(cfg, sp, kv_k2, kv_v2, B, P)
     sb = shard_batch(mesh, tokens=a["tokens"], positions=a["positions"],
                      page_table=a["page_table"])
-    got_toks, got_carry, _, _ = tp_fn(
+    got_toks, _, got_carry, _, _ = tp_fn(
         sp, sb["tokens"], sb["positions"], a["done"], a["steps"],
         a["remaining"], kv_k2, kv_v2, sb["page_table"],
         a["temperature"], a["top_k"], a["top_p"], a["seeds"],

@@ -80,5 +80,17 @@ run_step sharded_tp2 2400 --scenario sharded --mesh model=2 --dp-replicas 2
 run_step sharded_8b 3600 --scenario sharded --model 8b --dtype int8 \
     --mesh model=2 --dp-replicas 1 --concurrency 16
 
+# 11. dynaturbo decode hot-path A/B (ISSUE 16): identical decode-heavy
+#     workload, legacy arm (all hot-path optimizations off) first, then
+#     the overhauled path; each record carries itl_raw_chunk_p99_ms +
+#     the per-bucket cost table + loop-lag p99 + the compile fence.
+run_step hotpath_legacy 1800 --scenario hotpath --prof-sample 2 \
+    --hotpath-legacy --report-out "$OUT/hotpath_legacy_full.json"
+run_step hotpath 1800 --scenario hotpath --prof-sample 2 \
+    --report-out "$OUT/hotpath_full.json"
+# the quoted evidence table (docs/hot_path.md format)
+python -m tools.cost_diff "$OUT/hotpath_legacy_full.json" \
+    "$OUT/hotpath_full.json" > "$OUT/hotpath_cost_diff.txt" 2>&1 || true
+
 echo "=== chip session complete; results in $OUT/ ==="
 grep -h . "$OUT"/*.json 2>/dev/null | head -20
