@@ -71,6 +71,16 @@ def metric_name(args) -> str:
                 f"behind the KV router vs one unsharded engine, identical "
                 f"workload (ISL~{args.isl}/OSL {args.osl}, "
                 f"{args.requests} reqs, {_model_tag(args)} llama, {smoke})")
+    if args.scenario == "shared" and getattr(args, "cache_ab", False):
+        smoke = "cpu smoke" if getattr(args, "cpu", False) else "1 chip"
+        tier = str(args.host_pages) + (
+            "-fp16" if getattr(args, "host_tier_fp16", False) else "-int8")
+        return (f"realized hit rate + TTFT p95, dynaheat cache A/B "
+                f"(arms: lru/serial control, cost-evict, overlap-restore, "
+                f"cost+overlap; shared "
+                f"{getattr(args, 'shared_shape', 'multi_tenant')}, "
+                f"host_pages={tier}, {args.users}u x {args.turns}w, "
+                f"{_model_tag(args)} llama, {smoke})")
     if args.scenario == "shared":
         smoke = "cpu smoke" if getattr(args, "cpu", False) else "1 chip"
         return (f"prefix-cache hit rate, shared-prefix workloads "
@@ -277,7 +287,31 @@ def parse_args():
     ap.add_argument("--host-tier-int8", action="store_true",
                     help="int8-compress the host tier: half the D2H/H2D "
                          "bytes per page move (lossy; "
-                         "engine/kv_compress.py)")
+                         "engine/kv_compress.py). Now the DEFAULT when "
+                         "the tier is on — kept for invocation compat")
+    ap.add_argument("--host-tier-fp16", action="store_true",
+                    help="keep the host tier at pool precision (the "
+                         "lossless fallback arm for the int8-default "
+                         "A/B)")
+    ap.add_argument("--evict-policy", default=None,
+                    choices=["lru", "cost"],
+                    help="KV eviction policy override for both cache "
+                         "tiers (default: engine default = cost; lru is "
+                         "the A/B control)")
+    ap.add_argument("--restore-overlap", default=None,
+                    choices=["on", "off"],
+                    help="override the pipelined host-tier restore "
+                         "drain (default: engine default = on; off is "
+                         "the serial A/B control)")
+    ap.add_argument("--cache-ab", action="store_true",
+                    help="shared scenario: run the dynaheat four-arm "
+                         "cache A/B — lru/serial control, cost-evict, "
+                         "overlap-restore, cost+overlap — same workload "
+                         "per arm, fresh engine each, HBM pool sized "
+                         "below the working set so eviction policy "
+                         "actually decides; quotes per-arm TTFT "
+                         "p50/p95, realized hit rate, restore wait and "
+                         "evict fate split")
     ap.add_argument("--users", type=int, default=16)
     ap.add_argument("--turns", type=int, default=4)
     ap.add_argument("--max-batch", type=int, default=None,
@@ -393,8 +427,20 @@ def engine_setup(args):
         # (~10 pages/user HBM vs histories growing past 17 pages)
         ecfg.num_pages = min(ecfg.num_pages, 10 * args.users)
         ecfg.host_pages = args.host_pages
+    if args.scenario == "shared" and args.host_pages:
+        # dynaheat cache A/B: same pool-pressure setup — an HBM pool
+        # below the working set makes the eviction policy (and the
+        # host-tier restore pipeline) the thing being measured
+        ecfg.num_pages = min(ecfg.num_pages, 10 * args.users)
+        ecfg.host_pages = args.host_pages
     if args.host_tier_int8:
         ecfg.host_tier_int8 = True
+    if getattr(args, "host_tier_fp16", False):
+        ecfg.host_tier_int8 = False
+    if getattr(args, "evict_policy", None):
+        ecfg.evict_policy = args.evict_policy
+    if getattr(args, "restore_overlap", None) is not None:
+        ecfg.restore_overlap = args.restore_overlap == "on"
     params = None
     if args.model == "8b":
         # 8B Gaussian host-init costs minutes of single-core time the
@@ -759,6 +805,7 @@ async def run_shared(args):
                   "waves": args.turns, "shapes": {}}
         agg_hits = agg_prompts = 0
         ttft_ratios = []
+        share_ttfts: list = []  # share-leg TTFTs (the cache-sensitive arm)
         all_rows: list = []    # every leg's request rows (dynaslo goodput)
         async with aiohttp.ClientSession() as http:
             for shape in shapes:
@@ -786,6 +833,10 @@ async def run_shared(args):
                         "errors": sum(1 for r in rows if r.get("error")),
                         "ttft_p50_ms": (round(
                             ttfts[len(ttfts) // 2] * 1000, 1)
+                            if ttfts else None),
+                        "ttft_p95_ms": (round(
+                            ttfts[min(int(len(ttfts) * 0.95),
+                                      len(ttfts) - 1)] * 1000, 1)
                             if ttfts else None),
                         "prefix_hit_rate": round(hits / max(prompts, 1),
                                                  4),
@@ -816,6 +867,8 @@ async def run_shared(args):
                     if share:
                         agg_hits += hits
                         agg_prompts += prompts
+                        share_ttfts.extend(r["ttft"] for r in rows
+                                           if r.get("ttft") is not None)
                 entry = dict(legs)
                 if (legs["share"]["ttft_p50_ms"]
                         and legs["noshare"]["ttft_p50_ms"]):
@@ -842,6 +895,32 @@ async def run_shared(args):
         # dynaslo: goodput + per-role quantiles from the engine's merged
         # latency histograms (every wave's request rows judged)
         report["slo"] = _slo_block([st], all_rows)
+        # dynaheat flat cache keys: the per-toggle A/B driver and
+        # tools/cost_diff.py read these top-level (share-leg TTFT, the
+        # lifecycle counters, and the arm's toggle settings)
+        sorted_tt = sorted(share_ttfts)
+        report["ttft_p50_ms"] = (round(
+            sorted_tt[len(sorted_tt) // 2] * 1000, 1) if sorted_tt else None)
+        report["ttft_p95_ms"] = (round(
+            sorted_tt[min(int(len(sorted_tt) * 0.95),
+                          len(sorted_tt) - 1)] * 1000, 1)
+            if sorted_tt else None)
+        report["restore_wait_ms"] = round(
+            st["cache_restore_wait_seconds_total"] * 1000, 2)
+        report["device_hit_blocks"] = st["cache_device_hit_blocks_total"]
+        report["host_restored_blocks"] = st["cache_host_restored_blocks_total"]
+        report["fresh_blocks"] = st["cache_fresh_blocks_total"]
+        report["evict_offloaded_total"] = st["cache_evict_offloaded_total"]
+        report["evict_dropped_total"] = st["cache_evict_dropped_total"]
+        report["host_evictions_total"] = st["cache_host_evictions_total"]
+        report["restore_batch_pages_mean"] = round(
+            st["cache_restore_batch_pages_total"]
+            / max(st["cache_restore_batches_total"], 1), 2)
+        report["evict_policy"] = engine.ecfg.evict_policy
+        report["restore_overlap"] = bool(engine.ecfg.restore_overlap)
+        report["host_tier_int8"] = bool(engine.ecfg.host_tier_int8)
+        report["router_load_balance_weight"] = \
+            kvr.stats()["load_balance_weight"]
         print(json.dumps(report), file=sys.stderr)
         return report
     finally:
@@ -855,6 +934,73 @@ async def run_shared(args):
             await publisher.stop()
         await engine.stop()
         await drt.shutdown()
+
+
+# dynaheat per-toggle A/B: the SAME shared-prefix workload (same seed,
+# same shapes, same pool pressure) re-run once per arm with a fresh
+# engine, so every cache change is quoted against the lru/serial
+# control it replaced rather than against a different traffic mix.
+_CACHE_AB_ARMS = (
+    # name            evict_policy  restore_overlap
+    ("control",        "lru",       "off"),   # pre-dynaheat behavior
+    ("cost_evict",     "cost",      "off"),
+    ("overlap_restore", "lru",      "on"),
+    ("cost_overlap",   "cost",      "on"),    # dynaheat defaults
+)
+
+_CACHE_AB_ARM_KEYS = (
+    "prefix_hit_rate", "hit_rate_windowed", "ttft_p50_ms", "ttft_p95_ms",
+    "restore_wait_ms", "restore_batch_pages_mean",
+    "device_hit_blocks", "host_restored_blocks", "fresh_blocks",
+    "evict_offloaded_total", "evict_dropped_total", "host_evictions_total",
+    "post_warmup_compiles", "evict_policy", "restore_overlap",
+    "host_tier_int8", "router_load_balance_weight",
+)
+
+
+def run_shared_cache_ab(args) -> dict:
+    """Four-arm cache A/B (--cache-ab): lru/serial control, cost-aware
+    eviction alone, overlapped restores alone, and both together. Value
+    is the combined arm's realized prefix hit rate; vs_baseline is the
+    control-over-combined TTFT-p95 ratio (>1 = dynaheat is faster)."""
+    import copy
+
+    if not args.host_pages:
+        # the A/B is ABOUT the two-tier cache — without a host tier the
+        # eviction policy only picks drop victims and restores never run
+        args.host_pages = 16 * args.users
+    arms = {}
+    for name, policy, overlap in _CACHE_AB_ARMS:
+        a = copy.copy(args)
+        a.evict_policy = policy
+        a.restore_overlap = overlap
+        print(f"=== cache A/B arm {name}: evict={policy}, "
+              f"restore_overlap={overlap} ===", file=sys.stderr)
+        rep = asyncio.run(run_shared(a))
+        arms[name] = {k: rep.get(k) for k in _CACHE_AB_ARM_KEYS}
+    ctrl, best = arms["control"], arms["cost_overlap"]
+    detail = {"scenario": "shared_cache_ab", "users": args.users,
+              "waves": args.turns, "host_pages": args.host_pages,
+              "host_tier_int8": best["host_tier_int8"],
+              "arms": arms}
+    for name, rep in arms.items():
+        if name == "control":
+            continue
+        d = {}
+        if ctrl["ttft_p95_ms"] and rep["ttft_p95_ms"]:
+            d["ttft_p95_control_over_arm"] = round(
+                ctrl["ttft_p95_ms"] / rep["ttft_p95_ms"], 3)
+        d["hit_rate_delta"] = round(
+            rep["prefix_hit_rate"] - ctrl["prefix_hit_rate"], 4)
+        d["restore_wait_ms_delta"] = round(
+            rep["restore_wait_ms"] - ctrl["restore_wait_ms"], 2)
+        detail[f"{name}_vs_control"] = d
+    vs = (round(ctrl["ttft_p95_ms"] / best["ttft_p95_ms"], 3)
+          if ctrl["ttft_p95_ms"] and best["ttft_p95_ms"] else 1.0)
+    return {"metric": metric_name(args),
+            "value": best["prefix_hit_rate"],
+            "unit": metric_unit(args), "vs_baseline": vs,
+            "detail": detail}
 
 
 # --------------------------------------------------- dynashard sharded A/B
@@ -1994,6 +2140,8 @@ def _run_scenario(args) -> dict:
                 "value": report["disagg_over_agg_req_per_s"],
                 "unit": metric_unit(args), "vs_baseline": 1.0,
                 "detail": report}
+    if args.scenario == "shared" and getattr(args, "cache_ab", False):
+        return run_shared_cache_ab(args)
     if args.scenario == "shared":
         report = asyncio.run(run_shared(args))
         return {"metric": metric_name(args),

@@ -46,7 +46,7 @@ from ..models.config import ModelConfig
 from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
 from ..runtime import guard, profiling, slo, tracing
-from ..runtime.config import env_flag, env_int
+from ..runtime.config import env_bool, env_flag, env_int, env_str
 from ..runtime.engine import Context
 from .jit_fence import CompileFence
 from .kv_manager import ChainHashCache, PageManager
@@ -100,8 +100,21 @@ class EngineConfig:
     # quantized ON DEVICE before D2H and dequantized ON DEVICE after
     # H2D, so the slow host link moves ~half the bytes and the host
     # pool holds ~2x the pages per GB. LOSSY (restored pages round-trip
-    # through int8) — opt-in
-    host_tier_int8: bool = False
+    # through int8). None (default) = ON whenever the tier is enabled,
+    # unless DYN_HOST_TIER_FP16 asks for the lossless fallback;
+    # explicit True/False wins over both
+    host_tier_int8: Optional[bool] = None
+    # dynaheat eviction policy for BOTH cache tiers: "cost" (GreedyDual
+    # over the dynacache hot-prefix hit table — hot shared prefixes
+    # outlive cold one-shot churn) or "lru" (the original least-recently-
+    # freed order, kept as the A/B control). None reads DYN_EVICT_POLICY.
+    evict_policy: Optional[str] = None
+    # dynaheat overlapped restores: a drained restore batch's H2D +
+    # dequantize dispatches on one drain and its page inject lands on
+    # the NEXT, overlapping the intervening device step. False = the
+    # serial same-drain inject (A/B control). None reads
+    # DYN_RESTORE_OVERLAP.
+    restore_overlap: Optional[bool] = None
     max_prefill_batch: int = 8  # prompts packed per prefill dispatch
     # fused decode window: run K decode+sample steps inside ONE jitted
     # program (sampling stays on device; tokens cross to the host once per
@@ -440,12 +453,25 @@ class JaxEngine:
                        else make_long_prefill_fn)
             self.long_prefill_fn = builder(model_cfg, mesh)
             self._seq_par = mesh.shape["seq"]
+        # resolve the dynaheat None-means-env config knobs ONCE, here,
+        # so every later read sees a concrete value (the ecfg object is
+        # per-engine; bench/tests that pass explicit values are
+        # untouched)
+        if self.ecfg.host_tier_int8 is None:
+            self.ecfg.host_tier_int8 = (
+                self.ecfg.host_pages > 0
+                and not env_bool("DYN_HOST_TIER_FP16"))
+        if self.ecfg.evict_policy is None:
+            self.ecfg.evict_policy = env_str("DYN_EVICT_POLICY") or "cost"
+        if self.ecfg.restore_overlap is None:
+            self.ecfg.restore_overlap = env_bool("DYN_RESTORE_OVERLAP", True)
         # async frames must take _pm_lock (declared below) before
         # touching the page pool; sync frames on the engine step path
         # are serialized by the single-worker executor
         self.pm = PageManager(self.ecfg.num_pages,  # guarded-by: self._pm_lock
                               self.ecfg.page_size,
-                              host_pages=self.ecfg.host_pages)
+                              host_pages=self.ecfg.host_pages,
+                              evict_policy=self.ecfg.evict_policy)
         # host-DRAM offload pools (same per-page layout as the HBM pool)
         self.host_k = self.host_v = None
         self.host_k_s = self.host_v_s = None
@@ -505,6 +531,12 @@ class JaxEngine:
         # are gated out of prefill until the copy dispatches)
         self._offload_inflight: List[Tuple] = []
         self._unrestored_pages: set = set()
+        # restore_overlap staging: ONE drained restore batch whose H2D +
+        # dequantize dispatched on the previous drain (overlapping the
+        # intervening device step) and whose page inject lands on the
+        # next. Rows: (page, block_hash) per restored page + the device
+        # arrays; pages stay in _unrestored_pages until injected.
+        self._restore_staged: Optional[Tuple] = None
         # per-sequence max context implied by the warmed bucket grid: a
         # request may never need more pages than the largest page bucket,
         # or serving would compile mid-flight (VERDICT r2 weak #6)
@@ -770,6 +802,14 @@ class JaxEngine:
             size = 1
             while True:
                 idx = jnp.zeros(size, jnp.int32)
+                # the serving drain builds its index operands as
+                # jnp.asarray(<python list>, jnp.int32) — a DIFFERENT
+                # lowering (convert_element_type) from zeros/full above,
+                # one tiny program per distinct padded length. Warm that
+                # call form too, or the first drain of each pow2 size
+                # compiles mid-serving (compile-fence finding on the
+                # cache A/B arms).
+                jax.block_until_ready(jnp.asarray([0] * size, jnp.int32))
                 # both pools: their page shapes differ per model family
                 # (MLA latent vs rope), so each is its own program set
                 for pool_attr in ("kv_k", "kv_v"):
@@ -1296,18 +1336,36 @@ class JaxEngine:
         stall every other request; their sequences stay gated via
         ``_unrestored_pages`` until the copy dispatches.
 
+        With ``restore_overlap`` the drained batch is PIPELINED: its
+        host-slot gather + H2D + dequantize dispatch now, but the page
+        inject lands at the START of the next drain — the transfer gets
+        the whole intervening device step to complete instead of
+        stalling it. Staged pages stay in ``_unrestored_pages`` until
+        injected; rows whose page was recycled in between are remapped
+        to the out-of-range pad target at inject time (the scatter
+        drops them), so a late inject can never clobber a reallocated
+        page.
+
         ``full=True`` drains EVERYTHING now — required by the paths that
         hand pages to a consumer with no later drain between (disagg
         reserve/extract/inject)."""
         if self.host_k is None:
             return
         chunk = None if full else (self.ecfg.tier_restore_chunk or None)
+        # land the previous drain's staged restore batch FIRST: its H2D
+        # overlapped the intervening step, so this inject is cheap
+        if self._restore_staged is not None:
+            self._inject_staged()
         with self._pm_lock:
             off, res = self.pm.drain_tier_ops(restore_limit=chunk)
+            # block hash per drained page, captured under the lock — the
+            # inject-time validity check compares against by_hash
+            res_hashes = [self.pm.pages[p].block_hash for p, _ in res]
             # the gate set mirrors the still-queued restores exactly —
             # this also un-gates pages whose stale restore _pop_fresh
             # cancelled on reallocation (their new owner must not wait
-            # for a copy that will never run)
+            # for a copy that will never run). Newly staged pages are
+            # added back below.
             self._unrestored_pages = {p for p, _ in
                                       self.pm.pending_restore}
         if off:
@@ -1341,11 +1399,8 @@ class JaxEngine:
             rt0 = time.perf_counter()
             pages = [p for p, _ in res]
             slots = [s for _, s in res]
-            # pad targets out-of-range → dropped by the scatter; pad the
-            # host gather with slot 0 (content discarded)
-            idx = _pad_pow2(pages, self.ecfg.num_pages)
+            # pad the host gather with slot 0 (content discarded)
             hsl = _pad_pow2(slots, 0)
-            iidx = jnp.asarray(idx, jnp.int32)
             if self.ecfg.host_tier_int8:
                 # H2D moves int8 + scales; dequant runs on device
                 from .kv_compress import dequantize_pages
@@ -1359,8 +1414,20 @@ class JaxEngine:
             else:
                 k_rows = jnp.asarray(self.host_k[:, hsl])
                 v_rows = jnp.asarray(self.host_v[:, hsl])
-            self.kv_k = _inject_pages(self.kv_k, iidx, k_rows)
-            self.kv_v = _inject_pages(self.kv_v, iidx, v_rows)
+            overlap = bool(self.ecfg.restore_overlap) and not full
+            if overlap:
+                # pipeline: park the in-flight rows; the inject lands at
+                # the start of the NEXT drain. Pages stay gated.
+                self._restore_staged = (pages, res_hashes, k_rows, v_rows)
+                self._unrestored_pages.update(pages)
+            else:
+                # serial (A/B control / full drain): inject in the same
+                # drain. Pad targets out-of-range → dropped by the
+                # scatter.
+                idx = _pad_pow2(pages, self.ecfg.num_pages)
+                iidx = jnp.asarray(idx, jnp.int32)
+                self.kv_k = _inject_pages(self.kv_k, iidx, k_rows)
+                self.kv_v = _inject_pages(self.kv_v, iidx, v_rows)
             self.restore_pages_total += len(res)
             # dynacache: restore drain visibility — a step-timeline event
             # and a dyntrace span per drained batch (dispatch time only;
@@ -1370,11 +1437,28 @@ class JaxEngine:
             self.step_timeline.add(
                 "cache.restore", pages=len(res),
                 queued=len(self._unrestored_pages),
+                staged=int(overlap),
                 dispatch_ms=round(rdt * 1000.0, 3))
             tracing.get_tracer().record_span(
                 "cache.restore", rdt, parent=None,
-                attributes={"pages": len(res),
+                attributes={"pages": len(res), "staged": overlap,
                             "queued": len(self._unrestored_pages)})
+
+    def _inject_staged(self) -> None:
+        """Land the staged restore batch (restore_overlap second half).
+        Rows whose page was recycled since staging (sequence released
+        and the page re-popped — its hash no longer maps to it) are
+        remapped to the out-of-range pad target so the scatter drops
+        them; their content now belongs to someone else."""
+        pages, hashes, k_rows, v_rows = self._restore_staged
+        self._restore_staged = None
+        with self._pm_lock:
+            tgt = [p if self.pm.by_hash.get(h) == p else self.ecfg.num_pages
+                   for p, h in zip(pages, hashes)]
+        iidx = jnp.asarray(_pad_pow2(tgt, self.ecfg.num_pages), jnp.int32)
+        self.kv_k = _inject_pages(self.kv_k, iidx, k_rows)
+        self.kv_v = _inject_pages(self.kv_v, iidx, v_rows)
+        self._unrestored_pages.difference_update(pages)
 
     # ------------------------------------------------------------- prefill
 

@@ -31,6 +31,7 @@ page copies before its next device step (jax_engine._drain_kv_tier).
 
 from __future__ import annotations
 
+import heapq
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -39,6 +40,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import xxhash
 
 HASH_SEED = 1337  # match the reference's block hasher (kv_router/indexer.rs)
+
+EVICT_POLICIES = ("lru", "cost")
 
 
 def hash_block(parent: int, tokens: Sequence[int]) -> int:
@@ -145,9 +148,15 @@ class Alloc:
 class PageManager:
     """Host-side page pool bookkeeping with prefix reuse."""
 
-    def __init__(self, num_pages: int, page_size: int, host_pages: int = 0):
+    def __init__(self, num_pages: int, page_size: int, host_pages: int = 0,
+                 evict_policy: str = "lru"):
+        if evict_policy not in EVICT_POLICIES:
+            raise ValueError(
+                f"evict_policy must be one of {EVICT_POLICIES}, "
+                f"got {evict_policy!r}")
         self.num_pages = num_pages
         self.page_size = page_size
+        self.evict_policy = evict_policy
         # every pool structure below is event-loop-affine: all methods
         # are sync (each call is one atomic block under the loop), and
         # cross-thread callers serialize on the engine's _pm_lock. The
@@ -172,6 +181,28 @@ class PageManager:
         # by the same call must not reassign them (they reach
         # pending_restore only when the call completes)
         self._pinned_slots: set = set()
+        # slot→pin refcount, maintained at every pin transition (queued
+        # copies enqueue/drain, _pinned_slots add/remove) so _host_slot's
+        # busy check is O(1) instead of rebuilding a set of every queued
+        # copy per claim
+        self._slot_pins: Dict[int, int] = {}  # guarded-by: loop
+        # ---- eviction policy (dynaheat) ----
+        # `lru` keeps the original OrderedDict popitem/LRU-walk order as
+        # the A/B control. `cost` runs GreedyDual over both tiers: lazy
+        # min-heaps of (priority, seq, page_or_slot) with per-entry
+        # generation stamps for O(log n) eviction; priority = clock + 1 +
+        # hot-prefix hits, and the clock advances to each evicted entry's
+        # priority so once-hot blocks age out instead of squatting.
+        # heap rows are (priority, seq, page_or_slot, gen); a row is live
+        # iff gen matches the current _dev_gen/_host_gen for its member
+        self._dev_heap: List[Tuple[float, int, int, int]] = []  # guarded-by: loop
+        self._dev_gen: Dict[int, int] = {}  # guarded-by: loop
+        self._dev_clock = 0.0  # guarded-by: loop
+        self._host_heap: List[Tuple[float, int, int, int]] = []  # guarded-by: loop
+        self._host_gen: Dict[int, int] = {}  # guarded-by: loop
+        self._host_clock = 0.0  # guarded-by: loop
+        self._host_touch = 0  # host LRU clock (monotonic touch counter)
+        self._evict_seq = 0  # heap FIFO tiebreaker (monotonic)
         # ---- dynacache telemetry (host-side counters; same loop/lock
         # discipline as the pool structures above) ----
         # allocation prefix split (blocks == pages)
@@ -189,6 +220,11 @@ class PageManager:
         self._restore_enq: Dict[int, float] = {}  # guarded-by: loop
         self.restores_drained_total = 0  # guarded-by: loop
         self.restore_wait_seconds_total = 0.0  # guarded-by: loop
+        # restore batching: drained-batch count + pages per batch (mean
+        # batch size = pages/batches — the coalescing win the overlapped
+        # drain is chasing)
+        self.restore_batches_total = 0  # guarded-by: loop
+        self.restore_batch_pages_total = 0  # guarded-by: loop
         # hot prefix chains: per-block-hash hit counter, bounded — hashes
         # past the cap are simply untracked (top-K reporting only needs
         # the hot head, and an unbounded dict would grow with the corpus)
@@ -279,6 +315,8 @@ class PageManager:
         # needs to read (silent KV corruption — ADVICE r1 high)
         pinned = {slot for page, slot, _ in plan if page is None}
         self._pinned_slots |= pinned
+        for slot in pinned:
+            self._pin_slot(slot)
         claimed: List[int] = []
         restores: List[Tuple[int, int]] = []
         try:
@@ -303,15 +341,19 @@ class PageManager:
                 self.pages[fresh].committed_at = time.monotonic()
                 self.by_hash[h] = fresh
                 self.host_lru.move_to_end(slot)
+                self._host_push(slot, h)  # host hit — refresh its priority
                 restores.append((fresh, slot))
                 claimed.append(fresh)
             for _ in range(need_total - len(claimed)):
                 claimed.append(self._pop_fresh())
         finally:
             self._pinned_slots -= pinned
+            for slot in pinned:
+                self._unpin_slot(slot)
         now = time.monotonic()
-        for page, _ in restores:
+        for page, slot in restores:
             self._restore_enq[page] = now
+            self._pin_slot(slot)
         self.pending_restore.extend(restores)
         # dynacache: prefix split + hot-chain hit counts for the blocks
         # actually reused (plan may have been truncated above)
@@ -399,22 +441,109 @@ class PageManager:
             if st.refcount == 0:
                 if st.block_hash is not None:
                     self.reusable[p] = None  # most-recently-freed last
+                    if self.evict_policy == "cost":
+                        self._dev_push(p)
                 else:
                     self.free.append(p)
 
     # ------------------------------------------------------------- internal
 
+    def _pin_slot(self, slot: int) -> None:
+        self._slot_pins[slot] = self._slot_pins.get(slot, 0) + 1
+
+    def _unpin_slot(self, slot: int) -> None:
+        n = self._slot_pins.get(slot, 0) - 1
+        if n <= 0:
+            self._slot_pins.pop(slot, None)
+        else:
+            self._slot_pins[slot] = n
+
+    def _hits(self, block_hash: Optional[int]) -> int:
+        return self._hit_counts.get(block_hash, 0) if block_hash is not None \
+            else 0
+
+    def _dev_push(self, page: int) -> None:
+        """Enter ``page`` into the cost-policy device eviction heap (call
+        when it becomes reusable). Priority is GreedyDual: clock + 1 +
+        hot-prefix hits."""
+        gen = self._dev_gen.get(page, 0) + 1
+        self._dev_gen[page] = gen
+        self._evict_seq += 1
+        pri = self._dev_clock + 1.0 + self._hits(self.pages[page].block_hash)
+        heapq.heappush(self._dev_heap, (pri, self._evict_seq, page, gen))
+        if len(self._dev_heap) > 4 * self.num_pages + 64:
+            self._compact_heap("dev")
+
+    def _dev_invalidate(self, page: int) -> None:
+        """Lazy-invalidate any live heap row for ``page`` (it left the
+        reusable pool by _ref or eviction)."""
+        if page in self._dev_gen:
+            self._dev_gen[page] += 1
+
+    def _host_push(self, slot: int, block_hash: Optional[int]) -> None:
+        """(Re)enter ``slot`` into the host eviction heap — called on
+        every touch (insert, host hit, re-offload refresh). Under ``lru``
+        the priority is a monotonic touch counter, which reproduces the
+        OrderedDict LRU→MRU victim order exactly; under ``cost`` it is
+        the GreedyDual score."""
+        gen = self._host_gen.get(slot, 0) + 1
+        self._host_gen[slot] = gen
+        self._evict_seq += 1
+        if self.evict_policy == "cost":
+            pri = self._host_clock + 1.0 + self._hits(block_hash)
+        else:
+            self._host_touch += 1
+            pri = float(self._host_touch)
+        heapq.heappush(self._host_heap, (pri, self._evict_seq, slot, gen))
+        if len(self._host_heap) > 4 * self.host_pages + 64:
+            self._compact_heap("host")
+
+    def _compact_heap(self, which: str) -> None:
+        """Drop stale rows when lazy invalidation lets a heap outgrow its
+        pool 4x (amortized O(pool) — pushes since the last compaction pay
+        for it)."""
+        if which == "dev":
+            self._dev_heap = [r for r in self._dev_heap
+                              if self._dev_gen.get(r[2]) == r[3]]
+            heapq.heapify(self._dev_heap)
+        else:
+            self._host_heap = [r for r in self._host_heap
+                               if self._host_gen.get(r[2]) == r[3]]
+            heapq.heapify(self._host_heap)
+
     def _ref(self, page: int) -> None:
         st = self.pages[page]
         if st.refcount == 0 and page in self.reusable:
             del self.reusable[page]
+            self._dev_invalidate(page)
         st.refcount += 1
+
+    def _evict_reusable(self) -> int:
+        """Pick the eviction victim from the reusable pool. ``lru`` pops
+        the least-recently-freed entry (the original order — A/B control);
+        ``cost`` pops the minimum GreedyDual row from the lazy heap,
+        skipping stale rows, and advances the clock to the evicted
+        priority so surviving hot blocks age relative to it."""
+        if self.evict_policy == "cost":
+            while self._dev_heap:
+                pri, _, page, gen = heapq.heappop(self._dev_heap)
+                if self._dev_gen.get(page) != gen or page not in self.reusable:
+                    continue  # stale row (page was re-ref'd or re-pushed)
+                del self.reusable[page]
+                self._dev_gen[page] = gen + 1
+                self._dev_clock = max(self._dev_clock, pri)
+                return page
+            # defensive: heap dry but reusable non-empty (should not
+            # happen — every reusable insert pushes a row)
+        page, _ = self.reusable.popitem(last=False)
+        self._dev_invalidate(page)
+        return page
 
     def _pop_fresh(self) -> int:
         if self.free:
             page = self.free.popleft()
         else:
-            page, _ = self.reusable.popitem(last=False)  # evict LRU reusable
+            page = self._evict_reusable()
             st = self.pages[page]
             if st.block_hash is not None:
                 h = st.block_hash
@@ -428,14 +557,17 @@ class PageManager:
                     if h in self.host_by_hash:
                         # block already resident in the host tier (this page
                         # was a restore) — no copy, just refresh LRU
-                        self.host_lru.move_to_end(self.host_by_hash[h])
                         slot = self.host_by_hash[h]
+                        self.host_lru.move_to_end(slot)
+                        self._host_push(slot, h)
                     else:
                         slot = self._host_slot()
                         if slot is not None:
                             self.host_by_hash[h] = slot
                             self.host_lru[slot] = h
+                            self._host_push(slot, h)
                             self.pending_offload.append((page, slot))
+                            self._pin_slot(slot)
                 if slot is None:
                     self.evict_dropped_total += 1
                     self.events.append(KvEvent("removed", [h]))
@@ -445,8 +577,13 @@ class PageManager:
         # before any device step drained it) — a late copy would clobber
         # the new owner's content
         if self.pending_restore:
-            self.pending_restore = [(p, s) for p, s in self.pending_restore
-                                    if p != page]
+            kept = []
+            for p, s in self.pending_restore:
+                if p == page:
+                    self._unpin_slot(s)
+                else:
+                    kept.append((p, s))
+            self.pending_restore = kept
             self._restore_enq.pop(page, None)
         st = self.pages[page]
         assert st.refcount == 0
@@ -454,25 +591,43 @@ class PageManager:
         return page
 
     def _host_slot(self) -> Optional[int]:
-        """Claim a host-tier slot, evicting the LRU host block if full.
-        Slots referenced by queued copies are pinned (a reassignment before
-        the drain would corrupt the in-flight copy); returns None when the
-        whole tier is pinned. A "removed" event fires only when the evicted
-        block has no device copy either (it leaves the worker entirely)."""
+        """Claim a host-tier slot, evicting the policy victim if full
+        (``lru``: least-recently-touched; ``cost``: minimum GreedyDual
+        score). Slots referenced by queued copies are pinned (a
+        reassignment before the drain would corrupt the in-flight copy);
+        the O(1) ``_slot_pins`` refcount replaces the old per-claim busy
+        set + O(n) LRU walk. Pinned rows popped off the heap top are
+        stashed and re-pushed after the claim, so a claim is O(log n +
+        pinned). Returns None when the whole tier is pinned. A "removed"
+        event fires only when the evicted block has no device copy either
+        (it leaves the worker entirely)."""
         if self.host_free:
             return self.host_free.popleft()
-        busy = {s for _, s in self.pending_restore}
-        busy.update(s for _, s in self.pending_offload)
-        busy.update(self._pinned_slots)
-        for slot in self.host_lru:  # LRU → MRU order
-            if slot not in busy:
-                old_h = self.host_lru.pop(slot)
-                del self.host_by_hash[old_h]
-                self.host_evictions_total += 1
-                if old_h not in self.by_hash:
-                    self.events.append(KvEvent("removed", [old_h]))
-                return slot
-        return None
+        stashed: List[Tuple[float, int, int, int]] = []
+        victim: Optional[int] = None
+        while self._host_heap:
+            row = heapq.heappop(self._host_heap)
+            pri, _, slot, gen = row
+            if self._host_gen.get(slot) != gen or slot not in self.host_lru:
+                continue  # stale row (slot was re-touched or evicted)
+            if self._slot_pins.get(slot, 0) > 0:
+                stashed.append(row)  # still live — restore after the claim
+                continue
+            victim = slot
+            if self.evict_policy == "cost":
+                self._host_clock = max(self._host_clock, pri)
+            break
+        for row in stashed:
+            heapq.heappush(self._host_heap, row)
+        if victim is None:
+            return None
+        self._host_gen[victim] += 1
+        old_h = self.host_lru.pop(victim)
+        del self.host_by_hash[old_h]
+        self.host_evictions_total += 1
+        if old_h not in self.by_hash:
+            self.events.append(KvEvent("removed", [old_h]))
+        return victim
 
     def drain_tier_ops(self, restore_limit: Optional[int] = None
                        ) -> Tuple[List[Tuple[int, int]],
@@ -492,14 +647,19 @@ class PageManager:
         else:
             res = self.pending_restore[:restore_limit]
             self.pending_restore = self.pending_restore[restore_limit:]
+        for _, slot in off:
+            self._unpin_slot(slot)
         if res:
             # restore drain latency: enqueue → this pop (the dispatch point)
             now = time.monotonic()
-            for page, _ in res:
+            for page, slot in res:
+                self._unpin_slot(slot)
                 ts = self._restore_enq.pop(page, None)
                 if ts is not None:
                     self.restore_wait_seconds_total += max(now - ts, 0.0)
             self.restores_drained_total += len(res)
+            self.restore_batches_total += 1
+            self.restore_batch_pages_total += len(res)
         return off, res
 
     def host_usage(self) -> float:
@@ -536,6 +696,9 @@ class PageManager:
             "restores_drained_total": self.restores_drained_total,
             "restore_wait_seconds_total": round(
                 self.restore_wait_seconds_total, 4),
+            "restore_batches_total": self.restore_batches_total,
+            "restore_batch_pages_total": self.restore_batch_pages_total,
+            "evict_policy": self.evict_policy,
         }
 
     def drain_events(self) -> List[KvEvent]:

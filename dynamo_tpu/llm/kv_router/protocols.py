@@ -68,6 +68,10 @@ class ForwardPassMetrics:
     cache_restore_queue_depth: int = 0
     cache_restores_drained_total: int = 0
     cache_restore_wait_seconds_total: float = 0.0
+    # dynaheat restore batching: drained batches + pages per batch (mean
+    # batch size = pages/batches — the coalescing win)
+    cache_restore_batches_total: int = 0
+    cache_restore_batch_pages_total: int = 0
     # self-speculative decoding observability (engine/spec_decode.py):
     # accepted/drafted tokens, and accepted drafts per verify step
     spec_decode_acceptance_rate: float = 0.0

@@ -236,6 +236,11 @@ class KvRouter:
             self.calib_predicted_blocks += predicted
             self.calib_realized_blocks += realized
             self.calib_abs_error_blocks += abs(predicted - realized)
+            # dynaheat: feed the scheduler's load_balance_weight
+            # autotuner (no-op unless enabled; bounded adjustment once
+            # per calibration window)
+            self.scheduler.observe_calibration(predicted, realized,
+                                               ent["isl_blocks"])
         guard.counter_inc("dyn_kv_router_predicted_vs_realized_blocks",
                           float(predicted), view="predicted")
         guard.counter_inc("dyn_kv_router_predicted_vs_realized_blocks",
@@ -275,6 +280,14 @@ class KvRouter:
             # predicted (overlap scoring) vs realized (engine prefix
             # split) blocks over requests whose cost block came back
             "calibration": calib,
+            # dynaheat autotune: the live (possibly self-tuned) cost
+            # weight and how often calibration bias actually moved it
+            "load_balance_weight": round(
+                self.scheduler.load_balance_weight, 4),
+            "autotune": {
+                "enabled": bool(self.scheduler.autotune),
+                "adjustments": self.scheduler.autotune_adjustments,
+            },
         }
 
     def cache_snapshot(self) -> dict:

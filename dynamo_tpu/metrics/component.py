@@ -246,6 +246,13 @@ class MetricsAggregator:
             ("dyn_engine_cache_restore_wait_seconds_total",
              "summed queue wait of dispatched restores",
              lambda m: m.cache_restore_wait_seconds_total),
+            ("dyn_engine_cache_restore_batches_total",
+             "host->HBM restore batches dispatched (dynaheat batching)",
+             lambda m: m.cache_restore_batches_total),
+            ("dyn_engine_cache_restore_batch_pages_total",
+             "pages across dispatched restore batches (mean batch size "
+             "= pages / batches)",
+             lambda m: m.cache_restore_batch_pages_total),
             ("dyn_engine_batch_dispatches_total",
              "dispatches that distributed a per-request step share "
              "(dynaprof attribution conservation denominator)",

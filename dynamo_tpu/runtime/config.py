@@ -223,6 +223,28 @@ register_env("DYN_CACHE_WINDOW", "256", "engine",
              "N admissions; the lifetime ratio and raw token totals are "
              "exported alongside.")
 
+register_env("DYN_EVICT_POLICY", "cost", "engine",
+             "dynaheat: KV eviction policy for both cache tiers "
+             "(EngineConfig.evict_policy=None reads this). 'cost' "
+             "(default) runs GreedyDual over the dynacache hot-prefix "
+             "hit table — a hot shared prefix outlives cold one-shot "
+             "churn, O(log n) per eviction; 'lru' restores the original "
+             "least-recently-freed order (the A/B control arm).")
+register_env("DYN_RESTORE_OVERLAP", "1", "engine",
+             "dynaheat: pipeline host-tier restores — a drained batch's "
+             "H2D + dequantize dispatch on one drain and its page "
+             "inject lands on the next, so the transfer overlaps the "
+             "intervening device step instead of stalling it. 0 "
+             "restores the serial same-drain inject (the A/B control "
+             "arm). EngineConfig.restore_overlap=None reads this.")
+register_env("DYN_HOST_TIER_FP16", "0", "engine",
+             "dynaheat: keep the host KV tier at pool precision instead "
+             "of the int8 default (engine/kv_compress.py). int8 halves "
+             "the D2H/H2D bytes and doubles pages-per-GB but pages "
+             "round-trip lossily; set 1 for the lossless fallback when "
+             "bit-exact restores matter more than tier capacity. "
+             "Explicit EngineConfig.host_tier_int8=True/False wins.")
+
 register_env("DYN_LOOP_YIELD", None, "engine",
              "dynaturbo A/B: restore the historical unconditional "
              "asyncio.sleep(0) after each scheduler iteration. The "
@@ -245,6 +267,20 @@ register_env("DYN_PROF_SAMPLE", "0", "engine",
              "split, per-bucket cost table). The sampled iteration pays "
              "one deliberate device sync; 0 (default) disables sampling "
              "entirely — the hot path stays sync-free.")
+
+register_env("DYN_ROUTER_AUTOTUNE", "1", "llm",
+             "dynaheat: self-tune KvScheduler.load_balance_weight from "
+             "the dynacache predicted-vs-realized overlap calibration "
+             "error. Systematic over-prediction (stale/optimistic index) "
+             "shifts weight toward load; under-prediction shifts it "
+             "toward overlap. Bounded to [0.1, 0.9] and exported as the "
+             "dyn_kv_router_load_balance_weight gauge; 0 pins the "
+             "configured weight (the A/B control arm).")
+register_env("DYN_ROUTER_AUTOTUNE_GAIN", "0.05", "llm",
+             "dynaheat: per-window step size for the load_balance_weight "
+             "autotuner (fraction of the bounded range moved per "
+             "calibration window at full bias). Small values converge "
+             "slowly but never oscillate; 0 observes without adjusting.")
 
 register_env("DYN_PROF_USAGE", "0", "llm",
              "dynaprof: attach the per-request cost-attribution block "

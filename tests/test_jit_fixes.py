@@ -36,17 +36,25 @@ def mk_engine(**eng_kw):
 
 
 def test_host_pool_dtype_without_device_roundtrip():
-    """The host pools must match the device pool dtype (incl. bf16),
-    resolved WITHOUT a device round-trip (jax_engine DL017 fix)."""
-    eng = mk_engine(host_pages=8, num_pages=16)
+    """On the lossless tier (``host_tier_int8=False``) the host pools
+    must match the device pool dtype (incl. bf16), resolved WITHOUT a
+    device round-trip (jax_engine DL017 fix). With the dynaheat
+    int8-default tier the host pools are int8 by design."""
+    eng = mk_engine(host_pages=8, num_pages=16, host_tier_int8=False)
     assert eng.host_k is not None
     assert eng.host_k.dtype == np.dtype(eng.kv_k.dtype)
     assert eng.host_v.dtype == np.dtype(eng.kv_v.dtype)
     eng_bf16 = JaxEngine(ModelConfig.tiny(),
                          EngineConfig(page_size=8, num_pages=16,
-                                      host_pages=8),
+                                      host_pages=8, host_tier_int8=False),
                          seed=0, dtype=jnp.bfloat16)
     assert eng_bf16.host_k.dtype == np.dtype(jnp.bfloat16)
+    # int8 tier default-on: host pools hold quantized pages regardless
+    # of the device dtype (halved relay bytes; identity pinned in
+    # tests/test_kv_offload.py)
+    eng_i8 = mk_engine(host_pages=8, num_pages=16)
+    assert eng_i8.ecfg.host_tier_int8 is True
+    assert eng_i8.host_k.dtype == np.dtype(np.int8)
 
 
 # ------------------------------------------------ pow2-padded extract/inject

@@ -123,7 +123,8 @@ def test_engine_offload_end_to_end(host_pages, run_async):
     ecfg = EngineConfig(page_size=4, num_pages=24, max_batch=4,
                         prefill_chunk=32, prefill_buckets=(32,),
                         batch_buckets=(4,), page_buckets=(16,),
-                        host_pages=host_pages, watermark_pages=2)
+                        host_pages=host_pages, watermark_pages=2,
+                        host_tier_int8=False)  # identity asserts lossless
     engine = JaxEngine(cfg, ecfg, seed=0)
 
     async def gen(prompt, n=8):
@@ -179,7 +180,8 @@ def test_engine_chunked_restore_token_identity(run_async):
                             prefill_chunk=32, prefill_buckets=(32,),
                             batch_buckets=(4,), page_buckets=(16,),
                             host_pages=64, watermark_pages=2,
-                            tier_restore_chunk=chunk)
+                            tier_restore_chunk=chunk,
+                            host_tier_int8=False)  # identity: lossless
         engine = JaxEngine(cfg, ecfg, seed=0)
 
         async def gen(prompt, n=8):
@@ -302,7 +304,8 @@ def test_mla_engine_host_tier_end_to_end(run_async):
     ecfg = EngineConfig(page_size=4, num_pages=24, max_batch=4,
                         prefill_chunk=32, prefill_buckets=(32,),
                         batch_buckets=(4,), page_buckets=(16,),
-                        host_pages=64, watermark_pages=2)
+                        host_pages=64, watermark_pages=2,
+                        host_tier_int8=False)  # identity asserts lossless
     engine = JaxEngine(cfg, ecfg, seed=0)
     assert engine.host_k.shape[2:] == engine.kv_k.shape[2:]
     assert engine.host_v.shape[2:] == engine.kv_v.shape[2:]

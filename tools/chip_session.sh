@@ -92,5 +92,17 @@ run_step hotpath 1800 --scenario hotpath --prof-sample 2 \
 python -m tools.cost_diff "$OUT/hotpath_legacy_full.json" \
     "$OUT/hotpath_full.json" > "$OUT/hotpath_cost_diff.txt" 2>&1 || true
 
+# 12. dynaheat cache A/B (ISSUE 17): the shared-prefix workload under
+#     HBM pool pressure with an int8 host tier, four arms per run
+#     (lru/serial control, cost-evict, overlap-restore, cost+overlap) —
+#     realized hit rate + TTFT p95 + restore_wait + evict fate split per
+#     arm, compile fence 0 everywhere. The fp16-tier run isolates what
+#     int8 page moves buy on the relay.
+run_step cache_ab 3600 --scenario shared --cache-ab --host-pages 4096 \
+    --report-out "$OUT/cache_ab_full.json"
+run_step cache_ab_fp16 3600 --scenario shared --cache-ab \
+    --host-pages 4096 --host-tier-fp16 \
+    --report-out "$OUT/cache_ab_fp16_full.json"
+
 echo "=== chip session complete; results in $OUT/ ==="
 grep -h . "$OUT"/*.json 2>/dev/null | head -20

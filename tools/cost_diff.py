@@ -23,9 +23,28 @@ from typing import Any, Dict, List, Optional
 HEADLINE_KEYS = (
     "itl_raw_chunk_p99_ms",
     "itl_p99_ms",
+    "ttft_p50_ms",
+    "ttft_p95_ms",
     "loop_lag_p99_ms",
     "output_tok_per_s",
     "post_warmup_compiles",
+)
+
+# dynaheat cache counter family (bench.py --scenario shared flat keys):
+# realized hit rates, the allocation prefix split, restore-pipeline cost,
+# and the eviction fate split — so a cache A/B quote is one command over
+# the two arms' --report-out files
+CACHE_KEYS = (
+    "prefix_hit_rate",
+    "hit_rate_windowed",
+    "device_hit_blocks",
+    "host_restored_blocks",
+    "fresh_blocks",
+    "restore_wait_ms",
+    "restore_batch_pages_mean",
+    "evict_offloaded_total",
+    "evict_dropped_total",
+    "host_evictions_total",
 )
 
 
@@ -71,34 +90,43 @@ def diff_reports(before: Dict[str, Any],
         row["samples_before"] = None if b is None else b.get("samples")
         row["samples_after"] = None if a is None else a.get("samples")
         buckets.append(row)
-    headline: Dict[str, Dict[str, Optional[float]]] = {}
     b_det, a_det = _detail(before), _detail(after)
-    for key in HEADLINE_KEYS:
-        bv, av = b_det.get(key), a_det.get(key)
-        if bv is None and av is None:
-            continue
-        headline[key] = {
-            "before": bv, "after": av,
-            "delta": (av - bv if isinstance(bv, (int, float))
-                      and isinstance(av, (int, float)) else None),
-        }
-    return {"buckets": buckets, "headline": headline}
+
+    def _scalar_family(keys) -> Dict[str, Dict[str, Optional[float]]]:
+        fam: Dict[str, Dict[str, Optional[float]]] = {}
+        for key in keys:
+            bv, av = b_det.get(key), a_det.get(key)
+            if bv is None and av is None:
+                continue
+            fam[key] = {
+                "before": bv, "after": av,
+                "delta": (av - bv if isinstance(bv, (int, float))
+                          and isinstance(av, (int, float)) else None),
+            }
+        return fam
+
+    return {"buckets": buckets,
+            "headline": _scalar_family(HEADLINE_KEYS),
+            "cache": _scalar_family(CACHE_KEYS)}
 
 
 def _fmt(v: Optional[float], unit: str = "") -> str:
     if v is None:
         return "—"
     if isinstance(v, float):
-        return f"{v:.1f}{unit}"
+        # sub-1 magnitudes are rates/ratios — one decimal would erase
+        # the whole signal (0.2433 → "0.2")
+        return (f"{v:.3f}{unit}" if abs(v) < 1 else f"{v:.1f}{unit}")
     return f"{v}{unit}"
 
 
 def format_table(diff: Dict[str, Any]) -> str:
     lines = []
-    head = (f"{'bucket':<28} {'dispatch_us':>24} {'Δdisp':>9} "
-            f"{'device_us':>22} {'Δdev':>9} {'samples':>9}")
-    lines.append(head)
-    lines.append("-" * len(head))
+    if diff["buckets"]:
+        head = (f"{'bucket':<28} {'dispatch_us':>24} {'Δdisp':>9} "
+                f"{'device_us':>22} {'Δdev':>9} {'samples':>9}")
+        lines.append(head)
+        lines.append("-" * len(head))
     for row in diff["buckets"]:
         disp = (f"{_fmt(row['dispatch_us_before']):>11} →"
                 f"{_fmt(row['dispatch_us_after']):>11}")
@@ -112,6 +140,15 @@ def format_table(diff: Dict[str, Any]) -> str:
     if diff["headline"]:
         lines.append("")
         for key, h in diff["headline"].items():
+            lines.append(f"{key:<24} {_fmt(h['before'])} → "
+                         f"{_fmt(h['after'])}"
+                         + (f"  (Δ {_fmt(h['delta'])})"
+                            if h["delta"] is not None else ""))
+    if diff.get("cache"):
+        lines.append("")
+        lines.append("cache (dynaheat)")
+        lines.append("-" * 16)
+        for key, h in diff["cache"].items():
             lines.append(f"{key:<24} {_fmt(h['before'])} → "
                          f"{_fmt(h['after'])}"
                          + (f"  (Δ {_fmt(h['delta'])})"
@@ -132,9 +169,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     with open(argv[1]) as f:
         after = json.load(f)
     diff = diff_reports(before, after)
-    if not diff["buckets"]:
-        print("no bucket cost table in either report "
-              "(run bench.py with --prof-sample N)", file=sys.stderr)
+    if not diff["buckets"] and not diff["cache"] and not diff["headline"]:
+        print("no bucket cost table, headline, or cache counters in "
+              "either report (run bench.py with --prof-sample N, or "
+              "--scenario shared for the cache family)", file=sys.stderr)
         return 1
     if as_json:
         print(json.dumps(diff, indent=2))
