@@ -314,6 +314,34 @@ class Sequence:
     # and every page-boundary publish extend it instead of re-hashing
     # the whole sequence
     hash_cache: Optional[ChainHashCache] = None
+    # dynahot DL022: the request's eos/stop id lists are immutable per
+    # sequence, so the per-token append path reads one cached frozenset
+    # membership instead of rebuilding `x or []` defaults every token
+    _stop_set: Optional[frozenset] = None
+    _dev_stop_count: int = -1
+
+    @property
+    def stop_set(self) -> frozenset:
+        s = self._stop_set
+        if s is None:
+            stop = self.req.stop
+            eos = () if stop.ignore_eos else (self.req.eos_token_ids or ())
+            s = frozenset(eos) | frozenset(stop.stop_token_ids or ())
+            self._stop_set = s
+        return s
+
+    @property
+    def dev_stop_count(self) -> int:
+        """Rows the full stop-id set would occupy in the device stop
+        table (list lengths, duplicates counted, matching the decode
+        window's eos-table seeding)."""
+        n = self._dev_stop_count
+        if n < 0:
+            stop = self.req.stop
+            n = 0 if stop.ignore_eos else len(self.req.eos_token_ids or ())
+            n += len(stop.stop_token_ids or ())
+            self._dev_stop_count = n
+        return n
 
     def max_new(self) -> int:
         mt = self.req.stop.max_tokens
@@ -546,6 +574,9 @@ class JaxEngine:
         self._wake = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
         self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
+        # thread id of the loop's thread, captured in start(): _emit's
+        # on/off-loop routing is one integer compare (no exception probe)
+        self._aio_loop_tid: Optional[int] = None
         self._stopped = False
         # dynarevive graceful drain: a draining engine refuses new work
         # (typed NoCapacity) while in-flight sequences run to completion
@@ -854,6 +885,7 @@ class JaxEngine:
     def start(self) -> None:
         if self._loop_task is None:
             self._aio_loop = asyncio.get_running_loop()
+            self._aio_loop_tid = threading.get_ident()
             # dynaprof: the serving loop gets a lag monitor + stall
             # watchdog for as long as an engine runs on it (refcounted;
             # stop() releases)
@@ -2146,11 +2178,7 @@ class JaxEngine:
         """True when the row's full stop-id set fit the on-device stop
         table, so the window's done flag / emitted count are authoritative
         and the host can bulk-append without per-token stop checks."""
-        n = 0
-        if not seq.req.stop.ignore_eos:
-            n += len(seq.req.eos_token_ids or [])
-        n += len(seq.req.stop.stop_token_ids or [])
-        return n <= self.ecfg.max_eos_ids
+        return seq.dev_stop_count <= self.ecfg.max_eos_ids
 
     def _append_row(self, seq: Sequence, row: np.ndarray, n: int,
                     dev_done: bool, aux, i: int) -> int:
@@ -2191,9 +2219,7 @@ class JaxEngine:
                                  chain=self._chain(seq))
         if dev_done:
             last = ids[-1]
-            hit = (not seq.req.stop.ignore_eos
-                   and last in seq.req.eos_token_ids) \
-                or last in (seq.req.stop.stop_token_ids or [])
+            hit = last in seq.stop_set
             self._terminate(seq, FINISH_EOS if hit else FINISH_LENGTH)
         elif (seq.generated >= seq.max_new()
               or len(seq.tokens) >= self.cap_tokens):
@@ -2286,9 +2312,11 @@ class JaxEngine:
         if row is None:
             V = self.cfg.vocab_size
             row = np.zeros(V, np.float32)
-            for t, v in (seq.req.sampling.logit_bias or {}).items():
-                if 0 <= int(t) < V:
-                    row[int(t)] = v
+            bias_map = seq.req.sampling.logit_bias
+            if bias_map:
+                for t, v in bias_map.items():
+                    if 0 <= int(t) < V:
+                        row[int(t)] = v
             seq._bias_row = row
         return row
 
@@ -2333,8 +2361,7 @@ class JaxEngine:
         seq.tokens.append(tok)
         seq.last_token = tok
         seq.generated += 1
-        eos = (not seq.req.stop.ignore_eos and tok in seq.req.eos_token_ids) \
-            or tok in (seq.req.stop.stop_token_ids or [])
+        eos = tok in seq.stop_set
         self._emit(seq, EngineOutput(
             token_ids=[tok], prompt_tokens=seq.num_prompt,
             logprobs=[lp[0]] if lp is not None else None,
@@ -2478,12 +2505,11 @@ class JaxEngine:
                 self.latency.observe("itl", (now - seq.last_emit_t) / n, n)
             seq.last_emit_t = now
         # steps run in the executor thread; asyncio.Queue is not thread-safe,
-        # so route puts through the loop
-        try:
-            running_loop = asyncio.get_running_loop()
-        except RuntimeError:
-            running_loop = None
-        if running_loop is self._aio_loop:
+        # so route puts through the loop. Thread-id compare instead of an
+        # asyncio.get_running_loop() probe: off-loop the probe RAISES
+        # RuntimeError per emission (= per token on the decode path)
+        tid = self._aio_loop_tid
+        if tid is None or threading.get_ident() == tid:
             seq.out.put_nowait(out)
         else:
             self._aio_loop.call_soon_threadsafe(seq.out.put_nowait, out)

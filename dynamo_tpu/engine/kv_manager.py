@@ -467,6 +467,7 @@ class PageManager:
         when it becomes reusable). Priority is GreedyDual: clock + 1 +
         hot-prefix hits."""
         gen = self._dev_gen.get(page, 0) + 1
+        # bounded-by: keys are page ids of the fixed-capacity device pool
         self._dev_gen[page] = gen
         self._evict_seq += 1
         pri = self._dev_clock + 1.0 + self._hits(self.pages[page].block_hash)
@@ -487,6 +488,7 @@ class PageManager:
         OrderedDict LRU→MRU victim order exactly; under ``cost`` it is
         the GreedyDual score."""
         gen = self._host_gen.get(slot, 0) + 1
+        # bounded-by: keys are slot ids of the fixed-capacity host pool
         self._host_gen[slot] = gen
         self._evict_seq += 1
         if self.evict_policy == "cost":
@@ -530,6 +532,7 @@ class PageManager:
                 if self._dev_gen.get(page) != gen or page not in self.reusable:
                     continue  # stale row (page was re-ref'd or re-pushed)
                 del self.reusable[page]
+                # bounded-by: keys are page ids of the fixed-capacity device pool
                 self._dev_gen[page] = gen + 1
                 self._dev_clock = max(self._dev_clock, pri)
                 return page

@@ -93,6 +93,7 @@ class EngineProfiler:
     def _record(self, kind: str, key: Tuple[int, ...], dispatch_s: float,
                 device_s: float, tokens: int) -> None:
         label = f"{kind}:" + "x".join(str(k) for k in key)
+        # bounded-by: labels are pow2-padded bucket shapes (fixed vocab)
         row = self.buckets.setdefault(label, {
             "samples": 0, "device_us": 0.0, "dispatch_us": 0.0,
             "tokens": 0})
@@ -104,6 +105,7 @@ class EngineProfiler:
         self.device_seconds_total += device_s
         self.dispatch_seconds_total += dispatch_s
         if self.timeline is not None:
+            # bounded-by: StepTimeline is a deque(maxlen=) ring
             self.timeline.add(
                 "prof_sample", bucket=label,
                 dispatch_us=round(dispatch_s * 1e6, 1),

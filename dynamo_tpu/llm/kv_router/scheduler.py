@@ -184,23 +184,26 @@ class KvScheduler:
             raise RuntimeError("all workers saturated")
         chosen = self.rng.choice(best)
         # per-decision record: every live candidate's capped overlap plus
-        # the pick (bounded ring; feeds predicted-vs-realized calibration)
+        # the pick (bounded ring; feeds predicted-vs-realized calibration).
+        # The chosen worker's capped overlap is read once (dynahot DL022:
+        # the same min(scores.get(...)) was resolved three more times
+        # below for the accounting and the hit-rate event).
+        scores = overlaps.scores
+        chosen_overlap = min(scores.get(chosen, 0), isl_blocks)
         self.decisions.append({
             "request_id": request_id,
             "chosen": chosen,
             "isl_blocks": isl_blocks,
-            "overlap_blocks": min(overlaps.scores.get(chosen, 0),
-                                  isl_blocks),
-            "candidates": {wid: min(overlaps.scores.get(wid, 0), isl_blocks)
+            "overlap_blocks": chosen_overlap,
+            "candidates": {wid: min(scores.get(wid, 0), isl_blocks)
                            for wid in self.workers},
         })
         # optimistic accounting until the next scrape
         w = self.workers[chosen]
         w.extra_requests += 1
-        w.extra_blocks += isl_blocks - min(overlaps.scores.get(chosen, 0),
-                                           isl_blocks)
+        w.extra_blocks += isl_blocks - chosen_overlap
         if self.on_hit_rate_event:
             self.on_hit_rate_event(KVHitRateEvent(
                 worker_id=chosen, isl_blocks=isl_blocks,
-                overlap_blocks=min(overlaps.scores.get(chosen, 0), isl_blocks)))
+                overlap_blocks=chosen_overlap))
         return chosen

@@ -226,6 +226,7 @@ class LatencyRecorder:
         self.hists: Dict[str, Dict[str, Histogram]] = {}
 
     def observe(self, metric: str, value: float, n: int = 1) -> None:
+        # bounded-by: keyed by role then metric, both fixed vocabularies
         per_role = self.hists.setdefault(self.role, {})
         h = per_role.get(metric)
         if h is None:

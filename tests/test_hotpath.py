@@ -334,3 +334,89 @@ def test_hotpath_scenario_cpu_smoke():
     assert detail["post_warmup_compiles"] == 0
     assert "itl_raw_chunk_p99_ms" in detail
     assert "loop_lag_p99_ms" in detail
+
+
+# ------------------------- dynahot DL022 fix regressions (ISSUE 18)
+
+
+def test_sequence_stop_set_cached_once():
+    """The per-token stop check reads ONE cached frozenset (built on
+    first access) instead of rebuilding `x or []` defaults per token —
+    later mutation of the request's lists must not change it (proves
+    the cache is actually hit, not rebuilt)."""
+    from dynamo_tpu.engine.jax_engine import Sequence
+
+    req = _req([1, 2, 3], mt=10, eos=(7,))
+    req.stop.stop_token_ids = [9]
+    seq = Sequence(req=req, context=Context(), out=asyncio.Queue(),
+                   tokens=[1, 2, 3], num_prompt=3)
+    first = seq.stop_set
+    assert first == frozenset({7, 9})
+    assert seq.dev_stop_count == 2
+    req.stop.stop_token_ids.append(11)   # post-hoc mutation: ignored
+    assert seq.stop_set is first
+    assert seq.dev_stop_count == 2
+
+
+def test_sequence_stop_set_respects_ignore_eos():
+    from dynamo_tpu.engine.jax_engine import Sequence
+
+    req = _req([1], mt=10, eos=(7,))
+    req.stop.ignore_eos = True
+    req.stop.stop_token_ids = [9]
+    seq = Sequence(req=req, context=Context(), out=asyncio.Queue(),
+                   tokens=[1], num_prompt=1)
+    assert seq.stop_set == frozenset({9})
+    assert seq.dev_stop_count == 1
+
+
+def test_emit_routes_by_thread_id_without_exception_probe():
+    """_emit's on/off-loop routing is one thread-id compare: on the
+    captured thread it puts directly; off it goes through
+    call_soon_threadsafe; with no captured tid (engine not started) it
+    puts directly — and no asyncio loop probe is involved at all."""
+    import threading
+    import types
+
+    from dynamo_tpu.engine.jax_engine import JaxEngine, Sequence
+
+    calls = []
+    fake_loop = types.SimpleNamespace(
+        call_soon_threadsafe=lambda fn, *a: calls.append(a))
+    q = asyncio.Queue()
+    seq = Sequence(req=_req([1]), context=Context(), out=q,
+                   tokens=[1], num_prompt=1)
+    eng = types.SimpleNamespace(
+        latency=types.SimpleNamespace(observe=lambda *a, **k: None),
+        _aio_loop=fake_loop, _aio_loop_tid=threading.get_ident())
+    out = EngineOutput(token_ids=[5], prompt_tokens=1)
+    JaxEngine._emit(eng, seq, out)          # on-thread: direct put
+    assert q.qsize() == 1 and not calls
+    eng._aio_loop_tid = threading.get_ident() + 1
+    JaxEngine._emit(eng, seq, out)          # off-thread: via the loop
+    assert q.qsize() == 1 and len(calls) == 1
+    eng._aio_loop_tid = None
+    JaxEngine._emit(eng, seq, out)          # pre-start: direct put
+    assert q.qsize() == 2 and len(calls) == 1
+
+
+def test_router_decision_overlap_consistent():
+    """KvScheduler.schedule reads the chosen worker's capped overlap
+    once: the decision record, the optimistic accounting, and the
+    hit-rate event must all carry the SAME value."""
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+
+    events = []
+    sched = KvScheduler(block_size=16, on_hit_rate_event=events.append)
+    sched.update_metrics({1: ForwardPassMetrics(
+        request_active_slots=0, request_total_slots=8,
+        kv_active_blocks=0, kv_total_blocks=100)})
+    chosen = sched.schedule(64, OverlapScores({1: 2}), request_id="r1")
+    assert chosen == 1
+    dec = sched.decisions[-1]
+    expect = min(2, (64 + 15) // 16)
+    assert dec["overlap_blocks"] == expect
+    assert events[-1].overlap_blocks == expect
+    assert sched.workers[1].extra_blocks == (64 + 15) // 16 - expect

@@ -1784,7 +1784,7 @@ def test_cli_all_entry():
     out = json.loads(proc.stdout)
     assert out["violations"] == []
     assert "rule_counts" in out
-    for p in ("per_file", "dynaflow", "dynarace", "dynajit"):
+    for p in ("per_file", "dynaflow", "dynarace", "dynajit", "dynahot"):
         assert out["passes"][p] >= 0
 
 
@@ -2454,3 +2454,288 @@ def test_dynaproto_deterministic_output():
     first = [v.render() for v in proto_pass(*mods)]
     second = [v.render() for v in proto_pass(*mods)]
     assert first and first == second
+
+
+# ---------------------------------------------- dynahot (DL022-DL024)
+
+from tools.dynalint import (HOT_FRAME_RE, HOT_ROOTS,  # noqa: E402
+                            analyze_hot, hot_regions)
+
+
+def hot_pass(*mods):
+    """Run the dynahot pass over fixture modules (path, src)."""
+    sources = [parse_module(src, path) for path, src in mods]
+    return analyze_hot(sources)
+
+
+def hot_codes(*mods):
+    return [v.code for v in hot_pass(*mods)]
+
+
+# engine-path module with a name-grammar hot root (`_step`): the
+# legacy DL005 grammar seeds dynahot scheduler-kind regions too
+DL022_BAD_DEFAULT = """
+class Eng:
+    def _step(self, reqs):
+        for r in reqs:
+            if r.tok in (self.cfg.stop.ids or []):
+                self.kill(r)
+"""
+
+DL022_GOOD_HOISTED = """
+class Eng:
+    def _step(self, reqs):
+        stop_ids = self.cfg.stop.ids
+        if not stop_ids:
+            return
+        for r in reqs:
+            if r.tok in stop_ids:
+                self.kill(r)
+"""
+
+DL022_BAD_COMPILE = """
+import re
+
+class Eng:
+    def _step(self, lines):
+        for ln in lines:
+            if re.compile("tok=(\\\\d+)").search(ln):
+                self.hit(ln)
+"""
+
+DL022_BAD_LOOP_PROBE = """
+import asyncio
+
+class Eng:
+    def _step(self, outs):
+        for o in outs:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                loop = None
+            self.put(loop, o)
+"""
+
+
+def test_dl022_fires_on_invariant_default_rebuild():
+    assert "DL022" in hot_codes(
+        ("dynamo_tpu/engine/toyeng.py", DL022_BAD_DEFAULT))
+
+
+def test_dl022_quiet_on_hoisted():
+    assert "DL022" not in hot_codes(
+        ("dynamo_tpu/engine/toyeng.py", DL022_GOOD_HOISTED))
+
+
+def test_dl022_fires_on_compile_in_loop():
+    assert "DL022" in hot_codes(
+        ("dynamo_tpu/engine/toyeng.py", DL022_BAD_COMPILE))
+
+
+def test_dl022_fires_on_exception_probe_loop():
+    out = hot_pass(("dynamo_tpu/engine/toyeng.py", DL022_BAD_LOOP_PROBE))
+    assert ["DL022"] == [v.code for v in out]
+    assert "get_running_loop" in out[0].message
+
+
+def test_dl022_quiet_off_hot_path():
+    # same body, module outside engine/ and no declared root: no region
+    assert hot_codes(("dynamo_tpu/util/toy.py", DL022_BAD_DEFAULT)) == []
+
+
+def test_dl022_suppression():
+    src = DL022_BAD_DEFAULT.replace(
+        "            if r.tok in",
+        "            # dynalint: disable=hot-loop-invariant-work\n"
+        "            if r.tok in")
+    assert "DL022" not in hot_codes(("dynamo_tpu/engine/toyeng.py", src))
+
+
+DL023_BAD_FSTRING = """
+class Eng:
+    def _step(self, reqs):
+        for r in reqs:
+            self.logger.debug(f"dispatch {r.id} pages={r.pages}")
+"""
+
+DL023_GOOD_LAZY = """
+class Eng:
+    def _step(self, reqs):
+        for r in reqs:
+            self.logger.debug("dispatch %s pages=%s", r.id, r.pages)
+"""
+
+DL023_GOOD_GUARDED = """
+import logging
+
+class Eng:
+    def _step(self, reqs):
+        for r in reqs:
+            if self.logger.isEnabledFor(logging.DEBUG):
+                self.logger.debug(f"dispatch {r.id} pages={r.pages}")
+"""
+
+
+def test_dl023_fires_on_eager_fstring_log():
+    out = hot_pass(("dynamo_tpu/engine/toyeng.py", DL023_BAD_FSTRING))
+    assert "DL023" in [v.code for v in out]
+
+
+def test_dl023_quiet_on_lazy_args_and_level_guard():
+    assert "DL023" not in hot_codes(
+        ("dynamo_tpu/engine/toyeng.py", DL023_GOOD_LAZY))
+    assert "DL023" not in hot_codes(
+        ("dynamo_tpu/engine/toyeng.py", DL023_GOOD_GUARDED))
+
+
+def test_dl023_suppression():
+    src = DL023_BAD_FSTRING.replace(
+        "self.logger.debug(",
+        "self.logger.debug(  # dynalint: disable=hot-eager-format\n"
+        "                ")
+    assert "DL023" not in hot_codes(("dynamo_tpu/engine/toyeng.py", src))
+
+
+DL024_BAD_APPEND = """
+class Eng:
+    def __init__(self):
+        self.history = []
+
+    def _step(self, reqs):
+        for r in reqs:
+            self.history.append(r.id)
+"""
+
+DL024_GOOD_RING = """
+from collections import deque
+
+class Eng:
+    def __init__(self):
+        self.history = deque(maxlen=256)
+
+    def _step(self, reqs):
+        for r in reqs:
+            self.history.append(r.id)
+"""
+
+DL024_GOOD_EVICTED = """
+class Eng:
+    def __init__(self):
+        self.history = []
+
+    def _step(self, reqs):
+        for r in reqs:
+            self.history.append(r.id)
+
+    def reap(self):
+        while len(self.history) > 256:
+            self.history.pop()
+"""
+
+
+def test_dl024_fires_on_unbounded_request_path_growth():
+    out = hot_pass(("dynamo_tpu/engine/toyeng.py", DL024_BAD_APPEND))
+    assert "DL024" in [v.code for v in out]
+    assert "history" in out[0].message
+
+
+def test_dl024_quiet_on_ring_and_eviction():
+    assert "DL024" not in hot_codes(
+        ("dynamo_tpu/engine/toyeng.py", DL024_GOOD_RING))
+    assert "DL024" not in hot_codes(
+        ("dynamo_tpu/engine/toyeng.py", DL024_GOOD_EVICTED))
+
+
+def test_dl024_bounded_by_comment():
+    src = DL024_BAD_APPEND.replace(
+        "self.history.append(r.id)",
+        "# bounded-by: reqs is capped by max_batch upstream\n"
+        "            self.history.append(r.id)")
+    assert "DL024" not in hot_codes(("dynamo_tpu/engine/toyeng.py", src))
+
+
+def test_dl024_suppression():
+    src = DL024_BAD_APPEND.replace(
+        "self.history.append(r.id)",
+        "self.history.append(r.id)  # dynalint: disable=unbounded-growth")
+    assert "DL024" not in hot_codes(("dynamo_tpu/engine/toyeng.py", src))
+
+
+def test_dl024_quiet_off_request_path():
+    # growth in a frame no hot root reaches: not DL024's business
+    src = DL024_BAD_APPEND.replace("def _step", "def admin_dump")
+    assert hot_codes(("dynamo_tpu/engine/toyeng.py", src)) == []
+
+
+# ------------------------------------------- dynahot region machinery
+
+
+def test_hot_frame_re_matches_legacy_hot_re():
+    """DL005 behavior pin: the registry-derived frame-name pattern is
+    EXACTLY the legacy analyzer HOT_RE grammar for ["step"]."""
+    import re as _re
+
+    legacy = _re.compile(r"(^|_)step($|_)")
+    corpus = ["_step", "step", "decode_step_fn", "stepper", "misstep",
+              "_stepper", "my_step", "step_once", "restep", "_loop",
+              "process_window", "generate", "schedule", "steps"]
+    for name in corpus:
+        assert bool(HOT_FRAME_RE.search(name)) == \
+            bool(legacy.search(name)), name
+
+
+def test_hot_regions_reach_declared_roots_with_loop_depth():
+    """Declared per_token roots seed regions; callees reached through a
+    loop accumulate depth."""
+    src = """
+class Backend:
+    def generate(self, req):
+        self.prep(req)
+        for tok in req:
+            self.relay(tok)
+
+    def prep(self, req):
+        pass
+
+    def relay(self, tok):
+        pass
+"""
+    sources = [parse_module(src, "dynamo_tpu/llm/backend.py")]
+    regions = hot_regions(CallGraph.build(sources), sources)
+    gen = regions["dynamo_tpu.llm.backend:Backend.generate"]
+    assert gen.kind == "per_token" and gen.depth == 0
+    assert regions["dynamo_tpu.llm.backend:Backend.prep"].depth == 0
+    assert regions["dynamo_tpu.llm.backend:Backend.relay"].depth == 1
+
+
+def test_hot_roots_registry_is_pure_literal():
+    """The registry must stay a declared literal (tooling and docs parse
+    it); every declared root names module:Class.method."""
+    for kind in ("scheduler", "per_token"):
+        for entry in HOT_ROOTS[kind]:
+            mod, qual = entry.split(":")
+            assert mod and "." in mod and "." in qual, entry
+    assert HOT_ROOTS["frame_name_segments"] == ["step"]
+
+
+def test_dynahot_deterministic_output():
+    mods = (("dynamo_tpu/engine/toyeng.py", DL022_BAD_DEFAULT),
+            ("dynamo_tpu/engine/toyeng2.py", DL024_BAD_APPEND),
+            ("dynamo_tpu/engine/toyeng3.py", DL023_BAD_FSTRING))
+    first = [v.render() for v in hot_pass(*mods)]
+    second = [v.render() for v in hot_pass(*mods)]
+    assert first and first == second
+
+
+def test_source_cache_keys_on_content_hash(tmp_path):
+    """Same mtime + same size but different bytes must MISS the parse
+    cache (the staleness bug the sha1 key fixes)."""
+    f = tmp_path / "mod.py"
+    f.write_text("x = 1\n")
+    st = os.stat(f)
+    a = load_source(str(f), "dynamo_tpu/fixture_cache.py")
+    f.write_text("y = 2\n")  # same byte length
+    os.utime(f, (st.st_atime, st.st_mtime))  # force identical mtime
+    b = load_source(str(f), "dynamo_tpu/fixture_cache.py")
+    assert a is not b
+    assert "y" in [n.targets[0].id for n in b.tree.body]

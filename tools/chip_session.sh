@@ -104,5 +104,20 @@ run_step cache_ab_fp16 3600 --scenario shared --cache-ab \
     --host-pages 4096 --host-tier-fp16 \
     --report-out "$OUT/cache_ab_fp16_full.json"
 
+# 13. dynahot measured-fixes re-quote (ISSUE 18): the hotpath and
+#     shared scenarios after the DL022 hot-loop-invariant fixes
+#     (cached Sequence stop sets, thread-id emit routing, hoisted
+#     router overlap). Chip numbers supersede the CPU cost_diff quoted
+#     in docs/static_analysis.md; compile fence must stay 0 and greedy
+#     token identity is pinned by tests/test_hotpath.py.
+run_step dynahot_hotpath 1800 --scenario hotpath --prof-sample 2 \
+    --report-out "$OUT/dynahot_hotpath_full.json"
+run_step dynahot_shared 2400 --scenario shared \
+    --report-out "$OUT/dynahot_shared_full.json"
+# diff against the step-11 optimized arm: isolates what the dynahot
+# fixes add on top of the dynaturbo overhaul
+python -m tools.cost_diff "$OUT/hotpath_full.json" \
+    "$OUT/dynahot_hotpath_full.json" > "$OUT/dynahot_cost_diff.txt" 2>&1 || true
+
 echo "=== chip session complete; results in $OUT/ ==="
 grep -h . "$OUT"/*.json 2>/dev/null | head -20

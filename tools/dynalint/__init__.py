@@ -20,10 +20,19 @@ serving invariant with a device-residency + shape-provenance dataflow
 pass: recompile hazards at jitted call sites plus warmup coverage
 (DL015), donation discipline (DL016), and implicit host transfers
 (DL017) — the static twin of the runtime compile fence in
-``dynamo_tpu/engine/jit_fence.py``.
+``dynamo_tpu/engine/jit_fence.py``. The **dynahot** layer (dynahot.py)
+computes hot regions by callgraph reachability from the declared
+``HOT_ROOTS`` registry (scheduler-iteration + per-token roots, with
+per-frame accumulated loop depth) and enforces no loop-invariant work
+re-done per iteration (DL022), no eager formatting into log/trace
+calls on hot frames (DL023), and no unbounded ``self.<attr>``
+collection growth on the request path (DL024, justified exceptions via
+``# bounded-by: <reason>``).
 
 Usage:
     python -m tools.dynalint --all          # every pass, one parse
+    python -m tools.dynalint --changed      # pre-commit: per-file rules
+                                            # on the git diff only
     python -m tools.dynalint [--baseline FILE] [--json] paths...
     python -m tools.dynalint --callgraph-dot graph.dot
     python -m tools.dynalint --wire-schemas docs/wire_schemas.md
@@ -42,6 +51,8 @@ from .baseline import apply_baseline, format_entry, load_baseline
 from .callgraph import DEFAULT_DL008_DEPTH, CallGraph, module_name
 from .dynaflow import (FrameSchema, analyze_project, analyze_tree,
                        load_wire_schemas)
+from .dynahot import (HOT_FRAME_RE, HOT_ROOTS, HotFrame, analyze_hot,
+                      hot_regions)
 from .dynajit import JitInfo, analyze_jit, collect_jits
 from .dynaproto import (ProtoSchema, analyze_protocols, collect_anchors,
                         load_protocols, protocols_to_dot)
@@ -51,12 +62,14 @@ from .modelcheck import check_models, check_protocol_models, explore
 
 __all__ = [
     "RULES", "CallGraph", "DEFAULT_DL008_DEPTH", "FrameSchema",
-    "JitInfo", "ModuleSource", "ProtoSchema", "RaceModel", "Violation",
-    "analyze_jit", "analyze_paths", "analyze_project", "analyze_protocols",
-    "analyze_races", "analyze_source", "analyze_tree", "apply_baseline",
-    "build_race_model", "check_models", "check_protocol_models",
-    "check_transitive_host_sync", "collect_anchors", "collect_jits",
-    "explore", "format_entry", "iter_py_files", "load_protocols",
-    "load_source", "load_sources", "load_wire_schemas", "load_baseline",
-    "module_name", "parse_module", "protocols_to_dot", "scan_modules",
+    "HOT_FRAME_RE", "HOT_ROOTS", "HotFrame", "JitInfo", "ModuleSource",
+    "ProtoSchema", "RaceModel", "Violation",
+    "analyze_hot", "analyze_jit", "analyze_paths", "analyze_project",
+    "analyze_protocols", "analyze_races", "analyze_source", "analyze_tree",
+    "apply_baseline", "build_race_model", "check_models",
+    "check_protocol_models", "check_transitive_host_sync",
+    "collect_anchors", "collect_jits", "explore", "format_entry",
+    "hot_regions", "iter_py_files", "load_protocols", "load_source",
+    "load_sources", "load_wire_schemas", "load_baseline", "module_name",
+    "parse_module", "protocols_to_dot", "scan_modules",
 ]

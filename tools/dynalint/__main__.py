@@ -7,12 +7,19 @@ Runs the per-file rules (DL001-DL007, DL011) AND the whole-program
 passes — dynaflow (DL008 call-graph blocking propagation, DL009/DL010
 wire-schema conformance), dynarace (DL012-DL014 concurrency rules +
 interprocedural DL005), dynajit (DL015-DL017 compilation-stability /
-device-residency rules + the warmup-coverage check) and dynaproto
+device-residency rules + the warmup-coverage check), dynaproto
 (DL019/DL020 lifecycle-protocol conformance + the explicit-state model
-checker over the declared machines, DL021 typed-error-swallow) — over
-one shared parse of the tree. ``--all`` is the CI spelling: the default
-tree, every pass; its ``--json`` carries a ``protocols`` block with the
-per-machine state-space counts the model checker explored.
+checker over the declared machines, DL021 typed-error-swallow) and
+dynahot (DL022-DL024 hot-path cost + unbounded-growth rules over the
+HOT_ROOTS reachability regions) — over one shared parse of the tree.
+``--all`` is the CI spelling: the default tree, every pass; its
+``--json`` carries a ``protocols`` block with the per-machine
+state-space counts the model checker explored.
+
+``--changed`` is the pre-commit spelling: per-file rules run only on
+files ``git diff --name-only HEAD`` touches, while the whole-program
+passes still see the full tree (a callgraph built from a diff would
+miss the cross-file edges that make them sound).
 
 Exit status: 0 when every violation is baselined (stale baseline
 entries still warn on stderr), 1 when new violations exist.
@@ -51,6 +58,30 @@ DEFAULT_BASELINE = os.path.join(
 DEFAULT_PATHS = ["dynamo_tpu", "bench.py", "tools"]
 
 
+def _git_changed_py(repo_root: str) -> list:
+    """Absolute paths of .py files `git diff --name-only HEAD` reports
+    (staged + unstaged). Deleted files drop out (no file to lint)."""
+    import subprocess
+
+    try:
+        raw = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30,
+            check=True).stdout
+    except Exception as e:  # not a git checkout / git missing
+        print(f"dynalint --changed: git diff failed ({e}); "
+              f"running per-file rules on the full tree", file=sys.stderr)
+        return None
+    out = []
+    for line in raw.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            ab = os.path.join(repo_root, line)
+            if os.path.exists(ab):
+                out.append(ab)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="dynalint",
@@ -61,6 +92,11 @@ def main(argv=None) -> int:
                     help="run every pass (per-file + dynaflow + dynarace) "
                          "over the default tree off one shared AST parse "
                          "cache — the CI entry point")
+    ap.add_argument("--changed", action="store_true",
+                    help="incremental mode: per-file rules only on files "
+                         "`git diff --name-only HEAD` reports; "
+                         "whole-program passes still run over the full "
+                         "tree (the pre-commit entry point)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="grandfathered-violations file "
                          "(default: tools/dynalint/baseline.txt)")
@@ -122,19 +158,30 @@ def main(argv=None) -> int:
         paths = args.paths or [os.path.join(REPO_ROOT, p)
                                for p in DEFAULT_PATHS]
 
+    per_file_paths = None
+    if args.changed:
+        per_file_paths = _git_changed_py(REPO_ROOT)
+        if per_file_paths is not None and not args.as_json:
+            print(f"--changed: per-file rules on {len(per_file_paths)} "
+                  f"file(s); whole-program passes on the full tree",
+                  file=sys.stderr)
+
     if args.callgraph_dot:
+        from .dynahot import hot_regions
         from .dynarace import analyze_races
 
         sources = load_sources(paths, root=REPO_ROOT)
         graph = CallGraph.build(sources)
         # concurrency coloring: roots bold orange, shared-state-touching
-        # functions double-bordered (see dynarace.build_race_model)
+        # functions double-bordered (see dynarace.build_race_model);
+        # dynahot regions shaded by accumulated loop depth
         model_out: dict = {}
         analyze_races(sources, graph=graph, model_out=model_out)
+        hot = hot_regions(graph, sources)
         with open(args.callgraph_dot, "w", encoding="utf-8") as f:
-            f.write(graph.to_dot(race=model_out.get("model")))
+            f.write(graph.to_dot(race=model_out.get("model"), hot=hot))
         print(f"wrote {args.callgraph_dot} "
-              f"({len(graph.functions)} functions)")
+              f"({len(graph.functions)} functions, {len(hot)} hot)")
         return 0
 
     if args.proto_dot:
@@ -156,7 +203,8 @@ def main(argv=None) -> int:
     violations = analyze_tree(paths, root=REPO_ROOT,
                               dl008_depth=args.dl008_depth,
                               timings=timings,
-                              proto_report=proto_report)
+                              proto_report=proto_report,
+                              per_file_paths=per_file_paths)
     wall = time.perf_counter() - t0
 
     if args.write_baseline:

@@ -9,6 +9,7 @@ rationale behind each rule and the cleanup it drove.
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 import re
 from dataclasses import dataclass
@@ -118,6 +119,25 @@ RULES: Dict[str, Tuple[str, str]] = {
               "swallows the typed guard errors (DeadlineExceeded, "
               "NoCapacity, NoRespondersError) that must reach the "
               "504/503 mappers — peel them off or re-raise"),
+    # DL022-DL024 are the dynahot hot-path cost rules (dynahot.py):
+    # hot regions come from callgraph reachability over the declared
+    # HOT_ROOTS registry with per-frame loop depth, so analyze_source
+    # never emits them — analyze_tree does.
+    "DL022": ("hot-loop-invariant-work",
+              "loop-invariant work re-done every iteration of a hot "
+              "loop (invariant-default rebuild, re.compile/struct/"
+              "constant-asarray in loop, sorted() of an invariant, "
+              "repeated deep attribute chains, exception-probe loop "
+              "discovery) — hoist or cache it once"),
+    "DL023": ("hot-eager-format",
+              "string formatted eagerly for a logging/trace call on a "
+              "hot frame with no level or sampling guard: the format "
+              "cost is paid per token even when the sink drops it"),
+    "DL024": ("unbounded-growth",
+              "self.<attr> collection grows on the request path with no "
+              "reachable removal, bound check, ring, or eviction — the "
+              "leak class that falls over under sustained churn; cap "
+              "it or justify with `# bounded-by: <reason>`"),
 }
 
 NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}
@@ -161,9 +181,22 @@ LONG_AWAIT_CALLS = frozenset({"asyncio.sleep", "asyncio.wait",
                               "asyncio.wait_for", "asyncio.gather"})
 LONG_AWAIT_ATTRS = frozenset({"wait", "acquire", "join"})
 
-# DL005: applies to functions matching HOT_RE in modules under engine/.
-HOT_RE = re.compile(r"(^|_)step($|_)")
+# DL005: applies to hot-NAMED functions in modules under engine/. The
+# name grammar is declared once in the dynahot HOT_ROOTS registry
+# ("frame_name_segments") and compiled there as HOT_FRAME_RE — imported
+# lazily (dynahot imports this module, so a top-level import would
+# cycle) and cached here. Identical to the legacy inline
+# `(^|_)step($|_)` regex; the equivalence is pinned by test.
 HOT_PATH_MARKERS = ("engine/",)
+_HOT_FRAME_RE_CACHE: Optional[re.Pattern] = None
+
+
+def hot_frame_re() -> re.Pattern:
+    global _HOT_FRAME_RE_CACHE
+    if _HOT_FRAME_RE_CACHE is None:
+        from .dynahot import HOT_FRAME_RE
+        _HOT_FRAME_RE_CACHE = HOT_FRAME_RE
+    return _HOT_FRAME_RE_CACHE
 HOST_SYNC_CALLS = frozenset({"jax.block_until_ready", "np.asarray",
                              "np.array", "numpy.asarray", "numpy.array"})
 # Deliberately-synchronous scheduler arms: the sync is the design (the
@@ -607,7 +640,7 @@ class _Analyzer(ast.NodeVisitor):
         for name, _ in reversed(self._funcs):
             if name == "<lambda>":
                 continue
-            if not HOT_RE.search(name):
+            if not hot_frame_re().search(name):
                 return False
             qual = ".".join(self._classes + [name])
             return qual not in HOT_SYNC_ALLOWLIST
@@ -734,9 +767,12 @@ class ModuleSource:
     suppressed: Dict[int, Set[str]]
 
 
-# abspath -> ((mtime_ns, size), ModuleSource); keyed on stat so edits
-# between runs in one process (tests, watch modes) are picked up.
-_SOURCE_CACHE: Dict[str, Tuple[Tuple[int, int], ModuleSource]] = {}
+# abspath -> (content_sha1, ModuleSource). Keyed on content hash, NOT
+# (mtime_ns, size): a same-size rewrite within one mtime granule (editor
+# save + re-save, test fixtures on coarse-mtime filesystems) left the
+# old stat key unchanged and served a stale tree. The file is already
+# being read into memory for the parse, so hashing it is ~free.
+_SOURCE_CACHE: Dict[str, Tuple[str, ModuleSource]] = {}
 
 
 def parse_module(src: str, path: str) -> ModuleSource:
@@ -749,13 +785,13 @@ def parse_module(src: str, path: str) -> ModuleSource:
 
 def load_source(abspath: str, rel: str) -> ModuleSource:
     """Parse (or fetch from the per-process cache) one module."""
-    st = os.stat(abspath)
-    key = (st.st_mtime_ns, st.st_size)
+    with open(abspath, "rb") as fh:
+        data = fh.read()
+    key = hashlib.sha1(data).hexdigest()
     hit = _SOURCE_CACHE.get(abspath)
     if hit is not None and hit[0] == key:
         return hit[1]
-    with open(abspath, encoding="utf-8") as fh:
-        src = fh.read()
+    src = data.decode("utf-8")
     rel = rel.replace(os.sep, "/")
     tree = ast.parse(src, filename=rel)
     _annotate_parents(tree)

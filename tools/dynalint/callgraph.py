@@ -71,6 +71,11 @@ class CallSite:
     col: int
     raw: str                      # callee as written ('self.foo', 'mod.fn')
     target: Optional[str] = None  # resolved function key, if any
+    # lexical loop nesting of the call site within its own function frame
+    # (0 = straight-line code). dynahot multiplies this into hot-region
+    # depth: a callee invoked from inside a per-token loop inherits that
+    # loop's iteration cost.
+    loop_depth: int = 0
 
 
 @dataclass
@@ -289,7 +294,8 @@ class _Collector(ast.NodeVisitor):
             fn = self._funcs[-1]
             d = dotted(node.func)
             if d is not None:
-                fn.calls.append(CallSite(node.lineno, node.col_offset, d))
+                fn.calls.append(CallSite(node.lineno, node.col_offset, d,
+                                         loop_depth=self._loops[-1]))
             what = None
             if d is not None and (d in BLOCKING_CALLS
                                   or d in BLOCKING_BUILTINS
@@ -507,20 +513,29 @@ class CallGraph:
     # ------------------------------------------------------------- export
 
     def to_dot(self, reach: Optional[Dict[str, BlockPath]] = None,
-               race=None) -> str:
+               race=None, hot: Optional[dict] = None) -> str:
         """Graphviz export of the project-resolved graph: async defs are
         filled blue, functions that (transitively) reach a blocking
         primitive get a red outline, direct blockers a bold red outline.
         With a dynarace ``RaceModel``, concurrency roots get a bold
         orange outline and shared-state-touching functions a double
-        border (peripheries=2)."""
+        border (peripheries=2). With a dynahot region map (key ->
+        HotFrame), hot frames are shaded amber — deeper accumulated
+        loop depth shades darker — and the label carries
+        ``hot d=<depth>``."""
         reach = reach if reach is not None else self.blocking_reachability()
+        # amber ramp by loop depth: straight-line hot body -> deep loops
+        hot_ramp = ("#fff4cc", "#ffe08a", "#ffc44d", "#ff9e2c")
         lines = ["digraph dynaflow {",
                  '  rankdir=LR; node [shape=box, fontsize=10];']
         for key, fi in sorted(self.functions.items()):
             attrs = []
+            hf = hot.get(key) if hot is not None else None
             if fi.is_async:
                 attrs.append('style=filled, fillcolor="#cfe8ff"')
+            elif hf is not None:
+                shade = hot_ramp[min(hf.depth, len(hot_ramp) - 1)]
+                attrs.append(f'style=filled, fillcolor="{shade}"')
             bp = reach.get(key)
             if bp is not None:
                 attrs.append('color=red' + (', penwidth=2'
@@ -531,6 +546,8 @@ class CallGraph:
                 if key in race.shared_funcs:
                     attrs.append('peripheries=2')
             label = key.replace(":", "\\n")
+            if hf is not None:
+                label += f"\\nhot d={hf.depth}"
             lines.append(f'  "{key}" [label="{label}"'
                          + (", " + ", ".join(attrs) if attrs else "") + "];")
         seen = set()

@@ -612,24 +612,34 @@ def analyze_project(sources: Sequence[ModuleSource],
 def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
                  dl008_depth: int = DEFAULT_DL008_DEPTH,
                  timings: Optional[dict] = None,
-                 proto_report: Optional[dict] = None) -> List[Violation]:
+                 proto_report: Optional[dict] = None,
+                 per_file_paths: Optional[Sequence[str]] = None
+                 ) -> List[Violation]:
     """Per-file rules + whole-program dynaflow rules + the dynarace
-    concurrency passes + the dynaproto lifecycle-protocol passes (and
-    their model checker) over one tree; the shared parse cache means
-    each file is read and parsed exactly once per run. Pass
+    concurrency passes + the dynajit / dynaproto / dynahot passes (and
+    the protocol model checker) over one tree; the shared parse cache
+    means each file is read and parsed exactly once per run. Pass
     ``timings={}`` to receive per-pass wall seconds (``per_file``/
-    ``dynaflow``/``dynarace``/``dynajit``/``dynaproto``/``modelcheck``)
-    and ``proto_report={}`` for the per-machine model-checker stats
-    (``--json``'s ``protocols`` block)."""
+    ``dynaflow``/``dynarace``/``dynajit``/``dynaproto``/``modelcheck``/
+    ``dynahot``) and ``proto_report={}`` for the per-machine
+    model-checker stats (``--json``'s ``protocols`` block).
+
+    ``per_file_paths`` (the ``--changed`` incremental mode) scopes the
+    PER-FILE rules to those files only; the whole-program passes always
+    see the full tree — a callgraph built from a diff would miss every
+    cross-file edge that makes them sound."""
     import time as _time
 
     from .analyzer import analyze_module
 
     t0 = _time.perf_counter()
     sources = load_sources(paths, root=root)
+    per_file_abs = (None if per_file_paths is None else
+                    {os.path.abspath(p) for p in per_file_paths})
     out: List[Violation] = []
     for ms in sources:
-        out.extend(analyze_module(ms))
+        if per_file_abs is None or ms.abspath in per_file_abs:
+            out.extend(analyze_module(ms))
     # unparseable files: analyze_paths-style DL000s come from the per-file
     # entry; load_sources skipped them, so re-walk for syntax errors
     import ast as _ast
@@ -641,6 +651,8 @@ def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
     for f in iter_py_files(paths):
         ab = os.path.abspath(f)
         if ab in loaded:
+            continue
+        if per_file_abs is not None and ab not in per_file_abs:
             continue
         rel = os.path.relpath(ab, root_abs) \
             if ab.startswith(root_abs + os.sep) else f
@@ -674,6 +686,10 @@ def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
 
     out.extend(check_protocol_models(sources, report_out=proto_report))
     t6 = _time.perf_counter()
+    from .dynahot import analyze_hot
+
+    out.extend(analyze_hot(sources, graph=graph))
+    t7 = _time.perf_counter()
     if timings is not None:
         timings["per_file"] = round(t1 - t0, 3)
         timings["dynaflow"] = round(t2 - t1, 3)
@@ -681,5 +697,6 @@ def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
         timings["dynajit"] = round(t4 - t3, 3)
         timings["dynaproto"] = round(t5 - t4, 3)
         timings["modelcheck"] = round(t6 - t5, 3)
+        timings["dynahot"] = round(t7 - t6, 3)
     out.sort(key=lambda v: (v.path, v.line, v.code))
     return out

@@ -1,0 +1,779 @@
+"""dynahot: static hot-path cost & unbounded-growth analysis.
+
+dynaturbo (PR 16) bought its decode tok/s by hand-profiling the per-token
+host work; nothing in DL001-DL021 stops the next PR from silently
+re-adding a per-token allocation, an eager f-string on the emit path, or
+an unbounded dict that leaks under millions-of-users churn. dynahot makes
+the hot path a machine-checked *cost* invariant over the shared PR 5/8
+parse + callgraph:
+
+- **HOT_ROOTS** below is the declared, pure-literal registry of hot-path
+  roots — it replaces the old name-regex heuristic (``HOT_RE`` in
+  analyzer.py, now derived here as ``HOT_FRAME_RE`` from the registry's
+  ``frame_name_segments`` grammar, behavior pinned by test).
+  *Scheduler-iteration* roots run once per engine step; *per-token*
+  roots run once per emitted token / stream chunk.
+- **Hot regions** are computed by callgraph reachability from the roots,
+  with per-frame loop depth: a callee invoked from inside a loop of a
+  hot frame inherits that loop's iteration count (``CallSite.loop_depth``
+  accumulates into ``HotFrame.depth``). ``self.<attr>.<method>()`` calls
+  resolve through one level of constructor typing (``self.pm =
+  PageManager(...)`` in ``__init__``) so the region follows the engine
+  into its collaborators instead of stopping at the attribute wall.
+
+Three rules run over the region (tier-1, EMPTY baseline):
+
+- **DL022 hot-loop-invariant-work** — loop-invariant rebuilds inside hot
+  loops: ``<chain> or []`` invariant-default rebuilds, ``re.compile`` /
+  ``struct.Struct`` / constant ``jnp.asarray`` in a loop, ``sorted()``
+  of a loop-invariant name, the same deep attribute chain resolved 3+
+  times in one frame, and exception-probe loop discovery
+  (``try: asyncio.get_running_loop() except RuntimeError``) per call.
+- **DL023 hot-eager-format** — eager f-string / %-format / ``str()``-of-
+  structure handed to a logging/trace call on a hot frame without a
+  sampling or level guard (same guard grammar as DL018).
+- **DL024 unbounded-growth** — a ``self.<attr>`` collection mutated via
+  ``append`` / ``[k]=`` / ``add`` from a hot (request-path) frame with
+  no reachable removal, bound check, ring (``deque(maxlen=...)``), or
+  reset anywhere in its class. Suppress with a justification comment:
+  ``# bounded-by: <reason>`` on the mutation line (or the line above).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .analyzer import (LOG_METHODS, RULES, ModuleSource, Violation,
+                       _is_sample_guard, call_attr, dotted)
+from .callgraph import CallGraph
+
+# --------------------------------------------------------------- registry
+
+# The declared hot-path root registry (pure literal — tooling and tests
+# read it with ast.literal_eval, serving code never imports it).
+#
+# - "scheduler": frames entered once per engine scheduler iteration.
+# - "per_token": frames entered once per emitted token / stream chunk /
+#   routed request — the tightest loops in the product.
+# - "frame_name_segments": the legacy name grammar DL005 was built on
+#   (analyzer.py's old HOT_RE): any engine function with one of these
+#   segments in its snake_case name is a hot frame by name. Kept so
+#   DL005's per-file + interprocedural behavior is EXACTLY what it was
+#   (pinned by test_hot_frame_re_matches_legacy_hot_re).
+HOT_ROOTS = {
+    "scheduler": [
+        "dynamo_tpu.engine.jax_engine:JaxEngine._step",
+        "dynamo_tpu.engine.jax_engine:JaxEngine._loop",
+        "dynamo_tpu.engine.jax_engine:JaxEngine._process_window",
+        "dynamo_tpu.engine.jax_engine:JaxEngine._emit",
+    ],
+    "per_token": [
+        "dynamo_tpu.llm.backend:Backend.generate",
+        "dynamo_tpu.llm.processor:Processor._chat",
+        "dynamo_tpu.llm.processor:Processor._completion",
+        "dynamo_tpu.llm.kv_router.scheduler:KvScheduler.schedule",
+    ],
+    "frame_name_segments": ["step"],
+}
+
+# Derived from the registry grammar; byte-identical to the legacy
+# analyzer.HOT_RE for ["step"]. Engine functions matching this are hot
+# frames by name (DL005 origins AND dynahot scheduler-kind roots).
+HOT_FRAME_RE = re.compile(
+    "(^|_)(?:" + "|".join(re.escape(s)
+                          for s in HOT_ROOTS["frame_name_segments"])
+    + ")($|_)")
+
+# hot-by-name roots only apply under these path markers (mirrors the
+# legacy DL005 scoping: engine modules)
+HOT_NAME_PATH_MARKERS = ("engine/",)
+
+# hot-region propagation: loop depth saturates here (recursion guard —
+# depth 3+ already means "at least thousands of iterations per step")
+DEPTH_CAP = 8
+
+# DL022: array-materialization callables whose constant-arg form inside
+# a loop rebuilds the same device constant every iteration
+_CONST_ARRAY_CALLS = frozenset({
+    "jnp.asarray", "jnp.array", "np.asarray", "np.array",
+    "numpy.asarray", "numpy.array", "jax.numpy.asarray",
+    "jax.numpy.array",
+})
+
+# DL022: always-invariant compile-style constructors
+_COMPILE_CALLS = frozenset({"re.compile", "struct.Struct"})
+
+# DL023: receivers that make an Attribute call a logging/trace call
+_LOG_RECV_RE = re.compile(r"(?i)(^|\.)(log|logger|logging|trace|tracer)$")
+# DL023: level/guard spellings accepted in an enclosing `if` (superset of
+# DL018's SAMPLE_GUARD_RE via _is_sample_guard, plus level checks)
+_LEVEL_GUARD_RE = re.compile(r"(?i)(level|debug|verbose|trace)")
+
+# DL024: in-place growth / shrink method names on self.<attr> receivers
+_GROW_ATTRS = frozenset({"append", "appendleft", "add", "extend",
+                         "setdefault"})
+_SHRINK_ATTRS = frozenset({"pop", "popitem", "popleft", "remove",
+                           "discard", "clear", "move_to_end"})
+
+_BOUNDED_BY_RE = re.compile(r"#\s*bounded-by:\s*(\S.*)")
+
+_DL022_TAGS = frozenset({"DL022", "hot-loop-invariant-work", "all"})
+_DL023_TAGS = frozenset({"DL023", "hot-eager-format", "all"})
+_DL024_TAGS = frozenset({"DL024", "unbounded-growth", "all"})
+
+
+@dataclass
+class HotFrame:
+    """One function in the hot region."""
+
+    key: str          # '<module>:<qualname>'
+    kind: str         # 'scheduler' | 'per_token'
+    depth: int        # accumulated loop depth from the root (0 = root
+    #                   body straight-line; each enclosing hot loop +1)
+    root: str         # the root key this frame was reached from
+
+
+# ------------------------------------------------------- region computation
+
+class _InitTyper(ast.NodeVisitor):
+    """Collects ``self.<attr> = <Ctor>(...)`` from one class body."""
+
+    def __init__(self) -> None:
+        self.types: Dict[str, str] = {}   # attr -> raw ctor dotted name
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            raw = dotted(node.value.func)
+            if raw is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        self.types[t.attr] = raw
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if isinstance(node.value, ast.Call) and \
+                isinstance(node.target, ast.Attribute) and \
+                isinstance(node.target.value, ast.Name) and \
+                node.target.value.id == "self":
+            raw = dotted(node.value.func)
+            if raw is not None:
+                self.types[node.target.attr] = raw
+        self.generic_visit(node)
+
+
+def _attr_types(sources: Sequence[ModuleSource], graph: CallGraph
+                ) -> Dict[Tuple[str, str, str], str]:
+    """(module, class, attr) -> resolved class key 'mod.Class' for
+    constructor-typed instance attributes (``__init__`` assignments)."""
+    from .callgraph import module_name
+    out: Dict[Tuple[str, str, str], str] = {}
+    for ms in sources:
+        mod = graph.modules.get(module_name(ms.path))
+        if mod is None:
+            continue
+        for node in ast.walk(ms.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and sub.name == "__init__":
+                    typer = _InitTyper()
+                    typer.visit(sub)
+                    for attr, raw in typer.types.items():
+                        m, c = graph._resolve_class(mod, raw)
+                        if m is not None:
+                            out[(mod.name, node.name, attr)] = \
+                                f"{m.name}.{c}"
+    return out
+
+
+def hot_regions(graph: CallGraph,
+                sources: Optional[Sequence[ModuleSource]] = None
+                ) -> Dict[str, HotFrame]:
+    """Hot frames by callgraph reachability from HOT_ROOTS, with
+    accumulated per-frame loop depth. Deterministic: sorted worklist,
+    monotone depth updates capped at DEPTH_CAP."""
+    attr_types = (_attr_types(sources, graph) if sources is not None
+                  else {})
+    frames: Dict[str, HotFrame] = {}
+    roots: List[Tuple[str, str]] = []
+    for kind in ("scheduler", "per_token"):
+        for key in HOT_ROOTS[kind]:
+            if key in graph.functions:
+                roots.append((key, kind))
+    # legacy name-grammar roots: engine functions with a hot name segment
+    for key, fi in sorted(graph.functions.items()):
+        norm = fi.path.replace("\\", "/")
+        if any(m in norm for m in HOT_NAME_PATH_MARKERS) \
+                and HOT_FRAME_RE.search(fi.name):
+            roots.append((key, "scheduler"))
+    for key, kind in sorted(roots):
+        cur = frames.get(key)
+        if cur is None or (kind == "per_token"
+                           and cur.kind == "scheduler"):
+            frames[key] = HotFrame(key, kind, 0, key)
+
+    def _resolve_self_attr(fi, raw: str) -> Optional[str]:
+        parts = raw.split(".")
+        if len(parts) != 3 or parts[0] not in ("self", "cls"):
+            return None
+        cls_name = fi.qualname.split(".")[0]
+        cls_key = attr_types.get((fi.module, cls_name, parts[1]))
+        if cls_key is None:
+            return None
+        tmod, tcls = cls_key.rsplit(".", 1)
+        m = graph.modules.get(tmod)
+        if m is None:
+            return None
+        return graph._resolve_method(m, tcls, parts[2])
+
+    changed = True
+    while changed:
+        changed = False
+        for key in sorted(frames):
+            hf = frames[key]
+            fi = graph.functions.get(key)
+            if fi is None:
+                continue
+            for cs in fi.calls:
+                target = cs.target or _resolve_self_attr(fi, cs.raw)
+                if target is None or target not in graph.functions:
+                    continue
+                depth = min(hf.depth + cs.loop_depth, DEPTH_CAP)
+                cur = frames.get(target)
+                if cur is None or depth > cur.depth or \
+                        (hf.kind == "per_token"
+                         and cur.kind == "scheduler"
+                         and depth >= cur.depth):
+                    frames[target] = HotFrame(target, hf.kind, depth,
+                                              hf.root)
+                    changed = True
+    return frames
+
+
+# ------------------------------------------------------------ DL022/DL023
+
+def _chain_text(node: ast.AST) -> Optional[str]:
+    """Dotted text of a pure Name/Attribute chain, else None."""
+    return dotted(node)
+
+
+def _chain_dots(text: str) -> int:
+    return text.count(".")
+
+
+def _is_empty_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)) and not node.elts:
+        return True
+    if isinstance(node, ast.Dict) and not node.keys:
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("list", "tuple", "dict", "set",
+                                 "frozenset") and not node.args:
+        return True
+    return False
+
+
+def _assigned_names(node: ast.AST) -> Set[str]:
+    """Names bound anywhere inside ``node`` (loop targets, assigns,
+    with-as, comprehension targets)."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                    (ast.Store, ast.Del)):
+            out.add(sub.id)
+    return out
+
+
+def _eager_format_arg(node: ast.AST) -> Optional[str]:
+    """Display string when ``node`` is an eagerly-formatted value."""
+    if isinstance(node, ast.JoinedStr):
+        return "f-string"
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod) and \
+            isinstance(node.left, (ast.Constant, ast.JoinedStr)):
+        return "%-format"
+    if isinstance(node, ast.Call):
+        if call_attr(node) == "format":
+            return "str.format"
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in ("str", "repr") and node.args and \
+                not isinstance(node.args[0], ast.Constant):
+            return f"{node.func.id}() of a structure"
+    return None
+
+
+def _is_level_guard(test: ast.AST) -> bool:
+    if _is_sample_guard(test):
+        return True
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call) and \
+                call_attr(sub) == "isEnabledFor":
+            return True
+        if isinstance(sub, ast.Name) and _LEVEL_GUARD_RE.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and \
+                _LEVEL_GUARD_RE.search(sub.attr):
+            return True
+    return False
+
+
+class _FrameChecker(ast.NodeVisitor):
+    """DL022/DL023 over ONE hot frame's body (nested defs excluded —
+    they are their own frames when the region reaches them)."""
+
+    def __init__(self, ms: ModuleSource, frame: HotFrame, qualname: str,
+                 func_node: ast.AST, out: List[Violation]):
+        self.ms = ms
+        self.frame = frame
+        self.qualname = qualname
+        self.func_node = func_node
+        self.out = out
+        self._loops: List[ast.AST] = []
+        self._guards = 0
+        # full-frame repeated-chain census: text -> [nodes]
+        self._chains: Dict[str, List[ast.AST]] = {}
+        # names bound by any loop/comprehension in the frame: chains on
+        # these bases are per-element reads, not invariant resolution
+        self._iter_names: Set[str] = set()
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _suppressed(self, line: int, tags: frozenset) -> bool:
+        for probe in (line, line - 1):
+            have = self.ms.suppressed.get(probe)
+            if have and have & tags:
+                return True
+        return False
+
+    def _emit(self, node: ast.AST, code: str, tags: frozenset,
+              detail: str) -> None:
+        if self._suppressed(node.lineno, tags):
+            return
+        name, summary = RULES[code]
+        self.out.append(Violation(
+            self.ms.path, node.lineno, getattr(node, "col_offset", 0),
+            code, name, f"{summary}: {detail}", self.qualname))
+
+    def _in_loop(self) -> bool:
+        return bool(self._loops) or self.frame.depth >= 1
+
+    def _loop_assigned(self) -> Set[str]:
+        return _assigned_names(self._loops[-1]) if self._loops else set()
+
+    # -- scoping ----------------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is not self.func_node:
+            return  # nested def: its own frame if hot
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _visit_loop(self, node) -> None:
+        self._loops.append(node)
+        self._iter_names |= _assigned_names(node)
+        self.generic_visit(node)
+        self._loops.pop()
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+    visit_ListComp = _visit_loop
+    visit_SetComp = _visit_loop
+    visit_DictComp = _visit_loop
+    visit_GeneratorExp = _visit_loop
+
+    def visit_If(self, node: ast.If) -> None:
+        guarded = _is_level_guard(node.test)
+        self.visit(node.test)
+        if guarded:
+            self._guards += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if guarded:
+            self._guards -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    # -- DL022 patterns ---------------------------------------------------
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        # `<invariant chain> or []`: rebuilds the default and re-resolves
+        # the chain once per iteration — cache it on the object instead
+        if isinstance(node.op, ast.Or) and self._in_loop() and \
+                len(node.values) == 2 and \
+                _is_empty_literal(node.values[1]):
+            text = _chain_text(node.values[0])
+            if text and _chain_dots(text) >= 2 and \
+                    text.split(".")[0] not in self._loop_assigned():
+                self._emit(node, "DL022", _DL022_TAGS,
+                           f"`{text} or {ast.unparse(node.values[1])}` "
+                           f"re-evaluated every iteration — hoist or "
+                           f"cache the invariant default")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = dotted(node.func)
+        in_local_loop = bool(self._loops)
+        if in_local_loop and d in _COMPILE_CALLS:
+            self._emit(node, "DL022", _DL022_TAGS,
+                       f"`{d}(...)` inside a hot loop — compile once at "
+                       f"module scope")
+        if in_local_loop and d in _CONST_ARRAY_CALLS and node.args and \
+                all(isinstance(a, ast.Constant) for a in node.args):
+            self._emit(node, "DL022", _DL022_TAGS,
+                       f"`{d}` of constants inside a hot loop "
+                       f"materializes the same array every iteration")
+        if in_local_loop and isinstance(node.func, ast.Name) and \
+                node.func.id == "sorted" and len(node.args) == 1 and \
+                isinstance(node.args[0], ast.Name) and \
+                node.args[0].id not in self._loop_assigned():
+            self._emit(node, "DL022", _DL022_TAGS,
+                       f"`sorted({node.args[0].id})` of a loop-invariant "
+                       f"value inside a hot loop")
+        # DL023: eager formatting into a log/trace call on a hot frame
+        if self._guards == 0 and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in LOG_METHODS:
+            recv = dotted(node.func.value)
+            if recv is not None and _LOG_RECV_RE.search(recv):
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    what = _eager_format_arg(arg)
+                    if what is not None:
+                        self._emit(
+                            node, "DL023", _DL023_TAGS,
+                            f"{what} built eagerly for "
+                            f"`{recv}.{node.func.attr}(...)` on a hot "
+                            f"frame — use lazy %-args or guard on "
+                            f"level/sampling")
+                        break
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        # exception-probe loop discovery: try/except RuntimeError around
+        # asyncio.get_running_loop() raises once per call off-loop —
+        # per token on the emit path. Cache the loop/thread identity.
+        if self._in_loop():
+            probes = [sub for stmt in node.body
+                      for sub in ast.walk(stmt)
+                      if isinstance(sub, ast.Call)
+                      and dotted(sub.func) == "asyncio.get_running_loop"]
+            catches_rt = any(
+                h.type is not None and isinstance(h.type, ast.Name)
+                and h.type.id == "RuntimeError" for h in node.handlers)
+            if probes and catches_rt:
+                self._emit(node, "DL022", _DL022_TAGS,
+                           "`asyncio.get_running_loop()` probed under "
+                           "`except RuntimeError` per iteration — an "
+                           "exception is raised on every off-loop call; "
+                           "cache the loop/thread identity once")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # full-frame census of deep invariant chains (resolved at finish)
+        if isinstance(node.ctx, ast.Load):
+            parent = getattr(node, "_dl_parent", None)
+            if not isinstance(parent, ast.Attribute):
+                text = _chain_text(node)
+                if text and _chain_dots(text) >= 2:
+                    self._chains.setdefault(text, []).append(node)
+        self.generic_visit(node)
+
+    def finish(self) -> None:
+        for text, nodes in sorted(self._chains.items()):
+            base = text.split(".")[0]
+            if len(nodes) < 3 or base in ("self", "cls") or \
+                    base in self._iter_names:
+                continue
+            node = nodes[2]
+            self._emit(node, "DL022", _DL022_TAGS,
+                       f"attribute chain `{text}` resolved "
+                       f"{len(nodes)}x in one hot frame — bind it to a "
+                       f"local once")
+
+
+# ------------------------------------------------------------------ DL024
+
+class _GrowScan(ast.NodeVisitor):
+    """One class body: growth sites, shrink/bound/reset evidence."""
+
+    def __init__(self) -> None:
+        self.grows: List[Tuple[str, str, ast.AST]] = []  # (attr, how, node)
+        self.evidence: Dict[str, str] = {}  # attr -> why it is bounded
+        self._func: List[str] = []
+
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return node.attr
+        return None
+
+    def visit_FunctionDef(self, node) -> None:
+        self._func.append(node.name)
+        self.generic_visit(node)
+        self._func.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _fn(self) -> str:
+        return self._func[-1] if self._func else "<class>"
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            attr = self._self_attr(node.func.value)
+            if attr is not None:
+                if node.func.attr in _GROW_ATTRS:
+                    self.grows.append((attr, f".{node.func.attr}()", node))
+                elif node.func.attr in _SHRINK_ATTRS:
+                    self.evidence.setdefault(
+                        attr, f"`.{node.func.attr}()` in `{self._fn()}`")
+        # len(self.X) anywhere = a bound is being checked/maintained
+        if isinstance(node.func, ast.Name) and node.func.id == "len" \
+                and node.args:
+            attr = self._self_attr(node.args[0])
+            if attr is not None:
+                parent = getattr(node, "_dl_parent", None)
+                if isinstance(parent, ast.Compare):
+                    self.evidence.setdefault(
+                        attr, f"`len(self.{attr})` bound check in "
+                              f"`{self._fn()}`")
+        # deque(maxlen=...) / bounded-ring constructor
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                attr = self._self_attr(el)
+                if attr is not None:
+                    if self._fn() == "__init__":
+                        if self._bounded_ctor(node.value, t, el):
+                            self.evidence.setdefault(
+                                attr, "`deque(maxlen=...)` ring")
+                    else:
+                        # reset/swap outside __init__ empties the
+                        # collection on some path
+                        self.evidence.setdefault(
+                            attr, f"reassigned in `{self._fn()}`")
+                sub = el if isinstance(el, ast.Subscript) else None
+                if sub is not None:
+                    a = self._self_attr(sub.value)
+                    if a is not None:
+                        idx = sub.slice
+                        if isinstance(idx, ast.Slice):
+                            self.evidence.setdefault(
+                                a, f"slice-assign truncation in "
+                                   f"`{self._fn()}`")
+                        elif isinstance(idx, ast.Tuple) and \
+                                any(isinstance(e, ast.Slice)
+                                    for e in idx.elts):
+                            # ndarray-style `self.buf[:, slots] = ...`:
+                            # in-place write into a preallocated region,
+                            # not growth
+                            pass
+                        else:
+                            self.grows.append((a, "[k]=", node))
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        # `self._q: Optional[deque] = deque(maxlen=N) if cap else None`
+        attr = self._self_attr(node.target)
+        if attr is not None and node.value is not None:
+            if self._fn() == "__init__":
+                if self._bounded_ctor(node.value, node.target,
+                                      node.target):
+                    self.evidence.setdefault(
+                        attr, "`deque(maxlen=...)` ring")
+            else:
+                self.evidence.setdefault(
+                    attr, f"reassigned in `{self._fn()}`")
+        self.generic_visit(node)
+
+    def _bounded_ctor(self, value: ast.AST, target: ast.AST,
+                      el: ast.AST) -> bool:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                d = dotted(sub.func)
+                tail = d.rsplit(".", 1)[-1] if d else None
+                if tail == "deque" and any(kw.arg == "maxlen"
+                                           for kw in sub.keywords):
+                    return True
+        return False
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            attr = self._self_attr(
+                t.value if isinstance(t, ast.Subscript) else t)
+            if attr is not None:
+                self.evidence.setdefault(
+                    attr, f"`del` in `{self._fn()}`")
+        self.generic_visit(node)
+
+
+def _class_fields_bounded(cls_node: ast.ClassDef) -> Dict[str, str]:
+    """Dataclass-style class-level fields built as bounded rings:
+    ``decisions: deque = field(default_factory=lambda: deque(maxlen=N))``."""
+    out: Dict[str, str] = {}
+    for stmt in cls_node.body:
+        target = None
+        value = None
+        if isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name):
+            target, value = stmt.target.id, stmt.value
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            target, value = stmt.targets[0].id, stmt.value
+        if target is None or value is None:
+            continue
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                d = dotted(sub.func)
+                tail = d.rsplit(".", 1)[-1] if d else None
+                if tail == "deque" and any(kw.arg == "maxlen"
+                                           for kw in sub.keywords):
+                    out[target] = "`deque(maxlen=...)` ring"
+    return out
+
+
+def _bounded_by(ms: ModuleSource, line: int) -> Optional[str]:
+    lines = ms.src.splitlines()
+    for probe in (line, line - 1):
+        if 1 <= probe <= len(lines):
+            m = _BOUNDED_BY_RE.search(lines[probe - 1])
+            if m:
+                return m.group(1).strip()
+    return None
+
+
+# ------------------------------------------------------------------ driver
+
+def analyze_hot(sources: Sequence[ModuleSource],
+                graph: Optional[CallGraph] = None,
+                regions_out: Optional[dict] = None) -> List[Violation]:
+    """The dynahot pass: hot regions from HOT_ROOTS + DL022/023/024."""
+    if graph is None:
+        graph = CallGraph.build(sources)
+    frames = hot_regions(graph, sources)
+    if regions_out is not None:
+        regions_out["frames"] = frames
+    out: List[Violation] = []
+    by_mod: Dict[str, ModuleSource] = {}
+    from .callgraph import module_name
+    for ms in sources:
+        by_mod[module_name(ms.path)] = ms
+
+    # DL022/DL023: walk each hot frame's def once
+    frames_by_mod: Dict[str, Dict[str, HotFrame]] = {}
+    for key, hf in frames.items():
+        mod, qual = key.split(":", 1)
+        frames_by_mod.setdefault(mod, {})[qual] = hf
+    for mod_name_, want in sorted(frames_by_mod.items()):
+        ms = by_mod.get(mod_name_)
+        if ms is None:
+            continue
+        for qual, func_node in _iter_funcs(ms.tree):
+            hf = want.get(qual)
+            if hf is None:
+                continue
+            checker = _FrameChecker(ms, hf, qual, func_node, out)
+            checker.visit(func_node)
+            checker.finish()
+
+    # DL024: class-wide growth-vs-evidence, growth sites restricted to
+    # hot (request-path) frames
+    hot_quals: Dict[Tuple[str, str], HotFrame] = {}
+    for key, hf in frames.items():
+        mod, qual = key.split(":", 1)
+        hot_quals[(mod, qual)] = hf
+    name24, summary24 = RULES["DL024"]
+    attr_types = _attr_types(sources, graph)
+    for ms in sources:
+        mod_name_ = module_name(ms.path)
+        for cls_node in [n for n in ast.walk(ms.tree)
+                         if isinstance(n, ast.ClassDef)]:
+            scan = _GrowScan()
+            for stmt in cls_node.body:
+                scan.visit(stmt)
+            evidence = dict(_class_fields_bounded(cls_node))
+            evidence.update(scan.evidence)
+            # qualname prefix for methods of this (top-level) class
+            for attr, how, node in scan.grows:
+                if attr in evidence:
+                    continue
+                # `.m()` on a constructor-typed attribute whose class
+                # defines `m` is a delegated method call (the callee
+                # class gets its own scan), not builtin-collection growth
+                if how.startswith("."):
+                    meth = how[1:-2]
+                    ctor_key = attr_types.get(
+                        (mod_name_, cls_node.name, attr))
+                    if ctor_key is not None:
+                        cmod, ccls = ctor_key.rsplit(".", 1)
+                        ci = graph.modules.get(cmod)
+                        if ci is not None and ccls in ci.classes and \
+                                meth in ci.classes[ccls].methods:
+                            continue
+                # which function is this site in?
+                qual = _enclosing_qual(node)
+                if qual is None:
+                    continue
+                hf = hot_quals.get((mod_name_, qual))
+                if hf is None:
+                    continue
+                if _bounded_by(ms, node.lineno):
+                    continue
+                suppressed = False
+                for probe in (node.lineno, node.lineno - 1):
+                    tags = ms.suppressed.get(probe)
+                    if tags and tags & _DL024_TAGS:
+                        suppressed = True
+                if suppressed:
+                    continue
+                out.append(Violation(
+                    ms.path, node.lineno,
+                    getattr(node, "col_offset", 0), "DL024", name24,
+                    f"{summary24}: `self.{attr}{how}` grows on the "
+                    f"request path (hot via {hf.root.split(':', 1)[1]}) "
+                    f"with no removal/bound/ring in class "
+                    f"`{cls_node.name}` — evict, cap, or justify with "
+                    f"`# bounded-by: <reason>`", qual))
+    out.sort(key=lambda v: (v.path, v.line, v.code))
+    return out
+
+
+def _iter_funcs(tree: ast.AST):
+    """Yield (qualname, func_node) for every def, with class/function
+    nesting in the qualname (matches callgraph._Collector)."""
+
+    def rec(node: ast.AST, stack: List[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                yield qual, child
+                yield from rec(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, stack + [child.name])
+            else:
+                yield from rec(child, stack)
+
+    yield from rec(tree, [])
+
+
+def _enclosing_qual(node: ast.AST) -> Optional[str]:
+    """Qualname of the function a node sits in (via _dl_parent chain)."""
+    parts: List[str] = []
+    cur = getattr(node, "_dl_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = getattr(cur, "_dl_parent", None)
+    if not parts:
+        return None
+    return ".".join(reversed(parts))

@@ -69,9 +69,10 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from .analyzer import (HOT_RE, HOT_SYNC_ALLOWLIST, RULES, ModuleSource,
+from .analyzer import (HOT_SYNC_ALLOWLIST, RULES, ModuleSource,
                        Violation, call_attr, dotted)
 from .callgraph import DEFAULT_DL008_DEPTH, CallGraph
+from .dynahot import HOT_FRAME_RE
 
 # ------------------------------------------------------------------- config
 
@@ -996,7 +997,7 @@ def check_transitive_transfer(graph: CallGraph,
     for fi in graph.functions.values():
         if ENGINE_MARKER not in fi.path.replace("\\", "/"):
             continue
-        if not HOT_RE.search(fi.name) or _allowlisted(fi.qualname):
+        if not HOT_FRAME_RE.search(fi.name) or _allowlisted(fi.qualname):
             continue
         mod = graph.modules[fi.module]
         for cs in fi.calls:
@@ -1007,7 +1008,7 @@ def check_transitive_transfer(graph: CallGraph,
             if sub[0] == 0 and callee is not None and ENGINE_MARKER in \
                     callee.path.replace("\\", "/"):
                 continue  # engine sinks were already reported directly
-            if callee is not None and HOT_RE.search(callee.name):
+            if callee is not None and HOT_FRAME_RE.search(callee.name):
                 continue
             if (fi.key, cs.target) in seen:
                 continue

@@ -76,9 +76,10 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
-from .analyzer import (HOT_RE, HOT_SYNC_ALLOWLIST, LOCK_NAME_RE, RULES,
+from .analyzer import (HOT_SYNC_ALLOWLIST, LOCK_NAME_RE, RULES,
                        ModuleSource, Violation, call_attr, dotted)
 from .callgraph import DEFAULT_DL008_DEPTH, CallGraph, module_name
+from .dynahot import HOT_FRAME_RE
 
 GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*|loop)")
 
@@ -772,7 +773,7 @@ def check_transitive_host_sync(graph: CallGraph,
     for fi in graph.functions.values():
         if _HOT_PATH_MARKER not in fi.path.replace("\\", "/"):
             continue
-        if not HOT_RE.search(fi.name) \
+        if not HOT_FRAME_RE.search(fi.name) \
                 or fi.qualname in HOT_SYNC_ALLOWLIST:
             continue
         mod = graph.modules[fi.module]
@@ -781,7 +782,7 @@ def check_transitive_host_sync(graph: CallGraph,
             if sub is None or cs.target == fi.key:
                 continue
             callee = graph.functions.get(cs.target)
-            if callee is not None and HOT_RE.search(callee.name):
+            if callee is not None and HOT_FRAME_RE.search(callee.name):
                 continue  # hot callees carry their own per-file duty
             if (fi.key, cs.target) in seen:
                 continue

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Pre-commit gate: fast incremental lint over the files this commit
+# touches. Wire it up with
+#
+#     ln -sf ../../tools/precommit.sh .git/hooks/pre-commit
+#
+# Two layers, both scoped to `git diff --name-only HEAD`:
+#   1. ruff (style/pyflakes), if installed — seconds, changed files only
+#   2. dynalint --changed — per-file rules on the diffed files; the
+#      whole-program passes (dynaflow/dynarace/dynajit/dynaproto/
+#      dynahot) still analyze the full tree off one shared parse,
+#      because a callgraph built from a diff misses the cross-file
+#      edges that make them sound.
+set -euo pipefail
+
+ROOT="$(git rev-parse --show-toplevel)"
+cd "$ROOT"
+
+mapfile -t CHANGED_PY < <(git diff --name-only HEAD -- '*.py' |
+                          while read -r f; do [ -f "$f" ] && echo "$f"; done)
+
+if [ "${#CHANGED_PY[@]}" -eq 0 ]; then
+    echo "precommit: no changed .py files; skipping lint"
+    exit 0
+fi
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "precommit: ruff over ${#CHANGED_PY[@]} changed file(s)"
+    ruff check "${CHANGED_PY[@]}"
+else
+    echo "precommit: ruff not installed; skipping style layer"
+fi
+
+echo "precommit: dynalint --changed"
+python -m tools.dynalint --changed
