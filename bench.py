@@ -337,6 +337,14 @@ def parse_args():
                          "stage breakdown (route/prefill/kv_transfer/"
                          "decode span durations) plus a stage rollup "
                          "after the run")
+    ap.add_argument("--trip-incident", action="store_true",
+                    help="dynablack: after the workload finishes, trip a "
+                         "manual flight-recorder capture in-process and "
+                         "write the incident bundle next to --report-out "
+                         "(<stem>.incident.json), recording id/workers "
+                         "in the report's blackbox block — the chip-"
+                         "session step that proves the armed recorder "
+                         "produces a renderable bundle mid-bench")
     ap.add_argument("--report-out", default=None, metavar="PATH",
                     help="also write the full machine-readable record "
                          "(the BENCH_r*.json shape: metric/value/unit/"
@@ -2105,6 +2113,8 @@ def main():
         return
     if watchdog is not None:
         watchdog.cancel()
+    if getattr(args, "trip_incident", False):
+        record["blackbox"] = _trip_incident(args)
     if getattr(args, "report_out", None):
         # full machine-readable record for the perf trajectory; must
         # round-trip through json.load (tier-1 gated)
@@ -2114,6 +2124,35 @@ def main():
         print(f"report written to {args.report_out}", file=sys.stderr)
     # the ONE line the driver records
     print(json.dumps(record))
+
+
+def _trip_incident(args) -> dict:
+    """dynablack --trip-incident: manual capture after the workload, so
+    the chip session proves an armed recorder yields a renderable bundle
+    without perturbing the benched path (the trip happens post-run)."""
+    from dynamo_tpu.runtime import blackbox
+
+    rec = blackbox.get_recorder()
+    if not rec.enabled:
+        return {"armed": False, "window_s": rec.window_s}
+    bundle = rec.trip("manual", {"via": "bench"})
+    if bundle is None:
+        return {"armed": True, "captured": False,
+                "cooldown_remaining_s": round(rec.cooldown_remaining_s(), 3)}
+    block = {"armed": True, "captured": True,
+             "incident_id": bundle["id"],
+             "workers": sorted(bundle["workers"])}
+    if getattr(args, "report_out", None):
+        stem = args.report_out
+        if stem.endswith(".json"):
+            stem = stem[:-len(".json")]
+        path = stem + ".incident.json"
+        with open(path, "w") as f:
+            f.write(blackbox.render_bundle_json(bundle))
+            f.write("\n")
+        print(f"incident bundle written to {path}", file=sys.stderr)
+        block["bundle_path"] = path
+    return block
 
 
 def _run_scenario(args) -> dict:

@@ -45,7 +45,7 @@ from ..llm.protocols.common import (FINISH_CANCELLED, FINISH_EOS,
 from ..models.config import ModelConfig
 from ..models.llama import DROP_SLOT, KVCacheSpec
 from ..models.registry import get_model_module
-from ..runtime import guard, profiling, slo, tracing
+from ..runtime import blackbox, guard, profiling, slo, tracing
 from ..runtime.config import env_bool, env_flag, env_int, env_str
 from ..runtime.engine import Context
 from .jit_fence import CompileFence
@@ -632,6 +632,10 @@ class JaxEngine:
         # defaults to "unified"; disagg wrappers relabel via set_role().
         self.latency = slo.LatencyRecorder("unified")
         profiling.register_cache(f"jax-engine-{id(self):x}", self)
+        # dynablack: incident bundles fold this engine's stats() (cost
+        # table, cache, memory) at capture time — weakly held, cold path
+        blackbox.get_recorder().register_stats_source(
+            self.worker_label or f"jax-engine-{id(self):x}", self)
 
     @property
     def role(self) -> str:

@@ -96,6 +96,12 @@ class CompileFence:
         _install_listener()
         _fences.add(self)
         self.armed = True
+        # end of warmup = steady state begins: snapshot the pre-incident
+        # cost-table/cache baseline dynablack postmortems diff against
+        from ..runtime import blackbox
+        rec = blackbox.get_recorder()
+        if rec.enabled:
+            rec.refresh_baseline()
 
     def disarm(self) -> None:
         self.armed = False
@@ -108,6 +114,14 @@ class CompileFence:
             self.timeline.add("compile",
                               duration_ms=round(duration_secs * 1e3, 3),
                               post_warmup_total=self.post_warmup_compiles)
+        # a post-warmup compile is an incident by definition (the
+        # zero-compile invariant broke); already on the cold compile path
+        from ..runtime import blackbox
+        blackbox.notify_trigger("post_warmup_compile", {
+            "fence": self.name,
+            "duration_ms": round(duration_secs * 1e3, 3),
+            "post_warmup_total": self.post_warmup_compiles,
+        })
         mode = self.mode
         if mode == "raise":
             raise PostWarmupCompileError(

@@ -44,7 +44,7 @@ from ..metrics.component import MetricsAggregator
 from ..parallel.serving import DevicePool, NoFreeDevices
 from ..planner.planner import Planner, WatchTarget
 from ..planner.policy import PLANNER_KV_PREFIX
-from ..runtime import revive
+from ..runtime import blackbox, revive
 from ..runtime.component import Client
 from ..runtime.config import env_float
 from ..runtime.dcp_client import pack, unpack
@@ -106,6 +106,25 @@ class FleetSim:
             if scenario.slo_objectives else SloRegistry())
         self._role_seq = 0
         self._slo_step_hists: Dict[int, dict] = {}
+        # dynablack: a deterministic flight recorder on the virtual
+        # clock. The harness owns one ShadowRing per worker (fed by the
+        # lifecycle callback); on the first fired burn-rate alert the
+        # recorder trips, the capture fans out over the blackbox.capture
+        # DCP frame, every worker contributes its ring, and the merged
+        # bundle lands in the report's `incident` block — byte-identical
+        # per seed (virtual time only, canonical sorted serialization)
+        self.recorder: Optional[blackbox.FlightRecorder] = None
+        self._worker_rings: Dict[str, blackbox.ShadowRing] = {}
+        self._bb_workers: Set[str] = set()
+        self._incident_bundle: Optional[dict] = None
+        if scenario.capture_incident:
+            horizon = float(scenario.steps + scenario.drain_steps + 1) \
+                * scenario.step_seconds
+            self.recorder = blackbox.FlightRecorder(
+                window_s=horizon, cooldown_s=0.0, out_dir=None,
+                triggers="all", clock=self.clock.now, wall=self.clock.now,
+                id_factory=lambda: f"incident-{scenario.name}-{seed}",
+                include_process_state=False)
         # dynarevive: SLO-aware shed controller (wired in setup() when
         # the scenario sets shed_queue_depth)
         self.admission: Optional[revive.AdmissionController] = None
@@ -146,6 +165,9 @@ class FleetSim:
                                      slo_registry=self.slo_registry,
                                      slo_clock=self.clock.now)
         await self.agg.start(run_loop=False)
+        if self.recorder is not None:
+            # the ISSUE-mandated "last fleet-aggregator scrape" evidence
+            self.recorder.add_source("fleet_scrape", self.agg.last_scrape)
 
         self.planner = Planner(
             self.drt, NAMESPACE,
@@ -234,12 +256,28 @@ class FleetSim:
             lambda rid, ev, vt, n=name: self._lifecycle(n, rid, ev, vt),
             submesh=submesh, role=role, prefill_pool=self.prefill_pool)
         await worker.start()
+        if self.recorder is not None:
+            # one shadow ring per worker, anchored at its (virtual) spawn
+            # time; the worker joins the capture fan-out and answers an
+            # origin announcement with exactly its own ring
+            ring = blackbox.ShadowRing(name, maxlen=2048,
+                                       clock=self.clock.now,
+                                       wall=self.clock.now)
+            self._worker_rings[name] = ring
+            await blackbox.attach_dcp(
+                worker.drt, NAMESPACE, self.recorder, name,
+                rings_fn=lambda n=name: {
+                    n: self._worker_rings[n].export()})
+            self._bb_workers.add(name)
         return worker
 
     # --------------------------------------------------------- lifecycle
 
     def _lifecycle(self, worker: str, rid: str, event: str,
                    vt: float) -> None:
+        ring = self._worker_rings.get(worker)
+        if ring is not None:
+            ring.note(event, rid=rid, vt=vt)
         rec = self.scorer.record(rid)
         if rec is None:
             return
@@ -436,6 +474,10 @@ class FleetSim:
                     worker = live[min(fault.arg, len(live) - 1)]
                     await worker.crash()
                     self.scorer.worker_event(vt, "crash", worker.name)
+                    if self.recorder is not None:
+                        self.recorder.note("sim-harness", "fault",
+                                           fault="crash", step=step,
+                                           name=worker.name, vt=vt)
             elif fault.kind == "drain":
                 # rolling-restart wave: graceful drain of one live
                 # worker — discovery out, in-flight finishes, the
@@ -476,6 +518,30 @@ class FleetSim:
                     worker.set_blackout(fault.kind == "flap_start")
                     self.scorer.worker_event(vt, fault.kind, worker.name)
 
+    async def _capture_incident(self, alert: dict, step: int) -> None:
+        """First fired burn-rate alert: trip the recorder, broadcast the
+        capture over DCP, and wall-bounded-wait until every subscribed
+        worker's ring has merged into the bundle (the wait is for
+        determinism: the bundle must hold the same ring set every run)."""
+        rec = self.recorder
+        rec.note("sim-harness", "alert", step=step, **alert)
+        bundle = rec.trip("slo_burn_rate", alert)
+        if bundle is None:
+            return
+        await blackbox.broadcast_capture(self.drt, NAMESPACE, bundle,
+                                         worker_label="sim-harness")
+        want = set(self._bb_workers)
+        deadline = asyncio.get_running_loop().time() \
+            + self._discovery_timeout
+        while not want <= set(bundle["workers"]):
+            if asyncio.get_running_loop().time() >= deadline:
+                raise RuntimeError(
+                    "incident contributions did not converge "
+                    f"(have {sorted(bundle['workers'])}, want "
+                    f"{sorted(want)})")
+            await asyncio.sleep(0.005)
+        self._incident_bundle = bundle
+
     def _fleet_sample(self) -> None:
         waiting = sum(len(w.model.queue)
                       for w in self._workers_in_order())
@@ -496,6 +562,11 @@ class FleetSim:
         # Histogram objects each call) — the report diffs these at phase
         # boundaries into per-phase per-role quantiles
         self._slo_step_hists[step] = self.agg.merged_latency()
+        if self.recorder is not None and self._incident_bundle is None:
+            fired = [e for e in self.agg.slo.alert_events
+                     if e["state"] == "fired"]
+            if fired:
+                await self._capture_incident(fired[0], step)
         await self.planner.tick()
         await self._actuate()
         self._fleet_sample()
@@ -581,6 +652,13 @@ class FleetSim:
             }
         if self.slo_registry.objectives or self.prefill_pool is not None:
             extra["dynaslo"] = self._dynaslo_block()
+        if self.recorder is not None:
+            # dynablack plane: the merged incident bundle (or the armed-
+            # but-untripped recorder state) — virtual-time values only
+            extra["incident"] = (
+                self._incident_bundle if self._incident_bundle is not None
+                else {"captured": False,
+                      "captures_total": self.recorder.captures_total})
         if self.device_pool is not None:
             # dynashard plane: the submesh-assignment story of the run —
             # every partition/release with its virtual timestamp, the

@@ -84,6 +84,11 @@ class Scenario:
     slo_objectives: Optional[str] = None
     slo_fast_fraction: float = 0.1
     slo_burn_threshold: float = 2.0
+    # dynablack: run a deterministic FlightRecorder on the virtual clock,
+    # feed it every lifecycle stamp, trip it on the first fired burn-rate
+    # alert, fan the capture out over DCP (every SimWorker contributes
+    # its shadow ring) and attach the merged bundle to the report
+    capture_incident: bool = False
 
 
 def _smoke() -> Scenario:
@@ -335,6 +340,43 @@ def _pd_rebalance() -> Scenario:
     )
 
 
+def _incident() -> Scenario:
+    """dynablack end-to-end: steady load, a mid-run crash shrinks the
+    fleet (the planner is pinned, so no relief arrives), TTFT burns its
+    error budget and the multi-window alert fires — the first ``fired``
+    transition trips the flight recorder. The capture fans out over the
+    ``blackbox.capture`` DCP frame; every live worker answers with its
+    shadow ring, so the bundle holds ≥ 2 rings aligned by timeline
+    anchors, names the tripping trigger, and — the acceptance bar — is
+    byte-identical across runs at the same seed."""
+    steps = 36
+    return Scenario(
+        name="incident", steps=steps,
+        # rate sized so the 3-worker fleet holds the objective (demand 8
+        # slot-steps vs 9 capacity) and the 2-worker post-crash fleet
+        # cannot (8 vs 6): the burn is crash-caused, not baked in
+        traffic=lambda seed: constant(seed, steps=steps, rate=4.0,
+                                      max_tokens=12),
+        initial_workers=3,
+        profile=WorkerProfile(slots=3, tokens_per_step=6),
+        # scaling disabled (0-thresholds) and min below the post-crash
+        # count: the crashed worker is never replaced, so the capacity
+        # loss sustains the burn until the alert fires
+        planner=PlannerConfig(min_replicas=2, max_replicas=3,
+                              waiting_per_worker_high=0.0,
+                              queue_depth_per_worker_high=0.0,
+                              cache_high_water=0.0,
+                              cache_low_water=-1.0),
+        faults=[FaultEvent(step=9, kind="crash", arg=0)],
+        slo=SloTargets(ttft_p95=4.0, queue_wait_p95=3.0),
+        slo_objectives="ttft<=2.0@0.95/10",
+        slo_fast_fraction=0.25,
+        slo_burn_threshold=1.5,
+        disturb_end_step=9,
+        capture_incident=True,
+    )
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "smoke": _smoke,
     "burst": _burst,
@@ -347,6 +389,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "sharded": _sharded,
     "failover": _failover,
     "pd_rebalance": _pd_rebalance,
+    "incident": _incident,
 }
 
 

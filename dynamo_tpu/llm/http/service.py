@@ -19,7 +19,7 @@ from typing import AsyncIterator, Callable, Dict, Optional
 
 from aiohttp import web
 
-from ...runtime import guard, profiling, revive, tracing
+from ...runtime import blackbox, guard, profiling, revive, tracing
 from ...runtime.dcp_client import NoRespondersError
 from ...runtime.engine import Annotated, Context
 from ...runtime.tasks import spawn_tracked
@@ -85,6 +85,9 @@ class HttpService:
             web.get("/debug/profile/stacks", self._debug_stacks),
             web.post("/debug/profile/start", self._profile_start),
             web.post("/debug/profile/stop", self._profile_stop),
+            web.get("/debug/incidents", self._incidents),
+            web.get("/debug/incidents/{incident_id}", self._incident_one),
+            web.post("/debug/incidents/capture", self._incident_capture),
             web.post("/drain", self._drain),
             web.get("/metrics", self._metrics),
             web.get("/health", self._health),
@@ -123,6 +126,11 @@ class HttpService:
         # dynaprof: always-on loop-lag monitor + stall watchdog for the
         # frontend's event loop (refcounted; released in stop())
         profiling.acquire_loop_profiler()
+        # dynablack: fold the frontend's SLO view into incident bundles
+        # (weakly held; a disabled recorder ignores everything)
+        rec = blackbox.get_recorder()
+        if rec.enabled:
+            rec.add_source("slo", self.metrics.slo_snapshot)
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -181,11 +189,24 @@ class HttpService:
     async def _traces(self, request: web.Request) -> web.Response:
         """Debug listing: recent traces (newest first) + the registered
         engine step timelines (with their wall/monotonic anchor pairs,
-        so cross-worker rollups can put every ring on one time axis)."""
+        so cross-worker rollups can put every ring on one time axis).
+        ``?limit=`` caps both listings (default 100 traces / 200 timeline
+        events); ``?since_ms=`` (epoch ms) is the incremental-poll
+        filter — the defaults keep the response bounded at production
+        ring sizes."""
+        try:
+            limit = _query_num(request, "limit", int)
+            since_ms = _query_num(request, "since_ms", float)
+        except ValueError as e:
+            return _error_response(400, str(e))
         tracer = tracing.get_tracer()
         return web.json_response({
-            "traces": tracer.traces_summary(),
-            "engine_steps": tracing.timelines_snapshot(),
+            "traces": tracer.traces_summary(
+                limit=limit if limit is not None else 100,
+                since_ms=since_ms),
+            "engine_steps": tracing.timelines_snapshot(
+                limit=limit if limit is not None else 200,
+                since_ms=since_ms),
             "engine_step_anchors": tracing.timeline_anchors(),
         })
 
@@ -236,9 +257,69 @@ class HttpService:
 
     async def _debug_stacks(self, request: web.Request) -> web.Response:
         """Flamegraph-ready collapsed-stack dump of event-loop stalls
-        (pipe straight into flamegraph.pl)."""
-        return web.Response(text=profiling.stall_stacks_folded(),
+        (pipe straight into flamegraph.pl). ``?limit=`` keeps the top-N
+        hottest stacks (default 200); ``?since_ms=`` drops stacks not
+        sampled since that wall time."""
+        try:
+            limit = _query_num(request, "limit", int)
+            since_ms = _query_num(request, "since_ms", float)
+        except ValueError as e:
+            return _error_response(400, str(e))
+        text = profiling.stall_stacks_folded(
+            limit=limit if limit is not None else 200, since_ms=since_ms)
+        return web.Response(text=text,
                             content_type="text/plain", charset="utf-8")
+
+    # ------------------------------------------------ dynablack incidents
+
+    async def _incidents(self, request: web.Request) -> web.Response:
+        """dynablack incident table: one summary row per captured (or
+        contributed-to) incident, newest first."""
+        rec = blackbox.get_recorder()
+        return web.json_response({
+            "enabled": rec.enabled,
+            "window_s": rec.window_s,
+            "cooldown_remaining_s": round(rec.cooldown_remaining_s(), 3),
+            "captures_total": rec.captures_total,
+            "suppressed_total": rec.suppressed_total,
+            "incidents": rec.incidents_summary(),
+        })
+
+    async def _incident_one(self, request: web.Request) -> web.Response:
+        """One full incident bundle, in the canonical serialization the
+        persisted file and the admin renderer consume."""
+        iid = request.match_info["incident_id"]
+        bundle = blackbox.get_recorder().get(iid)
+        if bundle is None:
+            return _error_response(404, f"no incident {iid!r}")
+        return web.Response(text=blackbox.render_bundle_json(bundle),
+                            content_type="application/json",
+                            charset="utf-8")
+
+    async def _incident_capture(self, request: web.Request) -> web.Response:
+        """Manual trip: capture now unless the cooldown debounce is
+        active (409 + Retry-After) or the recorder is disabled."""
+        rec = blackbox.get_recorder()
+        if not rec.enabled:
+            return _error_response(
+                409, "flight recorder disabled (DYN_BLACKBOX_WINDOW_S=0)")
+        remaining = rec.cooldown_remaining_s()
+        if remaining > 0:
+            return _error_response(
+                409, f"capture cooldown active ({remaining:.1f}s left)",
+                {"Retry-After": str(max(1, int(remaining + 0.999)))})
+        bundle = rec.trip("manual", {"via": "http"})
+        if bundle is None:
+            # raced into a cooldown, or DYN_BLACKBOX_TRIGGERS excludes
+            # 'manual'
+            return _error_response(
+                409, "capture suppressed (cooldown or trigger filter)",
+                {"Retry-After": str(max(1, int(rec.cooldown_s)))})
+        return web.json_response({
+            "id": bundle["id"], "trigger": bundle["trigger"],
+            "at_wall_ms": bundle["at_wall_ms"],
+            "workers": sorted(bundle["workers"]),
+        })
 
     async def _profile_start(self, request: web.Request) -> web.Response:
         """Start an on-demand jax.profiler trace capture. Body may carry
@@ -797,6 +878,19 @@ def _timeout_chunk(endpoint: str, model: str, rid: str) -> dict:
             "created": int(_time.time()), "model": model,
             "choices": [{"index": 0, "text": "",
                          "finish_reason": "timeout"}]}
+
+
+def _query_num(request: web.Request, name: str, cast):
+    """Optional numeric query param; raises ValueError with a client-
+    facing message on junk (mapped to 400 by the handlers)."""
+    raw = request.query.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        return cast(raw)
+    except (TypeError, ValueError):
+        raise ValueError(f"query param {name!r} must be numeric, "
+                         f"got {raw!r}") from None
 
 
 def _error_response(status: int, message: str,

@@ -20,7 +20,7 @@ from typing import Callable, Dict, Optional
 from ..llm.kv_router.protocols import KV_HIT_RATE_SUBJECT, ForwardPassMetrics
 from ..runtime.component import Client, EndpointAddress
 from ..runtime.config import env_str
-from ..runtime import wire
+from ..runtime import blackbox, wire
 from ..runtime.dcp_client import unpack
 from ..runtime.runtime import DistributedRuntime
 from ..runtime.slo import (Histogram, SloEngine, SloRegistry, collapse_roles,
@@ -66,6 +66,19 @@ class MetricsAggregator:
         self._client: Optional[Client] = None
         self._task: Optional[asyncio.Task] = None
         self._sid: Optional[int] = None
+        self._bb_sid: Optional[int] = None
+
+    def last_scrape(self) -> dict:
+        """The most recent fleet scrape as a JSON-safe dict — folded into
+        dynablack incident bundles as the 'what did the aggregator see
+        last' evidence."""
+        return {
+            "workers": {str(wid): m.to_dict()
+                        for wid, m in sorted(self.worker_metrics.items())},
+            "hit_rate_events": self.hit_rate_events,
+            "scrape_failures_total": self.scrape_failures_total,
+            "alerts": list(self.slo.alert_events[-20:]),
+        }
 
     async def start(self, *, run_loop: bool = True) -> None:
         """``run_loop=False`` skips the periodic scrape task; drivers that
@@ -76,14 +89,24 @@ class MetricsAggregator:
             self.address.component).endpoint(self.address.endpoint).client()
         self._sid = await self.drt.dcp.subscribe(
             f"{self.namespace}.{KV_HIT_RATE_SUBJECT}", self._on_hit_rate)
+        # dynablack: join the incident capture fan-out — the aggregator
+        # contributes its last fleet scrape and receives sibling captures
+        rec = blackbox.get_recorder()
+        if rec.enabled:
+            rec.add_source("fleet_scrape", self.last_scrape)
+            self._bb_sid = await blackbox.attach_dcp(
+                self.drt, self.namespace, rec,
+                f"aggregator-{self.address.component}")
         if run_loop:
             self._task = spawn_tracked(self._loop(), name="metrics-scrape")
 
     async def stop(self) -> None:
         await cancel_join(self._task)
-        if self._sid is not None:
+        for sid in (self._sid, self._bb_sid):
+            if sid is None:
+                continue
             try:
-                await self.drt.dcp.unsubscribe(self._sid)
+                await self.drt.dcp.unsubscribe(sid)
             except Exception:
                 log.debug("unsubscribe failed during stop", exc_info=True)
         if self._client:

@@ -45,6 +45,30 @@ def register_env(name: str, default: Optional[str], component: str,
 # Keep alphabetical within each component block; docs/env_vars.md renders
 # straight from this table.
 
+register_env("DYN_BLACKBOX_COOLDOWN_S", "60", "runtime",
+             "dynablack incident flight recorder: debounce (seconds) "
+             "between persisted captures — a trigger storm (breaker "
+             "flapping, repeated stalls) produces one bundle per "
+             "cooldown window, not one per event. Manual captures "
+             "inside the window answer 409 with Retry-After.")
+register_env("DYN_BLACKBOX_DIR", None, "runtime",
+             "dynablack: directory incident bundles are persisted into "
+             "(one incident-<id>.json per capture). Unset = bundles are "
+             "kept in the bounded in-memory incident table only "
+             "(GET /debug/incidents).")
+register_env("DYN_BLACKBOX_TRIGGERS", "all", "runtime",
+             "dynablack: comma-separated trigger allowlist out of "
+             "slo_burn_rate,breaker_open,post_warmup_compile,"
+             "watchdog_stall,failover_resume,deadline_storm,manual — "
+             "'all' (default) arms every trigger; 'manual' keeps only "
+             "POST /debug/incidents/capture.")
+register_env("DYN_BLACKBOX_WINDOW_S", "30", "runtime",
+             "dynablack: how many seconds of shadow-ring telemetry an "
+             "incident bundle folds in (trace spans, step-timeline "
+             "events and shadow-ring entries older than the window are "
+             "dropped at capture time). 0 disables the flight recorder "
+             "entirely — no shadow rings, no triggers, no captures "
+             "(the hot-path A/B control arm).")
 register_env("DYN_BREAKER_PROBE_EVERY", "5", "runtime",
              "Circuit breakers: an OPEN breaker offers a single half-open "
              "probe every Nth denied call (deterministic cadence; works "

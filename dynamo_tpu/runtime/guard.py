@@ -155,6 +155,8 @@ class Deadline:
     def check(self, what: str = "request") -> None:
         if self.expired:
             counter_inc("dyn_guard_deadline_exceeded_total")
+            from . import blackbox
+            blackbox.note_deadline()
             raise DeadlineExceeded(f"deadline exceeded before {what}")
 
     def __repr__(self) -> str:
@@ -197,6 +199,8 @@ async def bound(awaitable: Awaitable, *, timeout: Optional[float] = None,
     except asyncio.TimeoutError:
         if deadline is not None and deadline.expired:
             counter_inc("dyn_guard_deadline_exceeded_total")
+            from . import blackbox
+            blackbox.note_deadline()
             raise DeadlineExceeded(f"deadline exceeded during {what}") \
                 from None
         raise
@@ -375,6 +379,12 @@ class CircuitBreaker:
         self.opened_total += 1
         self.denied_since_open = 0
         self._probe_inflight = False
+        # a breaker opening IS the incident; cold path by definition
+        from . import blackbox
+        blackbox.notify_trigger("breaker_open", {
+            "failures": self.failures,
+            "opened_total": self.opened_total,
+        })
 
     def reset(self) -> None:
         """External evidence of recovery (fresh discovery put): close."""
@@ -452,6 +462,37 @@ def counter_value(name: str, **labels: str) -> float:
 def reset_counters() -> None:
     """Test hook."""
     _COUNTERS.clear()
+
+
+def counters_snapshot() -> Dict[str, float]:
+    """Guard-plane counters as one flat JSON-safe dict (dynablack incident
+    bundles). Label sets fold into the key: ``name{k="v"}``."""
+    out: Dict[str, float] = {}
+    for (name, labels), val in sorted(_COUNTERS.items()):
+        if labels:
+            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            out[f"{name}{{{lbl}}}"] = val
+        else:
+            out[name] = val
+    return out
+
+
+def boards_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Per-board breaker state for dynablack incident bundles: state name,
+    consecutive failures and lifetime opens per (plane, instance)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for board in sorted(_BOARDS, key=lambda b: b.name):
+        rows: Dict[str, Any] = {}
+        for (plane, key), br in sorted(board.breakers.items(),
+                                       key=lambda kv: repr(kv[0])):
+            ident = f"{key:x}" if isinstance(key, int) else str(key)
+            rows[f"{plane}/{ident}"] = {
+                "state": br.state_name,
+                "failures": br.failures,
+                "opened_total": br.opened_total,
+            }
+        out[board.name] = rows
+    return out
 
 
 def render_prom_lines() -> List[str]:

@@ -144,6 +144,7 @@ class StallWatchdog(threading.Thread):
             self.threshold / 4.0, 0.01)
         self.monitor = monitor
         self._stacks: "OrderedDict[str, int]" = OrderedDict()
+        self._last_seen: Dict[str, float] = {}  # bounded-by: same cap as _stacks (popped together)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.captures = 0
@@ -173,17 +174,34 @@ class StallWatchdog(threading.Thread):
             self.captures += 1
             if folded in self._stacks:
                 self._stacks[folded] += 1
+                self._last_seen[folded] = time.time()
             elif len(self._stacks) < self.max_stacks:
                 self._stacks[folded] = 1
+                self._last_seen[folded] = time.time()
             else:
                 self.dropped += 1  # bounded: new shapes past cap are counted
+        # a stall long enough to sample IS an anomaly; already off-loop
+        from . import blackbox
+        blackbox.notify_trigger("watchdog_stall", {
+            "stack": folded, "threshold_ms": self.threshold * 1000.0})
         return folded
 
-    def folded(self) -> str:
-        """Flamegraph-ready collapsed-stack text: ``stack count`` lines."""
+    def folded(self, limit: Optional[int] = None,
+               since: Optional[float] = None) -> str:
+        """Flamegraph-ready collapsed-stack text: ``stack count`` lines.
+
+        ``limit`` keeps only the top-N hottest stacks; ``since`` (wall
+        seconds) drops stacks not sampled since that time — both exist so
+        /debug/profile/stacks can bound its response at production ring
+        sizes (satellite of dynablack)."""
         with self._lock:
             items = sorted(self._stacks.items(),
                            key=lambda kv: (-kv[1], kv[0]))
+            if since is not None:
+                items = [(s, c) for s, c in items
+                         if self._last_seen.get(s, 0.0) >= since]
+        if limit is not None and limit >= 0:
+            items = items[:limit]
         return "".join(f"{stack} {count}\n" for stack, count in items)
 
     def snapshot(self) -> dict:
@@ -294,11 +312,13 @@ def loop_lag_snapshot() -> dict:
     return prof.monitor.snapshot()
 
 
-def stall_stacks_folded() -> str:
+def stall_stacks_folded(limit: Optional[int] = None,
+                        since_ms: Optional[float] = None) -> str:
     prof = current_loop_profiler()
     if prof is None or prof.watchdog is None:
         return ""
-    return prof.watchdog.folded()
+    since = since_ms / 1000.0 if since_ms is not None else None
+    return prof.watchdog.folded(limit=limit, since=since)
 
 
 def render_prom_lines() -> List[str]:

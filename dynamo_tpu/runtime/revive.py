@@ -247,6 +247,13 @@ class ReviveSession:
         self.entry.resumes += 1
         self.ring.resumed_total += 1
         guard.counter_inc("dyn_revive_resumes_total")
+        # a failover resume means a worker just died mid-stream: capture
+        # the evidence of why (cold path — resumes are rare)
+        from . import blackbox
+        blackbox.notify_trigger("failover_resume", {
+            "request_id": self.entry.request_id,
+            "resumes": self.entry.resumes,
+        })
 
     def resume_request(self) -> Any:
         """The re-dispatch request: original prompt + journaled tokens,

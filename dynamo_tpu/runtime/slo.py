@@ -444,6 +444,12 @@ class SloEngine:
                                "burn_fast": ev["burn_fast"],
                                "burn_slow": ev["burn_slow"]})
         self.alert_events.extend(events)
+        for ev in events:
+            if ev["state"] == "fired":
+                # burn-rate trip: the canonical dynablack trigger (cold
+                # path — at most one transition per objective per tick)
+                from . import blackbox
+                blackbox.notify_trigger("slo_burn_rate", ev)
         return events
 
     # -------------------------------------------------------- evaluation

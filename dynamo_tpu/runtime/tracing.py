@@ -42,6 +42,30 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .config import env_float, env_int, env_str
 
+_JSON_SCALARS = (str, int, float, bool, type(None))
+
+
+def json_safe(value: Any) -> Any:
+    """Coerce ``value`` to JSON-serializable types (the dyntrace export
+    and dynablack incident-bundle serializer). Scalars pass through,
+    containers recurse, bytes decode (hex on failure), everything else
+    becomes its ``repr`` string — so ``json.dumps`` of the result never
+    raises and ``json.loads`` round-trips what jq/ingest pipelines see."""
+    if isinstance(value, _JSON_SCALARS):
+        return value
+    if isinstance(value, dict):
+        return {str(k): json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return [json_safe(v) for v in value]
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        raw = bytes(value)
+        try:
+            return raw.decode("utf-8")
+        except UnicodeDecodeError:
+            return raw.hex()
+    return repr(value)
+
+
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "dyn_trace_span", default=None)
 _request_id: contextvars.ContextVar = contextvars.ContextVar(
@@ -123,11 +147,16 @@ class Span:
         self.start = time.monotonic()
         self.wall_start = time.time()
         self.end_time: Optional[float] = None
-        self.attributes: Dict[str, Any] = dict(attributes or {})
+        # attrs are coerced JSON-safe at RECORD time (not export): a span
+        # carrying a jax array / dataclass / bytes must never leak a
+        # Python repr into the JSONL export or an incident bundle
+        self.attributes: Dict[str, Any] = (
+            {k: json_safe(v) for k, v in attributes.items()}
+            if attributes else {})
         self._token = None
 
     def set_attribute(self, key: str, value: Any) -> None:
-        self.attributes[key] = value
+        self.attributes[key] = json_safe(value)
 
     @property
     def duration_s(self) -> Optional[float]:
@@ -274,7 +303,10 @@ class Tracer:
     def _finish(self, span: Span) -> None:
         line = None
         if self._fh is not None:
-            line = json.dumps(span.to_dict(), default=repr) + "\n"
+            # attrs were coerced at record time; json_safe as the dumps
+            # fallback covers direct attribute-dict mutation so the
+            # export stays parseable JSON no matter what (never repr)
+            line = json.dumps(span.to_dict(), default=json_safe) + "\n"
         with self._lock:
             self._spans.append(span)
             self.spans_recorded += 1
@@ -332,11 +364,16 @@ class Tracer:
                 "spans": spans,
                 "stages": {k: round(v, 3) for k, v in stages.items()}}
 
-    def traces_summary(self, limit: int = 100) -> List[dict]:
-        """Newest-first one-line-per-trace summaries for /v1/traces."""
+    def traces_summary(self, limit: int = 100,
+                       since_ms: Optional[float] = None) -> List[dict]:
+        """Newest-first one-line-per-trace summaries for /v1/traces.
+        ``since_ms`` (wall-clock epoch ms) drops spans that started
+        earlier — the incremental-poll / incident-window filter."""
         by_trace: "OrderedDict[str, dict]" = OrderedDict()
         earliest: Dict[str, Span] = {}
         for s in self.snapshot():
+            if since_ms is not None and s.wall_start * 1000.0 < since_ms:
+                continue
             e = by_trace.setdefault(s.trace_id, {
                 "trace_id": s.trace_id, "request_id": None, "root": None,
                 "spans": 0, "duration_ms": 0.0, "start_ms": None})
@@ -449,15 +486,21 @@ class StepTimeline:
             fields["kind"] = kind
             self._q.append(fields)
 
-    def snapshot(self, limit: Optional[int] = None) -> List[dict]:
+    def snapshot(self, limit: Optional[int] = None,
+                 since_ms: Optional[float] = None) -> List[dict]:
+        """Newest ``limit`` events with derived wall ``ts_ms``;
+        ``since_ms`` (wall epoch ms) drops older events first."""
         if self._q is None:
             return []
         items = list(self._q)
-        if limit:
-            items = items[-limit:]
         base = self.anchor_wall * 1000.0
-        return [{**e, "ts_ms": round(base + e["mono_ms"], 3)}
-                for e in items]
+        out = [{**e, "ts_ms": round(base + e["mono_ms"], 3)}
+               for e in items]
+        if since_ms is not None:
+            out = [e for e in out if e["ts_ms"] >= since_ms]
+        if limit:
+            out = out[-limit:]
+        return out
 
     def anchors(self) -> dict:
         return {"anchor_wall_ms": round(self.anchor_wall * 1000.0, 3),
@@ -476,7 +519,9 @@ def register_timeline(name: str, timeline: StepTimeline) -> None:
         _timelines[name] = weakref.ref(timeline)
 
 
-def timelines_snapshot(limit: int = 200) -> Dict[str, List[dict]]:
+def timelines_snapshot(limit: int = 200,
+                       since_ms: Optional[float] = None
+                       ) -> Dict[str, List[dict]]:
     out: Dict[str, List[dict]] = {}
     with _timelines_lock:
         for name, ref in list(_timelines.items()):
@@ -484,7 +529,7 @@ def timelines_snapshot(limit: int = 200) -> Dict[str, List[dict]]:
             if tl is None:
                 del _timelines[name]
             elif tl.enabled:
-                out[name] = tl.snapshot(limit)
+                out[name] = tl.snapshot(limit, since_ms=since_ms)
     return out
 
 

@@ -1,0 +1,543 @@
+"""dynablack: the incident flight recorder (ISSUE 19).
+
+The acceptance contract: shadow rings are bounded and restart-safe,
+the recorder debounces and evicts deterministically, the trigger
+registry fires off the existing cold-path events (breaker open,
+deadline storm), the HTTP surface serves bounded listings + the
+incident table, the fleet-sim ``incident`` scenario produces a
+byte-identical bundle per seed with rings from >= 2 workers, the e2e
+path (severed request plane -> breaker open -> capture ->
+GET /debug/incidents/{id} -> postmortem renderer) never errors, and
+both Prometheus planes render hygienic exposition.
+"""
+
+import asyncio
+import json
+import re
+import threading
+
+import pytest
+
+from dynamo_tpu.runtime import blackbox, guard, tracing
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Each test builds its own recorder; none leaks between tests."""
+    blackbox.reset()
+    yield
+    blackbox.reset()
+
+
+# ------------------------------------------------------------ shadow ring
+
+
+def test_shadow_ring_bounded_windowed_and_anchored():
+    clock, wall = FakeClock(100.0), FakeClock(1_000.0)
+    ring = blackbox.ShadowRing("w0", maxlen=4, clock=clock, wall=wall)
+    assert ring.anchors() == {"anchor_wall": 1_000.0,
+                              "anchor_monotonic": 100.0}
+    for i in range(6):
+        clock.advance(1.0)
+        ring.note("step", i=i)
+    # bounded: the two oldest events rotated out
+    assert len(ring) == 4
+    events = ring.snapshot()
+    assert [e["i"] for e in events] == [2, 3, 4, 5]
+    # ts_ms is DERIVED from the wall anchor + the monotonic offset
+    assert events[-1]["mono_ms"] == 6_000.0
+    assert events[-1]["ts_ms"] == 1_000_000.0 + 6_000.0
+    # window filter: only events inside the last 2 virtual seconds
+    # (boundary inclusive: i=3 sits exactly on the cutoff)
+    recent = ring.snapshot(window_s=2.0)
+    assert [e["i"] for e in recent] == [3, 4, 5]
+    # export is json.dumps-able whatever the fields held
+    ring.note("weird", payload=object(), raw=b"\xff\xfe")
+    json.dumps(ring.export())
+
+
+def test_shadow_ring_restamp_clears_events_no_mono_aliasing():
+    clock, wall = FakeClock(50.0), FakeClock(500.0)
+    ring = blackbox.ShadowRing("w0", maxlen=16, clock=clock, wall=wall)
+    clock.advance(10.0)
+    ring.note("before", i=0)
+    assert ring.snapshot()[0]["mono_ms"] == 10_000.0
+    # restart: anchors restamp AND the ring clears, so a post-restart
+    # event can never alias a pre-restart mono_ms on the new anchors
+    clock.advance(5.0)
+    wall.advance(100.0)
+    ring.restamp()
+    assert len(ring) == 0
+    assert ring.anchors() == {"anchor_wall": 600.0,
+                              "anchor_monotonic": 65.0}
+    clock.advance(1.0)
+    ring.note("after", i=1)
+    (ev,) = ring.snapshot()
+    assert ev["mono_ms"] == 1_000.0
+    assert ev["ts_ms"] == 600_000.0 + 1_000.0
+
+
+def test_shadow_ring_concurrent_writers_stay_bounded():
+    ring = blackbox.ShadowRing("w0", maxlen=256)
+    errors = []
+
+    def writer(tid):
+        try:
+            for i in range(500):
+                ring.note("ev", tid=tid, i=i)
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(ring) == 256
+    json.dumps(ring.export())
+
+
+# -------------------------------------------- telemetry-ring churn hygiene
+
+
+def test_trace_ring_and_timeline_registry_churn():
+    try:
+        tracer = tracing.configure(sample=1.0, ring=8)
+        for i in range(20):
+            with tracer.start_span(f"s{i}"):
+                pass
+        # the span ring is bounded at the configured capacity
+        assert len(tracer.snapshot()) == 8
+
+        tl = tracing.StepTimeline(capacity=4)
+        for i in range(10):
+            tl.add("step", i=i)
+        assert [e["i"] for e in tl.snapshot()] == [6, 7, 8, 9]
+        tracing.register_timeline("churn-tl", tl)
+        assert "churn-tl" in tracing.timelines_snapshot()
+        # weakref registry: dropping the last strong ref evicts the entry
+        del tl
+        assert "churn-tl" not in tracing.timelines_snapshot()
+    finally:
+        tracing.configure()  # restore env defaults for later tests
+
+
+def test_tracing_jsonl_export_round_trips(tmp_path):
+    """The DL fix: span attributes are coerced JSON-safe at RECORD time,
+    so the JSONL export parses with json.loads (never a repr-poisoned
+    default=repr line) even for bytes/objects/int-keyed dicts."""
+    path = tmp_path / "trace.jsonl"
+    try:
+        tracer = tracing.configure(sample=1.0, ring=32, jsonl=str(path))
+        with tracer.start_span("weird", attributes={
+                "raw": b"\xff\xfe", "obj": object(),
+                "nested": {1: {"x": (1, 2)}}}) as sp:
+            sp.set_attribute("late", {3, 1, 2})
+        lines = [ln for ln in path.read_text().splitlines() if ln]
+        assert lines
+        rec = json.loads(lines[-1])
+        assert rec["name"] == "weird"
+        # bytes fell back to hex, the object became a repr STRING, the
+        # int dict key became a string key — all plain JSON
+        assert rec["attributes"]["raw"] == b"\xff\xfe".hex()
+        assert isinstance(rec["attributes"]["obj"], str)
+        assert rec["attributes"]["nested"]["1"] == {"x": [1, 2]}
+        assert sorted(rec["attributes"]["late"]) == [1, 2, 3]
+    finally:
+        tracing.configure()
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def _sim_recorder(clock, **kw):
+    kw.setdefault("window_s", 10.0)
+    kw.setdefault("cooldown_s", 60.0)
+    kw.setdefault("out_dir", None)
+    kw.setdefault("triggers", "all")
+    kw.setdefault("include_process_state", False)
+    return blackbox.FlightRecorder(clock=clock, wall=clock, **kw)
+
+
+def test_recorder_trip_debounce_and_eviction():
+    clock = FakeClock(1_000.0)
+    rec = _sim_recorder(clock, max_incidents=2)
+    rec.note("w0", "request", rid="r1")
+    rec.note("w1", "request", rid="r2")
+
+    b1 = rec.trip("manual", {"via": "test"})
+    assert b1 is not None and b1["trigger"] == "manual"
+    assert sorted(b1["workers"]) == ["w0", "w1"]
+    assert rec.captures_total == 1
+    # debounce: a second trip inside the cooldown is suppressed
+    clock.advance(1.0)
+    assert rec.trip("manual") is None
+    assert rec.suppressed_total == 1
+    assert 0 < rec.cooldown_remaining_s() <= 60.0
+    # cooldown elapsed: captures again
+    clock.advance(60.0)
+    b2 = rec.trip("breaker_open", {"failures": 3})
+    assert b2 is not None and b2["id"] != b1["id"]
+    clock.advance(61.0)
+    b3 = rec.trip("manual")
+    # bounded incident table: the oldest bundle evicted at max_incidents=2
+    assert rec.get(b1["id"]) is None
+    assert rec.get(b2["id"]) is not None
+    rows = rec.incidents_summary()
+    assert [r["id"] for r in rows] == [b3["id"], b2["id"]]  # newest first
+
+
+def test_recorder_trigger_filter_and_disabled():
+    clock = FakeClock()
+    rec = _sim_recorder(clock, triggers="breaker_open", cooldown_s=0.0)
+    assert rec.trip("manual") is None          # filtered out
+    assert rec.trip("breaker_open") is not None
+    off = _sim_recorder(clock, window_s=0.0)
+    assert not off.enabled
+    assert off.trip("breaker_open") is None    # disarmed: never captures
+
+
+def test_recorder_contribute_and_remote_stub():
+    clock = FakeClock(10.0)
+    rec = _sim_recorder(clock, cooldown_s=0.0)
+    rec.note("local", "request", rid="r1")
+    bundle = rec.trip("manual")
+    ok = rec.contribute(bundle["id"],
+                        {"sibling": {"anchors": {}, "events": []}},
+                        origin="sibling")
+    assert ok
+    assert sorted(bundle["workers"]) == ["local", "sibling"]
+    assert bundle["contributed"] == ["sibling"]
+    assert not rec.contribute("nope", {}, origin="x")  # unknown id
+    # a sibling's announcement opens a local stub carrying OUR rings,
+    # bypassing the cooldown (the debounce belongs to the originator)
+    stub = rec.observe_remote("incident-far", "slo_burn_rate",
+                              origin="w9", at_ms=123.0)
+    assert stub["remote"] and stub["origin"] == "w9"
+    assert "local" in stub["workers"]
+    assert rec.get("incident-far") is not None
+
+
+def test_deadline_storm_trigger():
+    clock = FakeClock(0.0)
+    rec = _sim_recorder(clock, cooldown_s=0.0)
+    blackbox.configure(recorder=rec)
+    # 7 timeouts spread inside the window: no storm yet
+    for _ in range(blackbox.STORM_N - 1):
+        clock.advance(0.1)
+        blackbox.note_deadline()
+    assert rec.captures_total == 0
+    clock.advance(0.1)
+    blackbox.note_deadline()               # the Nth inside the window
+    assert rec.captures_total == 1
+    (row,) = rec.incidents_summary()
+    assert row["trigger"] == "deadline_storm"
+    # slow drip (outside STORM_WINDOW_S) never trips
+    for _ in range(blackbox.STORM_N * 2):
+        clock.advance(blackbox.STORM_WINDOW_S)
+        blackbox.note_deadline()
+    assert rec.captures_total == 1
+
+
+def test_breaker_open_trips_the_recorder():
+    clock = FakeClock()
+    rec = _sim_recorder(clock, cooldown_s=0.0)
+    blackbox.configure(recorder=rec)
+    br = guard.CircuitBreaker(
+        guard.BreakerConfig(threshold=2, probe_every=2), clock=clock)
+    br.record_failure()
+    assert rec.captures_total == 0         # below threshold: no trip
+    br.record_failure()                    # closed -> open
+    assert rec.captures_total == 1
+    (row,) = rec.incidents_summary()
+    assert row["trigger"] == "breaker_open"
+    detail = rec.get(row["id"])["detail"]
+    assert detail["failures"] == 2 and detail["opened_total"] == 1
+
+
+def test_module_note_is_noop_without_armed_recorder():
+    # nothing configured: the hot-path entry points must not build a
+    # recorder as a side effect
+    blackbox.note("w0", "ev", i=1)
+    blackbox.note_deadline()
+    clock = FakeClock()
+    off = _sim_recorder(clock, window_s=0.0)
+    blackbox.configure(recorder=off)
+    blackbox.note("w0", "ev", i=2)
+    assert len(off.rings) == 0             # disarmed recorder grew nothing
+
+
+def test_capture_frame_infers_and_round_trips():
+    from dynamo_tpu.runtime import wire
+    frame = blackbox.capture_header("incident-1", "manual", "w0",
+                                    at_ms=12.5,
+                                    rings={"w0": {"anchors": {},
+                                                  "events": []}})
+    assert wire.infer_frame(frame).name == "blackbox.capture"
+    assert wire.decoded(wire.BLACKBOX_CAPTURE, frame)["incident_id"] \
+        == "incident-1"
+
+
+# ------------------------------------------------------------ HTTP surface
+
+
+def test_http_debug_surface_and_incident_endpoints(run_async, tmp_path):
+    async def main():
+        import aiohttp
+
+        from dynamo_tpu.llm.http.service import HttpService
+
+        rec = blackbox.configure(window_s=30.0, cooldown_s=60.0,
+                                 out_dir=str(tmp_path), triggers="all")
+        blackbox.note("w0", "request", rid="r1")
+        blackbox.note("w1", "request", rid="r2")
+        service = HttpService()
+        await service.start(host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        try:
+            async with aiohttp.ClientSession() as http:
+                # bounded listings accept ?limit= / ?since_ms=
+                async with http.get(f"{base}/v1/traces",
+                                    params={"limit": 5,
+                                            "since_ms": 0}) as r:
+                    assert r.status == 200
+                    body = await r.json()
+                    assert {"traces", "engine_steps",
+                            "engine_step_anchors"} <= set(body)
+                async with http.get(f"{base}/v1/traces",
+                                    params={"limit": "bogus"}) as r:
+                    assert r.status == 400
+                async with http.get(f"{base}/debug/profile/stacks",
+                                    params={"limit": 10}) as r:
+                    assert r.status == 200
+                async with http.get(f"{base}/debug/profile/stacks",
+                                    params={"since_ms": "junk"}) as r:
+                    assert r.status == 400
+
+                # manual capture
+                async with http.post(f"{base}/debug/incidents/capture") as r:
+                    assert r.status == 200
+                    cap = await r.json()
+                assert sorted(cap["workers"]) == ["w0", "w1"]
+                # second capture inside the cooldown: 409 + Retry-After
+                async with http.post(f"{base}/debug/incidents/capture") as r:
+                    assert r.status == 409
+                    assert int(r.headers["Retry-After"]) >= 1
+                async with http.get(f"{base}/debug/incidents") as r:
+                    listing = await r.json()
+                assert listing["enabled"] and listing["captures_total"] == 1
+                assert listing["incidents"][0]["id"] == cap["id"]
+                async with http.get(
+                        f"{base}/debug/incidents/{cap['id']}") as r:
+                    assert r.status == 200
+                    bundle = json.loads(await r.text())
+                async with http.get(f"{base}/debug/incidents/nope") as r:
+                    assert r.status == 404
+        finally:
+            await service.stop()
+
+        # the bundle persisted under DYN_BLACKBOX_DIR, byte-identical to
+        # the served serialization, and the postmortem renderer eats it
+        persisted = (tmp_path / f"{cap['id']}.json").read_text()
+        assert persisted == blackbox.render_bundle_json(bundle)
+        from dynamo_tpu.admin.incident import render_postmortem
+        text = render_postmortem(bundle)
+        assert cap["id"] in text and "manual" in text
+        assert rec.get(cap["id"]) is not None
+        return True
+
+    assert run_async(main())
+
+
+# --------------------------------------------------- fleet-sim determinism
+
+
+def test_fleet_incident_scenario_deterministic_bundle(run_async):
+    """The tentpole acceptance: the deterministic fleet-sim ``incident``
+    scenario trips a burn-rate capture AFTER the injected crash and
+    produces a byte-identical bundle per seed, with shadow rings
+    contributed by >= 2 sim workers over the real DCP fan-out."""
+    from dynamo_tpu.fleet.harness import run_scenario
+    from dynamo_tpu.fleet.scenarios import get_scenario
+
+    r1 = run_async(run_scenario(get_scenario("incident"), seed=0))
+    r2 = run_async(run_scenario(get_scenario("incident"), seed=0))
+
+    b1, b2 = r1["incident"], r2["incident"]
+    assert b1.get("trigger") == "slo_burn_rate", b1
+    assert blackbox.render_bundle_json(b1) == blackbox.render_bundle_json(b2)
+    sim_workers = [w for w in b1["workers"] if w.startswith("w")]
+    assert len(sim_workers) >= 2
+    # the contributions arrived over the wire, not by local aggregation
+    assert len([c for c in b1["contributed"]
+                if c.startswith("w")]) >= 2
+    assert any(b1["workers"][w]["events"] for w in sim_workers)
+    # the crash fault the alert postdates is on the harness ring
+    harness_events = b1["workers"]["sim-harness"]["events"]
+    assert any(e["kind"] == "fault" for e in harness_events)
+    # the renderer consumes the sim bundle without error
+    from dynamo_tpu.admin.incident import render_postmortem
+    assert "slo_burn_rate" in render_postmortem(b1)
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def test_e2e_breaker_open_capture_served_and_rendered(run_async, tmp_path):
+    """Severed request plane -> breaker opens -> the breaker_open trigger
+    captures on the live recorder -> the bundle is served by
+    GET /debug/incidents/{id} -> the admin renderer renders it. The
+    whole dynablack loop against the real DCP + HTTP stack."""
+
+    async def main():
+        import aiohttp
+
+        from dynamo_tpu.llm.http.service import HttpService
+        from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+        blackbox.configure(window_s=60.0, cooldown_s=120.0,
+                           out_dir=str(tmp_path), triggers="all")
+        drt = await DistributedRuntime.detached()
+        service = HttpService()
+        await service.start(host="127.0.0.1", port=0)
+        try:
+            async def handler(request, ctx):
+                yield {"ok": True}
+
+            ep = drt.namespace("bb").component("w").endpoint("gen")
+            handle = await ep.serve(handler)
+            client = await ep.client()
+            await client.wait_for_instances(timeout=5)
+            wid = client.instance_ids()[0]
+            blackbox.note(f"{wid:x}", "serving", state="up")
+
+            # sever the worker's request plane: unsubscribe the handlers
+            # but keep the discovery record (crashed-but-leased worker)
+            for sid in handle._sids:
+                await drt.dcp.unsubscribe(sid)
+            handle._sids.clear()
+
+            client.retry = guard.RetryPolicy(max_attempts=1)
+            for _ in range(client.breakers.cfg.threshold):
+                with pytest.raises(Exception):
+                    await client.round_robin({"x": 1}, timeout=0.5)
+            assert client.breakers.get("request", wid).state \
+                == guard.BREAKER_OPEN
+
+            rec = blackbox.get_recorder()
+            rows = [r for r in rec.incidents_summary()
+                    if r["trigger"] == "breaker_open"]
+            assert rows, "breaker open never tripped a capture"
+            iid = rows[0]["id"]
+
+            base = f"http://127.0.0.1:{service.port}"
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"{base}/debug/incidents/{iid}") as r:
+                    assert r.status == 200
+                    bundle = json.loads(await r.text())
+            assert bundle["trigger"] == "breaker_open"
+            assert f"{wid:x}" in bundle["workers"]
+            # live capture folds the process telemetry planes
+            assert {"guard_counters", "breakers", "caches",
+                    "loop_lag"} <= set(bundle["telemetry"])
+            assert (tmp_path / f"{iid}.json").exists()
+
+            from dynamo_tpu.admin.incident import render_postmortem
+            text = render_postmortem(bundle)
+            assert "breaker_open" in text and f"{wid:x}" in text
+
+            await client.close()
+        finally:
+            await service.stop()
+            await drt.shutdown()
+        return True
+
+    assert run_async(main())
+
+
+# --------------------------------------------- Prometheus exposition hygiene
+
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+(\S+)$')
+_LABELS_RE = re.compile(
+    r'^\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}$')
+
+
+def _check_exposition(text: str, plane: str):
+    help_seen, type_seen = {}, {}
+    sample_names = set()
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# HELP "):
+            _, _, rest = ln.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            assert help_seen.get(name, help_) == help_, \
+                f"{plane}: conflicting HELP for {name}"
+            help_seen[name] = help_
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, rest = ln.partition("# TYPE ")
+            name, _, typ = rest.partition(" ")
+            assert typ in ("counter", "gauge", "histogram", "summary"), \
+                f"{plane}: bad TYPE {typ!r} for {name}"
+            assert type_seen.get(name, typ) == typ, \
+                f"{plane}: conflicting TYPE for {name}"
+            type_seen[name] = typ
+            continue
+        if ln.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"{plane}: malformed sample line {ln!r}"
+        name, labels, value = m.groups()
+        if labels:
+            assert _LABELS_RE.match(labels), \
+                f"{plane}: malformed labels in {ln!r}"
+        float(value)  # parses as a number (inf/nan included)
+        sample_names.add(name)
+    # every sample belongs to a declared family (histogram suffixes fold)
+    for name in sample_names:
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if family not in type_seen and name.endswith(suffix):
+                family = name[:-len(suffix)]
+        assert family in type_seen, \
+            f"{plane}: sample {name} has no TYPE declaration"
+    # dyn_* charset (the regex above enforced it; keep the explicit gate)
+    for name in sample_names | set(type_seen):
+        assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name)
+    return type_seen
+
+
+def test_prometheus_exposition_hygiene_both_planes():
+    from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.metrics.component import MetricsAggregator
+
+    m = Metrics()
+    m.requests_total[("m1", "completions", "unary", "200")] += 1
+    m.inflight["m1"] = 2
+    m.observe_duration("m1", 0.25)
+    m.observe_ttft("m1", 0.1)
+    m.itl.observe("m1", 0.01)
+    m.stage.observe("prefill", 0.2)
+    m.count_output_tokens("m1", 7)
+    frontend_types = _check_exposition(m.render(), "frontend")
+    assert any(n.startswith("dyn_") for n in frontend_types)
+
+    agg = MetricsAggregator(None, "ns", "c")
+    agg_types = _check_exposition(agg.render_prometheus(), "aggregator")
+    assert any(n.startswith("dyn_") for n in agg_types)

@@ -442,6 +442,10 @@ FRAME_EXAMPLES = {
     "tcp.complete": {"t": "complete"},
     "tcp.err": {"t": "err", "message": "boom", "kind": "ValueError"},
     "tcp.ctrl": {"t": "ctrl", "kind": "stop"},
+    "blackbox.capture": {"event": "blackbox.capture",
+                         "incident_id": "incident-1", "trigger": "manual",
+                         "worker_label": "w0", "at_ms": 1000.0,
+                         "rings": {"w0": {"anchors": {}, "events": []}}},
 }
 
 

@@ -119,5 +119,21 @@ run_step dynahot_shared 2400 --scenario shared \
 python -m tools.cost_diff "$OUT/hotpath_full.json" \
     "$OUT/dynahot_hotpath_full.json" > "$OUT/dynahot_cost_diff.txt" 2>&1 || true
 
+# 14. dynablack armed-vs-off A/B + mid-bench capture (ISSUE 19): the
+#     hotpath workload with the flight recorder armed (default window)
+#     must match the disarmed arm within noise — the zero-measured-cost
+#     acceptance bar — and the armed run trips a manual capture whose
+#     bundle is archived next to the BENCH report and rendered to a
+#     postmortem transcript as the renderer-never-errors proof.
+DYN_BLACKBOX_WINDOW_S=0 run_step blackbox_off 1800 --scenario hotpath \
+    --prof-sample 2 --report-out "$OUT/blackbox_off_full.json"
+run_step blackbox_armed 1800 --scenario hotpath --prof-sample 2 \
+    --trip-incident --report-out "$OUT/blackbox_armed_full.json"
+python -m tools.cost_diff "$OUT/blackbox_off_full.json" \
+    "$OUT/blackbox_armed_full.json" > "$OUT/blackbox_cost_diff.txt" 2>&1 || true
+python -m dynamo_tpu.admin.incident \
+    "$OUT/blackbox_armed_full.incident.json" \
+    > "$OUT/blackbox_postmortem.txt" 2>&1 || true
+
 echo "=== chip session complete; results in $OUT/ ==="
 grep -h . "$OUT"/*.json 2>/dev/null | head -20

@@ -33,3 +33,13 @@ fi
 
 echo "precommit: dynalint --changed"
 python -m tools.dynalint --changed
+
+# 3. Prometheus exposition hygiene (ISSUE 19 satellite): both metric
+#    planes (frontend Metrics + fleet aggregator) must render exposition
+#    with consistent HELP/TYPE per family and well-formed dyn_* names —
+#    a malformed scrape silently drops the whole plane in most
+#    collectors, which is exactly the blind spot dynablack exists to
+#    close. Seconds on CPU; runs on every commit.
+echo "precommit: prometheus exposition hygiene"
+JAX_PLATFORMS=cpu python -m pytest -q tests/test_blackbox.py \
+    -k exposition -p no:cacheprovider
