@@ -66,6 +66,17 @@ def _cancel_reason(ctx: Context) -> str:
     return FINISH_TIMEOUT if ctx.expired else FINISH_CANCELLED
 
 
+def _stamp_dispatch(fence: CompileFence, name: str, fn):
+    """Wrap a jitted step fn so every dispatch notes its call form on
+    the engine's compile fence. The note is raw refs (one attribute
+    store); jit_fence renders it into a dtype[shape] call-form key only
+    when a post-warmup compile actually trips the fence."""
+    def call(*args, **kwargs):
+        fence.note_dispatch(name, args, kwargs)
+        return fn(*args, **kwargs)
+    return call
+
+
 @dataclass
 class EngineConfig:
     page_size: int = 64
@@ -91,6 +102,14 @@ class EngineConfig:
     # requests share a compiled window variant (the per-row requested
     # count is sliced host-side)
     max_top_logprobs: int = 20
+    # pre-compile the logprobs decode-window variants (logprobs_topn is
+    # a STATIC argname: serving flips it from 0 to max_top_logprobs on
+    # the first request that asks for logprobs, and each value is its
+    # own program per bucket). On by default — logprobs is a stock
+    # OpenAI-API field any client can send, so unlike penalties the
+    # unwarmed form is routinely reachable (DL026 warmup-form-drift
+    # finding, previously a runtime compile-fence trip class)
+    warmup_logprobs: bool = True
     # pre-compile the penalized decode-window variants too (doubles the
     # decode programs in warmup). Off by default: most deployments never
     # send sampling penalties, and a first penalty request merely pays
@@ -598,6 +617,16 @@ class JaxEngine:
         # dyn_engine_post_warmup_compiles_total.
         self.fence = CompileFence(f"jax-engine-{id(self):x}",
                                   timeline=self.step_timeline)
+        # stamp every fenced jit dispatch with its call form so a fence
+        # trip can name the offending form (jit name + operand
+        # dtype[shape] + static kwargs). note_dispatch stores raw refs
+        # only; rendering happens on the trip path, never per dispatch.
+        for _attr in ("prefill_fn", "decode_fn", "decode_multi_fn",
+                      "verify_fn", "long_prefill_fn"):
+            _fn = getattr(self, _attr, None)
+            if _fn is not None:
+                setattr(self, _attr,
+                        _stamp_dispatch(self.fence, _attr, _fn))
         # dynaprof: sampled device/host dispatch timing + per-bucket cost
         # (engine/profiler.py; sample=0 keeps the hot path sync-free)
         self.profiler = EngineProfiler(f"jax-engine-{id(self):x}",
@@ -690,11 +719,20 @@ class JaxEngine:
                     # _sample_device always passes penalties=, and warming
                     # the omitted form left every serving bucket one
                     # compile short (found by the compile fence)
-                    sample_tokens(logits, jnp.zeros(PB),
-                                  jnp.zeros(PB, jnp.int32), jnp.ones(PB),
-                                  jnp.zeros(PB, jnp.uint32),
-                                  jnp.zeros(PB, jnp.int32),
-                                  max_top_k=ecfg.max_top_k, penalties=None)
+                    toks = sample_tokens(
+                        logits, jnp.zeros(PB),
+                        jnp.zeros(PB, jnp.int32), jnp.ones(PB),
+                        jnp.zeros(PB, jnp.uint32),
+                        jnp.zeros(PB, jnp.int32),
+                        max_top_k=ecfg.max_top_k, penalties=None)
+                    if ecfg.warmup_logprobs and ecfg.max_top_logprobs > 0:
+                        # _sample_device runs logprob_aux EAGERLY after
+                        # every prefill/decode dispatch that asked for
+                        # logprobs, so its op-by-op executables compile
+                        # per logits bucket on the first such request —
+                        # a fence trip the jitted-window variants above
+                        # don't cover (DL026, same finding class)
+                        logprob_aux(logits, toks, ecfg.max_top_logprobs)
                     n += 1
             for B in (grid["decode_batches"] if decode else []):
                 tableB = jnp.zeros((B, P), jnp.int32)
@@ -711,58 +749,86 @@ class JaxEngine:
                             jnp.zeros((B, V), jnp.int32),
                             jnp.zeros((B, V), jnp.int8),
                             jnp.ones(B), jnp.zeros(B), jnp.zeros(B)))
+                    # logprobs_topn is a STATIC argname: serving flips it
+                    # to max_top_logprobs for any window with a logprobs
+                    # request, so each value is its own program per
+                    # bucket — warm both or the first logprobs request
+                    # compiles mid-serving (DL026 warmup-form-drift)
+                    topn_variants = [0]
+                    if ecfg.warmup_logprobs and ecfg.max_top_logprobs > 0:
+                        topn_variants.append(ecfg.max_top_logprobs)
                     for pv in pen_variants:
-                        # logprobs_topn=0 explicitly, matching the serving
-                        # call form in _dispatch_decode_window — the jit
-                        # cache distinguishes explicit static kwargs from
-                        # omitted defaults (compile-fence finding, same
-                        # class as the penalties=None note above)
-                        (toks, _emitted, _carry, self.kv_k,
-                         self.kv_v) = self.decode_multi_fn(
-                            self.params, jnp.zeros(B, jnp.int32),
-                            jnp.zeros(B, jnp.int32) - 1,
-                            jnp.zeros(B, bool), jnp.zeros(B, jnp.int32),
-                            jnp.ones(B, jnp.int32), self.kv_k, self.kv_v,
-                            tableB, jnp.zeros(B), jnp.zeros(B, jnp.int32),
-                            jnp.ones(B), jnp.zeros(B, jnp.uint32),
-                            jnp.full((B, ecfg.max_eos_ids), -1, jnp.int32),
-                            pv, k_steps=ecfg.decode_steps,
-                            logprobs_topn=0)
-                        if pv is None and self.mesh is not None:
-                            # committed-carry variant: under a mesh the
-                            # pipelined window's (tok, pos, done, steps,
-                            # remaining) arrive COMMITTED (NamedSharding
-                            # outputs of the previous window /
-                            # _merge_carry) while the host-array call
-                            # above is uncommitted — DIFFERENT jit cache
-                            # entries, so without this the first chained
-                            # window would compile mid-serving (found by
-                            # the compile fence on the first sharded
-                            # engine). Feed the window its own carry to
-                            # warm that variant; save it for the
-                            # merge-combo loop below.
-                            carries[B] = _carry
-                            (toks, _emitted, _carry, self.kv_k,
-                             self.kv_v) = self.decode_multi_fn(
-                                self.params, *_carry, self.kv_k,
+                        for topn in topn_variants:
+                            # kwargs explicitly, matching the serving
+                            # call form in _dispatch_decode_window — the
+                            # jit cache distinguishes explicit static
+                            # kwargs from omitted defaults (compile-fence
+                            # finding, same class as the penalties=None
+                            # note above)
+                            out = self.decode_multi_fn(
+                                self.params, jnp.zeros(B, jnp.int32),
+                                jnp.zeros(B, jnp.int32) - 1,
+                                jnp.zeros(B, bool), jnp.zeros(B, jnp.int32),
+                                jnp.ones(B, jnp.int32), self.kv_k,
                                 self.kv_v, tableB, jnp.zeros(B),
-                                jnp.zeros(B, jnp.int32), jnp.ones(B),
-                                jnp.zeros(B, jnp.uint32),
+                                jnp.zeros(B, jnp.int32),
+                                jnp.ones(B), jnp.zeros(B, jnp.uint32),
                                 jnp.full((B, ecfg.max_eos_ids), -1,
                                          jnp.int32),
                                 pv, k_steps=ecfg.decode_steps,
-                                logprobs_topn=0)
-                            n += 1
+                                logprobs_topn=topn)
+                            if topn:
+                                (toks, _emitted, _aux, _carry, self.kv_k,
+                                 self.kv_v) = out
+                                n += 1
+                            else:
+                                (toks, _emitted, _carry, self.kv_k,
+                                 self.kv_v) = out
+                            if pv is None and self.mesh is not None:
+                                # committed-carry variant: under a mesh
+                                # the pipelined window's (tok, pos, done,
+                                # steps, remaining) arrive COMMITTED
+                                # (NamedSharding outputs of the previous
+                                # window / _merge_carry) while the
+                                # host-array call above is uncommitted —
+                                # DIFFERENT jit cache entries, so without
+                                # this the first chained window would
+                                # compile mid-serving (found by the
+                                # compile fence on the first sharded
+                                # engine). Feed the window its own carry
+                                # to warm that variant; save it for the
+                                # merge-combo loop below.
+                                if topn == 0:
+                                    carries[B] = _carry
+                                out = self.decode_multi_fn(
+                                    self.params, *_carry, self.kv_k,
+                                    self.kv_v, tableB, jnp.zeros(B),
+                                    jnp.zeros(B, jnp.int32), jnp.ones(B),
+                                    jnp.zeros(B, jnp.uint32),
+                                    jnp.full((B, ecfg.max_eos_ids), -1,
+                                             jnp.int32),
+                                    pv, k_steps=ecfg.decode_steps,
+                                    logprobs_topn=topn)
+                                if topn:
+                                    (toks, _emitted, _aux, _carry,
+                                     self.kv_k, self.kv_v) = out
+                                else:
+                                    (toks, _emitted, _carry, self.kv_k,
+                                     self.kv_v) = out
+                                n += 1
                 else:
                     logits, self.kv_k, self.kv_v = self.decode_fn(
                         self.params, jnp.zeros(B, jnp.int32),
                         jnp.zeros(B, jnp.int32) - 1, self.kv_k, self.kv_v,
                         tableB, jnp.full((B,), DROP_SLOT, jnp.int32))
-                    sample_tokens(logits, jnp.zeros(B),
-                                  jnp.zeros(B, jnp.int32),
-                                  jnp.ones(B), jnp.zeros(B, jnp.uint32),
-                                  jnp.zeros(B, jnp.int32),
-                                  max_top_k=ecfg.max_top_k, penalties=None)
+                    toks = sample_tokens(
+                        logits, jnp.zeros(B),
+                        jnp.zeros(B, jnp.int32),
+                        jnp.ones(B), jnp.zeros(B, jnp.uint32),
+                        jnp.zeros(B, jnp.int32),
+                        max_top_k=ecfg.max_top_k, penalties=None)
+                    if ecfg.warmup_logprobs and ecfg.max_top_logprobs > 0:
+                        logprob_aux(logits, toks, ecfg.max_top_logprobs)
                 if self.verify_fn is not None:
                     # speculative verify grid: one [B, K+1] program per
                     # (B, P) bucket + the accept-mask program per B
@@ -794,10 +860,13 @@ class JaxEngine:
                 self.kv_k, self.kv_v = scatter_prefill_kv(
                     self.kv_k, self.kv_v, k_all, v_all,
                     jnp.full((1, t), DROP_SLOT, jnp.int32))
-                sample_tokens(logits, jnp.zeros(1), jnp.zeros(1, jnp.int32),
-                              jnp.ones(1), jnp.zeros(1, jnp.uint32),
-                              jnp.zeros(1, jnp.int32),
-                              max_top_k=ecfg.max_top_k, penalties=None)
+                toks = sample_tokens(
+                    logits, jnp.zeros(1), jnp.zeros(1, jnp.int32),
+                    jnp.ones(1), jnp.zeros(1, jnp.uint32),
+                    jnp.zeros(1, jnp.int32),
+                    max_top_k=ecfg.max_top_k, penalties=None)
+                if ecfg.warmup_logprobs and ecfg.max_top_logprobs > 0:
+                    logprob_aux(logits, toks, ecfg.max_top_logprobs)
                 n += 1
                 if t >= self.cap_tokens:
                     break

@@ -23,6 +23,14 @@ Every compile also lands a ``compile`` event in the engine's dyntrace
 step timeline, so ``/v1/traces`` shows exactly where in the serving
 schedule the stall happened.
 
+The monitoring event carries only a duration — no call info — so the
+engine stamps every fenced jit dispatch via ``note_dispatch`` (one
+attribute store of raw refs, no formatting on the hot path). When the
+fence trips, warn/raise messages and the blackbox trigger render that
+note lazily into a call-form key (jit name + per-operand dtype[shape]
+and static kwarg values): the runtime twin of dynaform's DL026
+warmup-form-drift key.
+
 The JAX monitoring API has no unregister, so ONE process-wide listener
 is installed lazily and dispatches to live fences (weakly referenced —
 a dropped engine stops counting). Compiles are process-global: with two
@@ -83,6 +91,48 @@ class CompileFence:
         self._mode_override = mode
         self.armed = False
         self.post_warmup_compiles = 0
+        # (jit name, args, kwargs) of the most recent fenced dispatch —
+        # raw refs only; the call-form summary is rendered lazily when a
+        # fence trips (never on the dispatch hot path)
+        self._last_dispatch: Optional[tuple] = None
+
+    def note_dispatch(self, name: str, args: tuple = (),
+                      kwargs: Optional[dict] = None) -> None:
+        """Stamp the jitted call about to run. The compile monitoring
+        event carries only a duration, so when the fence trips this note
+        is the only way to name the offending call form. Cheap by
+        design: one attribute store, no formatting."""
+        self._last_dispatch = (name, args, kwargs)
+
+    @staticmethod
+    def _summ(x, depth: int = 0) -> str:
+        dt = getattr(x, "dtype", None)
+        sh = getattr(x, "shape", None)
+        if dt is not None and sh is not None:
+            return f"{dt}[{','.join(str(d) for d in sh)}]"
+        if x is None or isinstance(x, (bool, int, float, str)):
+            return repr(x)
+        if isinstance(x, (tuple, list)) and depth < 2:
+            inner = ", ".join(
+                CompileFence._summ(e, depth + 1) for e in x[:4])
+            if len(x) > 4:
+                inner += f", ...{len(x)} items"
+            return f"({inner})"
+        return type(x).__name__
+
+    def last_dispatch_form(self) -> str:
+        """Render the most recent dispatch as a call-form key: jit name
+        plus per-operand dtype[shape] / static-value summary."""
+        if self._last_dispatch is None:
+            return "<no dispatch recorded>"
+        name, args, kwargs = self._last_dispatch
+        try:
+            parts = [self._summ(a) for a in args]
+            for k, v in (kwargs or {}).items():
+                parts.append(f"{k}={self._summ(v)}")
+            return f"{name}({', '.join(parts)})"
+        except Exception:  # never let diagnostics mask the real trip
+            return f"{name}(<unprintable args>)"
 
     @property
     def mode(self) -> str:
@@ -121,6 +171,7 @@ class CompileFence:
             "fence": self.name,
             "duration_ms": round(duration_secs * 1e3, 3),
             "post_warmup_total": self.post_warmup_compiles,
+            "last_dispatch_form": self.last_dispatch_form(),
         })
         mode = self.mode
         if mode == "raise":
@@ -129,10 +180,13 @@ class CompileFence:
                 f"({duration_secs * 1e3:.1f} ms, "
                 f"{self.post_warmup_compiles} total): an unbucketed "
                 f"shape or request-varying static arg reached a jitted "
-                f"call — see dynajit (docs/static_analysis.md)")
+                f"call — last dispatched form: "
+                f"{self.last_dispatch_form()} — see dynajit/dynaform "
+                f"(docs/static_analysis.md)")
         if mode == "warn":
             log.warning(
                 "XLA compile after warmup on %s (%.1f ms, %d total): "
                 "an unbucketed shape or request-varying static arg "
-                "reached a jitted call", self.name, duration_secs * 1e3,
-                self.post_warmup_compiles)
+                "reached a jitted call — last dispatched form: %s",
+                self.name, duration_secs * 1e3,
+                self.post_warmup_compiles, self.last_dispatch_form())

@@ -61,6 +61,42 @@ def test_fence_warn_mode_logs(caplog):
                for r in caplog.records)
 
 
+def test_fence_messages_name_last_dispatched_form(caplog):
+    """A tripped fence names the offending call form — jit name plus
+    per-operand dtype[shape] and static kwarg values — from the note the
+    engine's dispatch wrapper stamps via note_dispatch (raw refs on the
+    hot path, rendered only here on the trip path)."""
+    fence = CompileFence("t2b", mode="warn")
+    assert fence.last_dispatch_form() == "<no dispatch recorded>"
+    fence.note_dispatch("decode_multi_fn",
+                        (jnp.zeros((2, 8), jnp.bfloat16), 3),
+                        {"k_steps": 2, "logprobs_topn": 20})
+    form = fence.last_dispatch_form()
+    assert form.startswith("decode_multi_fn(")
+    assert "bfloat16[2,8]" in form
+    assert "logprobs_topn=20" in form
+    fence.arm()
+    with caplog.at_level(logging.WARNING, "dynamo_tpu.engine.fence"):
+        _fresh_jit_compile(107)
+    fence.disarm()
+    assert any("last dispatched form" in r.getMessage()
+               and "decode_multi_fn(" in r.getMessage()
+               for r in caplog.records)
+
+
+def test_fence_raise_mode_names_form():
+    fence = CompileFence("t3b", mode="raise")
+    fence.note_dispatch("prefill_fn",
+                        (jnp.zeros((4,), jnp.int32),), None)
+    fence.arm()
+    try:
+        with pytest.raises(PostWarmupCompileError,
+                           match=r"prefill_fn\(int32\[4\]\)"):
+            _fresh_jit_compile(108)
+    finally:
+        fence.disarm()
+
+
 def test_fence_raise_mode():
     fence = CompileFence("t3", mode="raise")
     fence.arm()
@@ -182,6 +218,11 @@ def test_fence_zero_compiles_mixed_workload(caplog):
         "the zero-compile serving invariant broke: a jitted engine entry "
         "compiled mid-serving (run with jax_log_compiles to locate it)")
     assert eng.stats()["post_warmup_compiles_total"] == 0
+    # the engine's dispatch wrapper stamped real step-fn call forms, so
+    # any trip above would have named the offending form
+    assert eng.fence.last_dispatch_form().split("(")[0] in {
+        "prefill_fn", "decode_fn", "decode_multi_fn", "verify_fn",
+        "long_prefill_fn"}
 
     # an intentionally unbucketed call trips warn mode
     eng.fence._mode_override = "warn"

@@ -1468,12 +1468,18 @@ def test_dl015_quiet_on_padded_gather():
     assert "DL015" not in codes and "DL017" not in codes
 
 
-def test_dl015_warmup_coverage():
-    vs = [v for v in jit_pass(("dynamo_tpu/engine/fixture.py",
-                               DL015_UNWARMED_ENTRY))
-          if v.code == "DL015"]
+def test_dl026_subsumes_dl015_warmup_coverage():
+    """The unwarmed-entry coverage check moved to dynaform wholesale:
+    DL015 keeps its shape rules and must NOT report coverage anymore,
+    and DL026 reports the unwarmed entry exactly once (no
+    double-reporting across the two passes)."""
+    assert "DL015" not in jit_codes(DL015_UNWARMED_ENTRY)
+    vs = [v for v in form_pass(("dynamo_tpu/engine/fixture.py",
+                                DL015_UNWARMED_ENTRY))
+          if v.code == "DL026"]
     assert len(vs) == 1
     assert "`other`" in vs[0].message and "warmup" in vs[0].message
+    assert vs[0].scope == "other"
 
 
 def test_dl015_suppression():
@@ -1784,7 +1790,8 @@ def test_cli_all_entry():
     out = json.loads(proc.stdout)
     assert out["violations"] == []
     assert "rule_counts" in out
-    for p in ("per_file", "dynaflow", "dynarace", "dynajit", "dynahot"):
+    for p in ("per_file", "dynaflow", "dynarace", "dynajit", "dynahot",
+              "dynaform"):
         assert out["passes"][p] >= 0
 
 
@@ -2739,3 +2746,333 @@ def test_source_cache_keys_on_content_hash(tmp_path):
     b = load_source(str(f), "dynamo_tpu/fixture_cache.py")
     assert a is not b
     assert "y" in [n.targets[0].id for n in b.tree.body]
+
+
+# --------------------------------------------- dynaform (DL025-DL027)
+
+from tools.dynalint import analyze_form  # noqa: E402
+
+
+def form_pass(*mods):
+    """Run the dynaform passes (DL025-DL027) over fixture modules."""
+    return analyze_form([parse_module(src, path) for path, src in mods])
+
+
+def form_codes(*mods):
+    return [v.code for v in form_pass(*mods)]
+
+
+ENG = "dynamo_tpu/engine/fixture.py"
+
+DL025_BAD_WIDEN = """
+import jax.numpy as jnp
+
+class Eng:
+    def _step(self):
+        bias = jnp.zeros((4,))            # fp32 default
+        return self.kv_k * 2 + bias       # bf16 (+) fp32 widens
+"""
+
+DL025_BAD_INT8 = """
+import jax.numpy as jnp
+
+class Eng:
+    def _step(self):
+        q = jnp.zeros((4, 8), jnp.int8)
+        return q * 0.5                    # int8 (+) python float -> fp32
+"""
+
+DL025_GOOD_WEAK = """
+class Eng:
+    def _step(self):
+        return self.kv_k * 0.5            # weak python float stays bf16
+"""
+
+DL025_PROMOTE_OK = """
+import jax.numpy as jnp
+
+class Eng:
+    def _step(self):
+        acc = jnp.zeros((4,))
+        # promote-ok: softmax accumulation in fp32 by design
+        return acc + self.kv_k
+"""
+
+
+def test_dl025_fires_on_fp32_widen():
+    vs = [v for v in form_pass((ENG, DL025_BAD_WIDEN))
+          if v.code == "DL025"]
+    assert len(vs) == 1
+    assert "promotes a bf16 device value to fp32" in vs[0].message
+    assert vs[0].scope == "Eng._step"
+
+
+def test_dl025_fires_on_int8_float_mix():
+    vs = [v for v in form_pass((ENG, DL025_BAD_INT8))
+          if v.code == "DL025"]
+    assert len(vs) == 1 and "4x" in vs[0].message
+
+
+def test_dl025_quiet_on_weak_scalar():
+    # bf16 (+) python float is the weak-type FAST path, not a widening
+    assert "DL025" not in form_codes((ENG, DL025_GOOD_WEAK))
+
+
+def test_dl025_promote_ok_comment():
+    assert "DL025" not in form_codes((ENG, DL025_PROMOTE_OK))
+
+
+def test_dl025_suppression():
+    src = DL025_BAD_WIDEN.replace(
+        "        return self.kv_k * 2 + bias",
+        "        # dynalint: disable=silent-dtype-promotion\n"
+        "        return self.kv_k * 2 + bias")
+    assert "DL025" not in form_codes((ENG, src))
+
+
+def test_dl025_quiet_off_hot_path():
+    # same widening in a frame no hot root reaches: not DL025's business
+    src = DL025_BAD_WIDEN.replace("def _step", "def admin_dump")
+    assert form_codes((ENG, src)) == []
+
+
+# the three historical fence findings, re-derived statically on seeds
+
+DL026_HIST_KWARGS = """
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, static_argnames=("penalties",))
+def decode(x, *, penalties=None):
+    return x
+
+class Eng:
+    def warmup(self):
+        decode(jnp.zeros((4, 8), jnp.bfloat16))
+    def _step(self):
+        decode(jnp.zeros((4, 8), jnp.bfloat16), penalties=None)
+"""
+
+DL026_HIST_CARRY = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def window(tok, kv):
+    return tok, kv
+
+class Eng:
+    def warmup(self):
+        tok = jnp.zeros((4,), jnp.int32)      # host-built: uncommitted
+        window(tok, self.kv_k)
+    def _step(self):
+        tok, self.kv_k = window(self.prev_tok, self.kv_k)
+        window(tok, self.kv_k)                # jit result: committed
+"""
+
+DL026_HIST_LISTY = """
+import jax.numpy as jnp
+
+def _pad_pow2(lst, fill):
+    return lst
+
+class Eng:
+    def warmup(self):
+        self.decode_fn(jnp.zeros((4,), jnp.int32))
+    def _drain(self, page_ids):
+        idx = jnp.asarray(_pad_pow2(list(page_ids), 0), jnp.int32)
+        return idx
+"""
+
+
+def test_dl026_historical_explicit_vs_defaulted_kwargs():
+    """PR-9 fence finding: `penalties=None` passed explicitly keys a
+    DIFFERENT jit cache entry than the warmed defaulted form."""
+    vs = [v for v in form_pass((ENG, DL026_HIST_KWARGS))
+          if v.code == "DL026"]
+    assert len(vs) == 1
+    assert "no warmup form has this arity/kwarg set" in vs[0].message
+    assert "penalties={None}" in vs[0].message   # the serving form render
+
+
+def test_dl026_historical_committed_vs_uncommitted_carry():
+    """PR-12 fence finding: refeeding a jit-result (committed) carry
+    where warmup passed a host-built (uncommitted) one recompiles under
+    a mesh."""
+    vs = [v for v in form_pass((ENG, DL026_HIST_CARRY))
+          if v.code == "DL026"]
+    assert len(vs) == 1
+    assert "different jit cache entries under a mesh" in vs[0].message
+
+
+def test_dl026_historical_listy_convert():
+    """PR-17 fence finding: `jnp.asarray(<python list>)` on the serving
+    drain lowers one tiny program per distinct pow2 padded length."""
+    vs = [v for v in form_pass((ENG, DL026_HIST_LISTY))
+          if v.code == "DL026"]
+    assert len(vs) == 1
+    assert "python list" in vs[0].message
+    assert "list-convert" in vs[0].message
+
+
+def test_dl026_quiet_when_warmup_covers_listy():
+    src = DL026_HIST_LISTY.replace(
+        "        self.decode_fn(jnp.zeros((4,), jnp.int32))",
+        "        self.decode_fn(jnp.zeros((4,), jnp.int32))\n"
+        "        jnp.asarray(_pad_pow2([0], 0), jnp.int32)")
+    assert "DL026" not in form_codes((ENG, src))
+
+
+DL026_BAD_STATIC_VALUE = """
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, static_argnames=("topn",))
+def win(x, *, topn=0):
+    return x
+
+class Eng:
+    def warmup(self):
+        win(jnp.zeros((4,), jnp.bfloat16), topn=0)
+    def _step(self, wants):
+        t = self.ecfg.max_top_logprobs if wants else 0
+        win(jnp.zeros((4,), jnp.bfloat16), topn=t)
+"""
+
+
+def test_dl026_static_kwarg_value_set_fires():
+    """static argnames key the cache per VALUE: a serving value set not
+    covered by warmup is a first-request compile (the fleet finding this
+    PR fixed: logprobs_topn flipping 0 -> max_top_logprobs)."""
+    vs = [v for v in form_pass((ENG, DL026_BAD_STATIC_VALUE))
+          if v.code == "DL026"]
+    assert len(vs) == 1
+    assert "never warmed" in vs[0].message
+    assert "cfg:max_top_logprobs" in vs[0].message
+
+
+def test_dl026_static_value_set_covered_by_warmup_loop():
+    src = DL026_BAD_STATIC_VALUE.replace(
+        "        win(jnp.zeros((4,), jnp.bfloat16), topn=0)",
+        "        variants = [0]\n"
+        "        variants.append(self.ecfg.max_top_logprobs)\n"
+        "        for t in variants:\n"
+        "            win(jnp.zeros((4,), jnp.bfloat16), topn=t)")
+    assert "DL026" not in form_codes((ENG, src))
+
+
+def test_dl026_quiet_on_matching_forms():
+    src = DL026_HIST_KWARGS.replace(
+        "        decode(jnp.zeros((4, 8), jnp.bfloat16))\n",
+        "        decode(jnp.zeros((4, 8), jnp.bfloat16), penalties=None)\n")
+    assert "DL026" not in form_codes((ENG, src))
+
+
+def test_dl026_suppression():
+    src = DL026_BAD_STATIC_VALUE.replace(
+        "        win(jnp.zeros((4,), jnp.bfloat16), topn=t)",
+        "        # dynalint: disable=warmup-form-drift\n"
+        "        win(jnp.zeros((4,), jnp.bfloat16), topn=t)")
+    assert "DL026" not in form_codes((ENG, src))
+
+
+DL027_BAD_NO_SCALE = """
+from dynamo_tpu.engine.kv_compress import dequantize_pages
+
+class Eng:
+    def _drain(self):
+        return dequantize_pages(self.staged)      # missing scale tensor
+"""
+
+DL027_BAD_DROPPED_SCALE = """
+from dynamo_tpu.engine.kv_compress import quantize_pages
+
+class Eng:
+    def _drain(self, g):
+        q, s = quantize_pages(g)
+        self.stash(q)                             # s never used
+"""
+
+DL027_GOOD_PAIR = """
+from dynamo_tpu.engine.kv_compress import (dequantize_pages,
+                                           quantize_pages)
+
+class Eng:
+    def _drain(self, g):
+        q, s = quantize_pages(g)
+        return dequantize_pages(q, s)
+"""
+
+DL027_BAD_RAW_PAGES = """
+import jax.numpy as jnp
+
+class Eng:
+    def _restore(self, idx):
+        if self.ecfg.host_tier_int8:
+            pages = self.host_k[idx]
+            self.kv_k = self.decode_fn(jnp.asarray(pages))  # raw codes
+"""
+
+DL027_BAD_FP16_MIX = """
+class Eng:
+    def _restore(self, idx):
+        if self.ecfg.host_tier_int8:
+            pass
+        else:
+            return self.host_k_s[idx]   # fp16 branch reads a scale pool
+"""
+
+
+def test_dl027_missing_scale_arg():
+    vs = [v for v in form_pass((ENG, DL027_BAD_NO_SCALE))
+          if v.code == "DL027"]
+    assert len(vs) == 1 and "without its scale tensor" in vs[0].message
+
+
+def test_dl027_dropped_scale():
+    vs = [v for v in form_pass((ENG, DL027_BAD_DROPPED_SCALE))
+          if v.code == "DL027"]
+    assert len(vs) == 1 and "`s`" in vs[0].message
+    assert "never used" in vs[0].message
+
+
+def test_dl027_quiet_on_paired_quant_dequant():
+    assert form_codes((ENG, DL027_GOOD_PAIR)) == []
+
+
+def test_dl027_raw_int8_pages_into_jit():
+    vs = [v for v in form_pass((ENG, DL027_BAD_RAW_PAGES))
+          if v.code == "DL027"]
+    assert len(vs) == 1
+    assert "without dequantize_pages" in vs[0].message
+
+
+def test_dl027_fp16_branch_touches_scale_pool():
+    vs = [v for v in form_pass((ENG, DL027_BAD_FP16_MIX))
+          if v.code == "DL027"]
+    assert len(vs) == 1 and "never mix" in vs[0].message
+
+
+def test_dl027_suppression():
+    src = DL027_BAD_NO_SCALE.replace(
+        "        return dequantize_pages(self.staged)",
+        "        # dynalint: disable=tier-dtype-contract\n"
+        "        return dequantize_pages(self.staged)")
+    assert "DL027" not in form_codes((ENG, src))
+
+
+def test_dl027_scoped_to_engine_modules():
+    # the host-side *_np pair in llm/ transfer code is out of scope
+    assert form_codes(("dynamo_tpu/llm/fixture.py",
+                       DL027_BAD_NO_SCALE)) == []
+
+
+def test_dynaform_deterministic_output():
+    mods = ((ENG, DL025_BAD_WIDEN),
+            ("dynamo_tpu/engine/fixture2.py", DL027_BAD_DROPPED_SCALE),
+            ("dynamo_tpu/engine/fixture3.py", DL026_BAD_STATIC_VALUE))
+    first = [v.render() for v in form_pass(*mods)]
+    second = [v.render() for v in form_pass(*mods)]
+    assert first and first == second

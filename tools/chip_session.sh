@@ -135,5 +135,23 @@ python -m dynamo_tpu.admin.incident \
     "$OUT/blackbox_armed_full.incident.json" \
     > "$OUT/blackbox_postmortem.txt" 2>&1 || true
 
+# 15. dynaform measured-fix re-quote (ISSUE 20): hotpath and shared
+#     after the DL026 warmup-form-drift fix (warmup pre-compiles the
+#     logprobs_topn decode-window variants, EngineConfig.warmup_logprobs).
+#     Chip numbers supersede the CPU cost_diff quoted in
+#     docs/static_analysis.md; the compile fence must stay 0 on both —
+#     on chips a missed form would cost whole seconds per bucket, which
+#     is exactly what the warmed variants buy. Diff against the step-13
+#     dynahot arm to isolate what the dynaform fix adds on top.
+run_step dynaform_hotpath 1800 --scenario hotpath --prof-sample 2 \
+    --report-out "$OUT/dynaform_hotpath_full.json"
+run_step dynaform_shared 2400 --scenario shared \
+    --report-out "$OUT/dynaform_shared_full.json"
+python -m tools.cost_diff "$OUT/dynahot_hotpath_full.json" \
+    "$OUT/dynaform_hotpath_full.json" > "$OUT/dynaform_cost_diff.txt" 2>&1 || true
+python -m tools.cost_diff "$OUT/dynahot_shared_full.json" \
+    "$OUT/dynaform_shared_full.json" \
+    >> "$OUT/dynaform_cost_diff.txt" 2>&1 || true
+
 echo "=== chip session complete; results in $OUT/ ==="
 grep -h . "$OUT"/*.json 2>/dev/null | head -20

@@ -27,7 +27,16 @@ per-frame accumulated loop depth) and enforces no loop-invariant work
 re-done per iteration (DL022), no eager formatting into log/trace
 calls on hot frames (DL023), and no unbounded ``self.<attr>``
 collection growth on the request path (DL024, justified exceptions via
-``# bounded-by: <reason>``).
+``# bounded-by: <reason>``). The **dynaform** layer (dynaform.py) types
+every expression on a dtype x provenance lattice (bf16/fp32/int8/weak
+scalars x committed/uncommitted/literal/bucketed) and enforces no
+silent weak-type widening of bf16/int8 device values in hot regions
+(DL025, justified exceptions via ``# promote-ok: <reason>``),
+warmup/serving jit call-form equivalence — arity, operand dtype and
+committedness, explicit-kwarg sets, static kwarg value sets,
+list-convert forms — so every serving-path call form is pre-compiled
+(DL026, subsuming dynajit's per-entry warmup-coverage check), and the
+int8 host-tier quantize/dequantize pairing contract (DL027).
 
 Usage:
     python -m tools.dynalint --all          # every pass, one parse
@@ -51,6 +60,7 @@ from .baseline import apply_baseline, format_entry, load_baseline
 from .callgraph import DEFAULT_DL008_DEPTH, CallGraph, module_name
 from .dynaflow import (FrameSchema, analyze_project, analyze_tree,
                        load_wire_schemas)
+from .dynaform import FormSite, FormVal, analyze_form, check_form_drift
 from .dynahot import (HOT_FRAME_RE, HOT_ROOTS, HotFrame, analyze_hot,
                       hot_regions)
 from .dynajit import JitInfo, analyze_jit, collect_jits
@@ -61,12 +71,13 @@ from .dynarace import (RaceModel, analyze_races, build_race_model,
 from .modelcheck import check_models, check_protocol_models, explore
 
 __all__ = [
-    "RULES", "CallGraph", "DEFAULT_DL008_DEPTH", "FrameSchema",
-    "HOT_FRAME_RE", "HOT_ROOTS", "HotFrame", "JitInfo", "ModuleSource",
-    "ProtoSchema", "RaceModel", "Violation",
-    "analyze_hot", "analyze_jit", "analyze_paths", "analyze_project",
-    "analyze_protocols", "analyze_races", "analyze_source", "analyze_tree",
-    "apply_baseline", "build_race_model", "check_models",
+    "RULES", "CallGraph", "DEFAULT_DL008_DEPTH", "FormSite", "FormVal",
+    "FrameSchema", "HOT_FRAME_RE", "HOT_ROOTS", "HotFrame", "JitInfo",
+    "ModuleSource", "ProtoSchema", "RaceModel", "Violation",
+    "analyze_form", "analyze_hot", "analyze_jit", "analyze_paths",
+    "analyze_project", "analyze_protocols", "analyze_races",
+    "analyze_source", "analyze_tree", "apply_baseline",
+    "build_race_model", "check_form_drift", "check_models",
     "check_protocol_models", "check_transitive_host_sync",
     "collect_anchors", "collect_jits", "explore", "format_entry",
     "hot_regions", "iter_py_files", "load_protocols", "load_source",

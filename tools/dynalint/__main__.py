@@ -7,11 +7,14 @@ Runs the per-file rules (DL001-DL007, DL011) AND the whole-program
 passes — dynaflow (DL008 call-graph blocking propagation, DL009/DL010
 wire-schema conformance), dynarace (DL012-DL014 concurrency rules +
 interprocedural DL005), dynajit (DL015-DL017 compilation-stability /
-device-residency rules + the warmup-coverage check), dynaproto
+device-residency rules), dynaproto
 (DL019/DL020 lifecycle-protocol conformance + the explicit-state model
-checker over the declared machines, DL021 typed-error-swallow) and
+checker over the declared machines, DL021 typed-error-swallow),
 dynahot (DL022-DL024 hot-path cost + unbounded-growth rules over the
-HOT_ROOTS reachability regions) — over one shared parse of the tree.
+HOT_ROOTS reachability regions) and dynaform (DL025-DL027 dtype
+promotion, warmup/serving call-form equivalence — which subsumes the
+old dynajit warmup-coverage check — and the int8 tier contract) — over
+one shared parse of the tree.
 ``--all`` is the CI spelling: the default tree, every pass; its
 ``--json`` carries a ``protocols`` block with the per-machine
 state-space counts the model checker explored.

@@ -138,6 +138,26 @@ RULES: Dict[str, Tuple[str, str]] = {
               "reachable removal, bound check, ring, or eviction — the "
               "leak class that falls over under sustained churn; cap "
               "it or justify with `# bounded-by: <reason>`"),
+    # DL025-DL027 are the dynaform dtype-provenance / call-form rules
+    # (dynaform.py): a dtype x provenance lattice over the shared parse
+    # and call graph, so analyze_source never emits them — analyze_tree
+    # does.
+    "DL025": ("silent-dtype-promotion",
+              "JAX weak-type promotion widens a bf16/int8 device value "
+              "to fp32 on a hot path (fp32 operand or python float into "
+              "int8) — 2-4x the bytes/FLOPs of the intended dtype; cast "
+              "explicitly or justify with `# promote-ok: <reason>`"),
+    "DL026": ("warmup-form-drift",
+              "serving-path jitted call form (arity, operand dtype/"
+              "committedness, explicit-kwarg set, static kwarg values, "
+              "list-convert construction) that warmup() never "
+              "exercises: the first serving call in that form pays a "
+              "multi-second XLA compile mid-flight"),
+    "DL027": ("tier-dtype-contract",
+              "int8 host-tier pages consumed without dequantize_pages, "
+              "a dequantize missing its scale tensor, a quantize whose "
+              "scales are dropped, or an fp16-fallback path touching "
+              "int8 scale pools — tier formats must never mix"),
 }
 
 NAME_TO_CODE = {name: code for code, (name, _) in RULES.items()}

@@ -616,13 +616,13 @@ def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
                  per_file_paths: Optional[Sequence[str]] = None
                  ) -> List[Violation]:
     """Per-file rules + whole-program dynaflow rules + the dynarace
-    concurrency passes + the dynajit / dynaproto / dynahot passes (and
-    the protocol model checker) over one tree; the shared parse cache
-    means each file is read and parsed exactly once per run. Pass
-    ``timings={}`` to receive per-pass wall seconds (``per_file``/
+    concurrency passes + the dynajit / dynaproto / dynahot / dynaform
+    passes (and the protocol model checker) over one tree; the shared
+    parse cache means each file is read and parsed exactly once per run.
+    Pass ``timings={}`` to receive per-pass wall seconds (``per_file``/
     ``dynaflow``/``dynarace``/``dynajit``/``dynaproto``/``modelcheck``/
-    ``dynahot``) and ``proto_report={}`` for the per-machine
-    model-checker stats (``--json``'s ``protocols`` block).
+    ``dynahot``/``dynaform``) and ``proto_report={}`` for the
+    per-machine model-checker stats (``--json``'s ``protocols`` block).
 
     ``per_file_paths`` (the ``--changed`` incremental mode) scopes the
     PER-FILE rules to those files only; the whole-program passes always
@@ -690,6 +690,10 @@ def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
 
     out.extend(analyze_hot(sources, graph=graph))
     t7 = _time.perf_counter()
+    from .dynaform import analyze_form
+
+    out.extend(analyze_form(sources, graph=graph))
+    t8 = _time.perf_counter()
     if timings is not None:
         timings["per_file"] = round(t1 - t0, 3)
         timings["dynaflow"] = round(t2 - t1, 3)
@@ -698,5 +702,6 @@ def analyze_tree(paths: Sequence[str], root: Optional[str] = None,
         timings["dynaproto"] = round(t5 - t4, 3)
         timings["modelcheck"] = round(t6 - t5, 3)
         timings["dynahot"] = round(t7 - t6, 3)
+        timings["dynaform"] = round(t8 - t7, 3)
     out.sort(key=lambda v: (v.path, v.line, v.code))
     return out

@@ -1,0 +1,1029 @@
+"""dynaform: dtype-provenance & warmup/serving call-form equivalence
+(DL025-DL027).
+
+The compile fence has caught the same bug class three times at runtime:
+serving-path jitted call forms that ``warmup()`` never exercised
+(explicit-vs-defaulted kwargs, committed-vs-uncommitted carries under a
+mesh, ``jnp.asarray(<python list>)`` lowering one tiny program per
+padded length). Each cost a multi-second first-request compile in
+deployment before the fence flagged it. Separately, JAX weak-type
+promotion silently widens bf16/int8 device values to fp32 — a 2x-4x
+bytes/FLOP hazard on the HBM-bound decode path that no shape-level rule
+sees. dynaform moves both bug classes to lint time, on the same shared
+parse + call graph as dynaflow/dynajit/dynahot.
+
+The analysis types every expression along two axes:
+
+- **dtype** — ``bf16`` / ``fp32`` / ``fp16`` / ``int8`` / ``int32`` /
+  ``weak-i`` / ``weak-f`` (python scalars, which JAX promotes weakly) /
+  ``bool`` / ``none`` / ``?``. Knowledge comes only from explicit
+  evidence: dtype arguments to constructors, ``.astype``, the typed
+  engine pools (``kv_k``/``kv_v``/``params`` are bf16 by config
+  default), scale pools (fp32). Unknown matches anything — a
+  whole-program lint must never guess.
+- **provenance** — ``committed`` (jit-call results, the device pools:
+  carries a NamedSharding under a mesh), ``uncommitted`` (host-built
+  ``jnp.*``/``np.*`` arrays: a DIFFERENT jit cache entry under a mesh),
+  ``literal`` (python scalars), ``bucketed`` (results of the dynajit
+  bucket helpers), or ``?``.
+
+Rules (tier-1-enforced with an EMPTY baseline):
+
+- **DL025 silent-dtype-promotion** — inside hot regions (dynahot's
+  ``HOT_ROOTS`` reachability) in engine/models code, an arithmetic mix
+  whose JAX promotion WIDENS a known-bf16/int8 device value to
+  fp32/fp16: ``bf16 (+) fp32`` widens; ``int8 (+) python-float`` widens
+  to fp32; ``bf16 (+) python-float`` stays bf16 and is deliberately
+  quiet (that is the weak-type fast path). Suppress deliberate
+  promotions with ``# promote-ok: <reason>`` — the fp32 is then
+  documented as the point (e.g. softmax accumulation).
+- **DL026 warmup-form-drift** — for every jitted entry (``@jax.jit``
+  defs and the engine's ``self.<x>_fn`` convention) the *call-form key*
+  at each serving site is matched against the warmup sites: positional
+  arity, per-operand (dtype, committedness, None-vs-array treedef),
+  the explicit-kwarg name set, and the statically-enumerable value set
+  of scalar kwargs (static argnames key the jit cache per VALUE — a
+  serving kwarg value set not covered by warmup is a first-request
+  compile). A serving form with no warmup match fires, naming the
+  nearest warmup form and the drifted fields. The rule also owns the
+  two coarser checks it subsumes: entries dispatched but never warmed
+  at all (folded in from DL015, which keeps its shape rules), and
+  ``jnp.asarray(<python list>)`` built on the serving path with no
+  warmup site of the same dtype list form (each distinct padded length
+  lowers its own tiny convert program).
+- **DL027 tier-dtype-contract** — the int8 host-tier invariants:
+  int8-tier page reads (``host_k``/``host_v`` under a
+  ``host_tier_int8`` guard) must flow through ``dequantize_pages``
+  before any fp consumer (``_inject_pages``/step fns/arithmetic);
+  ``dequantize_pages`` must receive its scale tensor (exactly two
+  array args); a tuple-unpacked ``q, s = quantize_pages(...)`` whose
+  scale is never used afterwards silently drops the scales; and the
+  fp16-fallback branch must never touch the scale pools or dequantize
+  (tier mixing).
+
+Suppression: the usual ``# dynalint: disable=<rule>`` on the line or
+the line above; DL025 additionally honors ``# promote-ok: <reason>``.
+Policy (docs/static_analysis.md): fix form drift by warming the
+serving form, not by suppressing — suppression is for forms that are
+statically visible but unreachable in deployment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .analyzer import RULES, ModuleSource, Violation, call_attr, dotted
+from .callgraph import CallGraph
+from .dynajit import (BUCKET_HELPERS, CONFIG_BASE_RE, DEVICE_MODULE_MARKERS,
+                      DEVICE_POOL_ATTRS, ENGINE_MARKER, HOST_POOL_ATTRS,
+                      JIT_ATTR_RE, JNP_BASES, NP_BASES, JitInfo, _DUMMY_FI,
+                      _jit_decorator_kw, _suppressed, collect_jits)
+
+# ------------------------------------------------------------------- config
+
+# `# promote-ok: <reason>` — a justified deliberate widening
+PROMOTE_OK_RE = re.compile(r"#\s*promote-ok:\s*\S")
+
+# dtype-name tails (jnp.int32 / np.float32 / "bfloat16" / bool) -> token
+_DTYPE_BY_NAME = {
+    "bfloat16": "bf16", "bf16": "bf16",
+    "float32": "fp32", "f32": "fp32", "float64": "fp32", "float_": "fp32",
+    "float16": "fp16", "f16": "fp16",
+    "int8": "int8", "uint8": "int8",
+    "int32": "int32", "uint32": "uint32", "int64": "int64",
+    "int16": "int32", "int_": "int64",
+    "bool_": "bool", "bool": "bool",
+}
+_FLOATS = frozenset({"bf16", "fp16", "fp32"})
+_INTS = frozenset({"int8", "int32", "int64", "uint32"})
+
+# constructors whose result dtype defaults to fp32 when no dtype is given
+_FP_DEFAULT_CTORS = frozenset({"zeros", "ones", "empty"})
+# jnp/np elementwise ops that promote their operands (DL025 surface)
+_PROMOTING_OPS = frozenset({
+    "add", "subtract", "multiply", "divide", "true_divide", "power",
+    "mod", "remainder", "maximum", "minimum", "where", "clip",
+})
+_ARITH_BINOPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.Pow,
+                 ast.Mod, ast.FloorDiv, ast.MatMult)
+
+# the int8 host-tier pair (DL027 anchors)
+_QUANT_FNS = frozenset({"quantize_pages", "quantize_pages_np"})
+_DEQUANT_FNS = frozenset({"dequantize_pages", "dequantize_pages_np"})
+_SCALE_POOL_ATTRS = frozenset({"host_k_s", "host_v_s"})
+_PAGE_POOL_ATTRS = frozenset({"host_k", "host_v"})
+# fp consumers an un-dequantized int8-tier page must never reach
+_FP_SINK_NAMES = frozenset({"_inject_pages", "_inject_staged"})
+# the host_tier_int8 guard attribute (EngineConfig flag)
+_TIER_FLAG = "host_tier_int8"
+
+
+def _fs(*vals: str) -> FrozenSet[str]:
+    return frozenset(vals)
+
+
+@dataclass
+class FormVal:
+    """dtype x provenance x treedef-kind (+ static value tokens) for one
+    expression. ``?`` fields match anything in DL026 comparisons."""
+
+    dtype: str = "?"
+    prov: str = "?"          # committed | uncommitted | literal | bucketed
+    kind: str = "?"          # arr | list | tuple | scalar | none | str
+    vals: FrozenSet[str] = frozenset()
+    elem: Optional["FormVal"] = None
+    int8raw: bool = False    # int8-tier page bytes not yet dequantized
+
+
+UNKNOWN_FV = FormVal()
+
+
+def _join_fv(a: FormVal, b: FormVal) -> FormVal:
+    return FormVal(
+        a.dtype if a.dtype == b.dtype else "?",
+        a.prov if a.prov == b.prov else "?",
+        a.kind if a.kind == b.kind else "?",
+        (a.vals | b.vals) if (a.vals and b.vals) else frozenset(),
+        a.elem if b.elem is None else (b.elem if a.elem is None
+                                       else _join_fv(a.elem, b.elem)),
+        a.int8raw or b.int8raw)
+
+
+def _dtype_token(node: Optional[ast.AST]) -> str:
+    """The dtype a node syntactically names, or ``?``."""
+    if node is None:
+        return "?"
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_BY_NAME.get(node.value, "?")
+    d = dotted(node)
+    if d is None:
+        return "?"
+    return _DTYPE_BY_NAME.get(d.rsplit(".", 1)[-1], "?")
+
+
+def _promote(a: str, b: str) -> str:
+    """JAX type-promotion result of mixing dtypes ``a`` and ``b``
+    (weak scalars promote weakly: bf16 (+) python-float stays bf16)."""
+    if a == b:
+        return a
+    if "?" in (a, b) or "str" in (a, b) or "none" in (a, b):
+        return "?"
+    for x, y in ((a, b), (b, a)):
+        if x == "bool":
+            return y
+        if x == "weak-i":
+            if y in _INTS or y in _FLOATS or y == "weak-f":
+                return y
+            return "?"
+        if x == "weak-f":
+            if y in _FLOATS:
+                return y
+            if y in _INTS:
+                return "fp32"      # int array (+) python float widens
+            return "?"
+    if a in _FLOATS and b in _FLOATS:
+        return "fp32"              # bf16/fp16 mixes resolve to fp32
+    if a in _INTS and b in _INTS:
+        order = ("int8", "uint32", "int32", "int64")
+        return max((a, b), key=order.index) if a in order and b in order \
+            else "?"
+    if (a in _FLOATS) != (b in _FLOATS):
+        return "fp32"              # int array (+) float array
+    return "?"
+
+
+def _dt_compat(a: str, b: str) -> bool:
+    """DL026 operand-dtype compatibility: a weak scalar hits the same
+    jit cache entry as the array dtype it promotes into."""
+    if a == "?" or b == "?" or a == b:
+        return True
+    weak = {"weak-i": _INTS | {"weak-i"}, "weak-f": _FLOATS | {"weak-f"}}
+    if a in weak:
+        return b in weak[a]
+    if b in weak:
+        return a in weak[b]
+    return False
+
+
+# ---------------------------------------------------------- call-form sites
+
+@dataclass
+class FormSite:
+    """One statically-extracted jitted call form."""
+
+    entry: str               # display name of the jitted entry
+    path: str
+    line: int
+    warm: bool               # inside a top-level warmup() body
+    scope: str               # enclosing qualname
+    nargs: Optional[int]     # None when *args present (wildcard arity)
+    args: Tuple[Tuple[str, str, str], ...]   # (dtype, prov, kind) per pos
+    kwnames: Tuple[str, ...]                 # sorted explicit kwarg names
+    kwstar: bool             # **kwargs present (wildcard kwarg set)
+    kwargs: Dict[str, Tuple[str, str, str, FrozenSet[str]]] = \
+        field(default_factory=dict)
+
+    def render(self) -> str:
+        parts: List[str] = (["*"] if self.nargs is None else
+                            [f"{dt}/{pv}" if kd != "none" else "None"
+                             for dt, pv, kd in self.args])
+        for k in self.kwnames:
+            dt, pv, kd, vals = self.kwargs[k]
+            if vals:
+                parts.append(f"{k}={{{', '.join(sorted(vals))}}}")
+            elif kd == "none":
+                parts.append(f"{k}=None")
+            else:
+                parts.append(f"{k}={dt}/{pv}")
+        if self.kwstar:
+            parts.append("**")
+        return f"{self.entry}({', '.join(parts)})"
+
+
+@dataclass
+class ListySite:
+    """One ``jnp.asarray(<python list>)`` device-convert site."""
+
+    path: str
+    line: int
+    dtype: str
+    warm: bool
+    scope: str
+    text: str
+
+
+def _form_mismatches(s: FormSite, w: FormSite) -> Optional[List[str]]:
+    """Field-level differences between a serving form and one warmup
+    form; [] means the warmup form covers it, None means the forms are
+    structurally incomparable (different arity/kwarg sets)."""
+    if s.nargs is not None and w.nargs is not None and s.nargs != w.nargs:
+        return None
+    if not s.kwstar and not w.kwstar and s.kwnames != w.kwnames:
+        return None
+    diffs: List[str] = []
+    if s.nargs is not None and w.nargs is not None:
+        for i, ((sd, sp, sk), (wd, wp, wk)) in enumerate(
+                zip(s.args, w.args)):
+            if not _dt_compat(sd, wd):
+                diffs.append(f"arg {i} dtype {sd} vs warmed {wd}")
+            if {sp, wp} == {"committed", "uncommitted"}:
+                diffs.append(f"arg {i} {sp} vs warmed {wp} — different "
+                             f"jit cache entries under a mesh")
+            if "none" in (sk, wk) and sk != wk and "?" not in (sk, wk):
+                diffs.append(f"arg {i} treedef {sk} vs warmed {wk}")
+    for k in s.kwnames:
+        if k not in w.kwargs:
+            continue
+        sd, sp, sk, svals = s.kwargs[k]
+        wd, wp, wk, wvals = w.kwargs[k]
+        if not _dt_compat(sd, wd):
+            diffs.append(f"kwarg `{k}` dtype {sd} vs warmed {wd}")
+        if {sp, wp} == {"committed", "uncommitted"}:
+            diffs.append(f"kwarg `{k}` {sp} vs warmed {wp} — different "
+                         f"jit cache entries under a mesh")
+        if "none" in (sk, wk) and sk != wk and "?" not in (sk, wk):
+            diffs.append(f"kwarg `{k}` treedef {sk} vs warmed {wk}")
+        if svals and wvals and not svals <= wvals:
+            extra = ", ".join(sorted(svals - wvals))
+            diffs.append(f"kwarg `{k}` serving value(s) {{{extra}}} never "
+                         f"warmed (warmup covers "
+                         f"{{{', '.join(sorted(wvals))}}})")
+    return diffs
+
+
+# ----------------------------------------------------------- the form scan
+
+class _FormScan(ast.NodeVisitor):
+    """One device module: dtype/provenance dataflow over every function
+    (jitted bodies included — promotion inside device code is the
+    hazard), recording jitted call forms and emitting DL025/DL027."""
+
+    def __init__(self, ms: ModuleSource, modname: str, graph: CallGraph,
+                 jits: Dict[str, JitInfo], hot_keys: Set[str]):
+        self.ms = ms
+        self.modname = modname
+        self.graph = graph
+        self.jits = jits
+        self.hot_keys = hot_keys
+        # serving/warmup forms are an engine-layer notion, like dynajit
+        self.report = ENGINE_MARKER in ms.path.replace("\\", "/")
+        self.violations: List[Violation] = []
+        self.sites: List[FormSite] = []
+        self.listy: List[ListySite] = []
+        self._classes: List[str] = []
+        self._funcs: List[str] = []
+        self._scopes: List[Dict[str, FormVal]] = []
+        self._fn_nodes: List[ast.AST] = []
+        self._injit: int = 0
+        self._tier: List[str] = []    # "int8" / "fp16" branch context
+        self._dropped_scales: Dict[str, Tuple[int, ast.AST]] = {}
+        self._mod = graph.modules.get(modname)
+        self._src_lines = ms.src.splitlines()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _qualname(self) -> str:
+        return ".".join(self._classes + self._funcs) or "<module>"
+
+    def _emit(self, node: ast.AST, code: str, detail: str,
+              scope: Optional[str] = None) -> None:
+        line = getattr(node, "lineno", 0)
+        if _suppressed(self.ms, line, code):
+            return
+        name, summary = RULES[code]
+        self.violations.append(Violation(
+            self.ms.path, line, getattr(node, "col_offset", 0), code,
+            name, f"{summary}: {detail}", scope or self._qualname()))
+
+    def _promote_ok(self, line: int) -> bool:
+        for probe in (line, line - 1):
+            if 1 <= probe <= len(self._src_lines) and \
+                    PROMOTE_OK_RE.search(self._src_lines[probe - 1]):
+                return True
+        return False
+
+    def _hot(self) -> bool:
+        key = f"{self.modname}:{self._qualname()}"
+        return key in self.hot_keys or self._injit > 0
+
+    # ------------------------------------------------------------- scoping
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._classes.append(node.name)
+        self.generic_visit(node)
+        self._classes.pop()
+
+    def _visit_func(self, node) -> None:
+        jitted = any(_jit_decorator_kw(d) is not None
+                     for d in node.decorator_list)
+        scope: Dict[str, FormVal] = {}
+        for a in node.args.posonlyargs + node.args.args + [
+                node.args.vararg, node.args.kwarg] + node.args.kwonlyargs:
+            if a is not None:
+                scope[a.arg] = UNKNOWN_FV
+        self._funcs.append(node.name)
+        self._scopes.append(scope)
+        self._fn_nodes.append(node)
+        self._injit += 1 if jitted else 0
+        saved_scales = self._dropped_scales
+        self._dropped_scales = {}
+        for stmt in node.body:
+            self.visit(stmt)
+        for sname in sorted(self._dropped_scales):
+            line, at = self._dropped_scales[sname]
+            if not self._name_loaded_after(node, sname, line):
+                self._emit(at, "DL027",
+                           f"scale tensor `{sname}` from quantize_pages "
+                           f"is never used — int8 pages without their "
+                           f"scales cannot be dequantized; store/ship "
+                           f"the (q, s) pair together")
+        self._dropped_scales = saved_scales
+        self._injit -= 1 if jitted else 0
+        self._fn_nodes.pop()
+        self._scopes.pop()
+        self._funcs.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _name_loaded_after(self, fn_node, name: str, line: int) -> bool:
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.Name) and sub.id == name \
+                    and isinstance(sub.ctx, ast.Load) \
+                    and getattr(sub, "lineno", 0) > line:
+                return True
+        return False
+
+    def _lookup(self, name: str) -> FormVal:
+        for scope in reversed(self._scopes):
+            if name in scope:
+                return scope[name]
+        return UNKNOWN_FV
+
+    def _bind(self, name: str, fv: FormVal) -> None:
+        if self._scopes:
+            old = self._scopes[-1].get(name)
+            if old is not None and old is not UNKNOWN_FV:
+                fv = _join_fv(old, fv)       # flow-insensitive join
+            self._scopes[-1][name] = fv
+
+    # -------------------------------------------------------- the evaluator
+
+    def eval(self, node: Optional[ast.AST]) -> FormVal:  # noqa: C901
+        if node is None:
+            return UNKNOWN_FV
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if v is None:
+                return FormVal("none", "literal", "none", _fs("None"))
+            if isinstance(v, bool):
+                return FormVal("bool", "literal", "scalar", _fs(repr(v)))
+            if isinstance(v, int):
+                return FormVal("weak-i", "literal", "scalar", _fs(repr(v)))
+            if isinstance(v, float):
+                return FormVal("weak-f", "literal", "scalar", _fs(repr(v)))
+            if isinstance(v, str):
+                return FormVal("str", "literal", "str")
+            return UNKNOWN_FV
+        if isinstance(node, ast.Name):
+            return self._lookup(node.id)
+        if isinstance(node, ast.Attribute):
+            return self._eval_attr(node)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, ast.Tuple):
+            elts = [self.eval(e) for e in node.elts]
+            elem = elts[0] if elts else None
+            for e in elts[1:]:
+                elem = _join_fv(elem, e)
+            return FormVal("?", "?", "tuple", frozenset(), elem)
+        if isinstance(node, (ast.List, ast.Set)):
+            elts = [self.eval(e) for e in node.elts]
+            elem = elts[0] if elts else None
+            for e in elts[1:]:
+                elem = _join_fv(elem, e)
+            return FormVal(elem.dtype if elem is not None else "?",
+                           "literal", "list", frozenset(), elem)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return FormVal(self.eval(node.elt).dtype
+                           if isinstance(node.elt, ast.Constant) else "?",
+                           "literal", "list")
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test)
+            return _join_fv(self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, ast.BinOp):
+            return self._eval_binop(node)
+        if isinstance(node, ast.UnaryOp):
+            inner = self.eval(node.operand)
+            try:
+                v = ast.literal_eval(node)
+                return FormVal(inner.dtype, inner.prov, inner.kind,
+                               _fs(repr(v)), inner.elem, inner.int8raw)
+            except (ValueError, SyntaxError):
+                return FormVal(inner.dtype, inner.prov, inner.kind,
+                               frozenset(), inner.elem, inner.int8raw)
+        if isinstance(node, (ast.BoolOp, ast.Compare)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.expr):
+                    self.eval(sub)
+            return FormVal("bool", "?", "scalar")
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(node)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        return UNKNOWN_FV
+
+    def _eval_attr(self, node: ast.Attribute) -> FormVal:
+        base = dotted(node.value)
+        if base is not None and CONFIG_BASE_RE.match(base):
+            return FormVal("?", "literal", "scalar",
+                           _fs(f"cfg:{node.attr}"))
+        if base == "self":
+            if node.attr in DEVICE_POOL_ATTRS:
+                return FormVal("bf16", "committed", "arr")
+            if node.attr in _SCALE_POOL_ATTRS:
+                self._check_scale_read(node)
+                return FormVal("fp32", "uncommitted", "arr")
+            if node.attr in _PAGE_POOL_ATTRS:
+                tier = self._tier[-1] if self._tier else "?"
+                return FormVal("int8" if tier == "int8" else "?",
+                               "uncommitted", "arr",
+                               int8raw=(tier == "int8"))
+            if node.attr in HOST_POOL_ATTRS:
+                return FormVal("?", "uncommitted", "arr")
+        return UNKNOWN_FV
+
+    def _check_scale_read(self, node: ast.AST) -> None:
+        if self._tier and self._tier[-1] == "fp16" and self.report:
+            self._emit(node, "DL027",
+                       "fp16-fallback branch reads an int8 scale pool — "
+                       "the two tier formats must never mix on one path")
+
+    def _elem(self, node: ast.AST) -> FormVal:
+        """Loop-iteration element FormVal for ``for x in <node>``."""
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            elts = [self.eval(e) for e in node.elts]
+            elem = elts[0] if elts else UNKNOWN_FV
+            for e in elts[1:]:
+                elem = _join_fv(elem, e)
+            return elem
+        if isinstance(node, ast.IfExp):
+            return _join_fv(self._elem(node.body), self._elem(node.orelse))
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            tail = d.rsplit(".", 1)[-1] if d else None
+            if tail in ("sorted", "set", "list", "tuple", "reversed") \
+                    and node.args:
+                return self._elem(node.args[0])
+            if tail == "range":
+                return FormVal("weak-i", "literal", "scalar")
+        if isinstance(node, ast.Name):
+            fv = self._lookup(node.id)
+            return fv.elem or UNKNOWN_FV
+        fv = self.eval(node)
+        return fv.elem or UNKNOWN_FV
+
+    def _eval_binop(self, node: ast.BinOp) -> FormVal:
+        left, right = self.eval(node.left), self.eval(node.right)
+        if isinstance(node.op, (ast.Mult, ast.Add)) and \
+                "list" in (left.kind, right.kind):
+            # [0] * n — python list repetition/concat stays a list
+            listy = left if left.kind == "list" else right
+            return FormVal(listy.dtype, "literal", "list", frozenset(),
+                           listy.elem)
+        if isinstance(node.op, _ARITH_BINOPS):
+            self._check_promotion(node, left, right)
+        res = _promote(left.dtype, right.dtype)
+        provs = (left.prov, right.prov)
+        prov = ("committed" if "committed" in provs
+                else "uncommitted" if "uncommitted" in provs
+                else left.prov if left.prov == right.prov else "?")
+        kind = "arr" if "arr" in (left.kind, right.kind) else (
+            left.kind if left.kind == right.kind else "?")
+        vals: FrozenSet[str] = frozenset()
+        try:
+            vals = _fs(repr(ast.literal_eval(node)))
+        except (ValueError, SyntaxError, TypeError):
+            pass
+        return FormVal(res, prov, kind, vals,
+                       int8raw=left.int8raw or right.int8raw)
+
+    def _check_promotion(self, node: ast.AST, left: FormVal,
+                         right: FormVal) -> None:
+        """DL025: fire when a known-bf16/int8 device value is widened to
+        fp32/fp16 by the other operand's dtype."""
+        if not self._hot() or not self.report:
+            return
+        line = getattr(node, "lineno", 0)
+        for dev, other in ((left, right), (right, left)):
+            if dev.dtype not in ("bf16", "int8"):
+                continue
+            if dev.prov not in ("committed", "uncommitted"):
+                continue
+            res = _promote(dev.dtype, other.dtype)
+            if res in ("?", dev.dtype) or res not in _FLOATS:
+                continue
+            if self._promote_ok(line):
+                return
+            src = ast.unparse(node)[:72]
+            self._emit(node, "DL025",
+                       f"`{src}` promotes a {dev.dtype} device value to "
+                       f"{res} ({dev.dtype} (+) {other.dtype}) on a hot "
+                       f"path — {2 if dev.dtype == 'bf16' else 4}x the "
+                       f"bytes/FLOPs; cast explicitly or justify with "
+                       f"`# promote-ok: <reason>`")
+            if dev.int8raw:
+                self._emit(node, "DL027",
+                           "int8-tier page bytes used in fp arithmetic "
+                           "without dequantize_pages — the values are "
+                           "quantized codes, not activations")
+            return
+
+    def _eval_subscript(self, node: ast.Subscript) -> FormVal:
+        value = self.eval(node.value)
+        idx = node.slice
+        parts = list(idx.elts) if isinstance(idx, ast.Tuple) else [idx]
+        for p in parts:
+            if not isinstance(p, ast.Slice):
+                self.eval(p)
+        if value.kind == "arr":
+            # a view/gather of an array keeps its dtype & provenance
+            return FormVal(value.dtype, value.prov, "arr",
+                           int8raw=value.int8raw)
+        if value.kind in ("list", "tuple") and value.elem is not None \
+                and not any(isinstance(p, ast.Slice) for p in parts):
+            return value.elem
+        return UNKNOWN_FV
+
+    # ---------------------------------------------------------------- calls
+
+    def _jit_callee(self, node: ast.Call) -> Tuple[Optional[str],
+                                                   Optional[JitInfo]]:
+        d = dotted(node.func)
+        if d is None:
+            return None, None
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2 \
+                and JIT_ATTR_RE.search(parts[1]):
+            return parts[1], None          # step-fn convention
+        if self._mod is not None:
+            first = self._qualname().split(".")[0]
+            cls_name = first if first in self._mod.classes else None
+            fi = self._mod.functions.get(self._qualname())
+            fi_key = self.graph._resolve(
+                self._mod, d, cls_name, fi if fi is not None else _DUMMY_FI)
+            if fi_key is not None and fi_key in self.jits:
+                return d.rsplit(".", 1)[-1], self.jits[fi_key]
+        return None, None
+
+    def _eval_call(self, node: ast.Call) -> FormVal:  # noqa: C901
+        d = dotted(node.func)
+        tail = d.rsplit(".", 1)[-1] if d else call_attr(node)
+        base = d.rsplit(".", 1)[0] if d and "." in d else None
+
+        if tail in BUCKET_HELPERS:
+            args = [self.eval(a) for a in node.args]
+            if tail == "_pad_pow2":
+                # pads a python list: result is a list of the input's
+                # element dtype (the serving drains' asarray operand)
+                elem = (args[0].elem or UNKNOWN_FV) if args else UNKNOWN_FV
+                return FormVal(elem.dtype if elem.dtype != "?"
+                               else "weak-i", "bucketed", "list",
+                               frozenset(), elem)
+            return FormVal("weak-i", "bucketed", "scalar")
+
+        if base in NP_BASES or base in JNP_BASES:
+            return self._eval_np_call(node, tail, base in JNP_BASES)
+
+        if tail in _DEQUANT_FNS:
+            args = [self.eval(a) for a in node.args]
+            for k in node.keywords:
+                self.eval(k.value)
+            if self.report and len(node.args) < 2 and not any(
+                    isinstance(a, ast.Starred) for a in node.args):
+                self._emit(node, "DL027",
+                           f"`{tail}` called without its scale tensor — "
+                           f"int8 pages dequantize as (q, s) pairs")
+            if self.report and self._tier and self._tier[-1] == "fp16":
+                self._emit(node, "DL027",
+                           f"`{tail}` on the fp16-fallback branch — the "
+                           f"two tier formats must never mix on one path")
+            return FormVal("fp32", "committed" if tail == "dequantize_pages"
+                           else "uncommitted", "arr")
+        if tail in _QUANT_FNS:
+            for a in node.args:
+                self.eval(a)
+            return FormVal("int8", "committed" if tail == "quantize_pages"
+                           else "uncommitted", "tuple")
+
+        jit_name, info = self._jit_callee(node)
+        if jit_name is not None:
+            return self._note_jit_call(node, jit_name, info)
+
+        if tail == "len":
+            for a in node.args:
+                self.eval(a)
+            return FormVal("weak-i", "literal", "scalar")
+        if tail in ("min", "max", "sum", "abs", "round"):
+            for a in node.args:
+                self.eval(a)
+            return FormVal("weak-i", "?", "scalar")
+        if tail == "append" and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) and node.args:
+            # list.append widens the stored element join
+            nm = node.func.value.id
+            cur = self._lookup(nm)
+            item = self.eval(node.args[0])
+            if cur.kind == "list":
+                self._bind(nm, FormVal(
+                    "?", cur.prov, "list", frozenset(),
+                    item if cur.elem is None else _join_fv(cur.elem, item)))
+            return UNKNOWN_FV
+        if tail == "astype" and isinstance(node.func, ast.Attribute):
+            recv = self.eval(node.func.value)
+            dt = _dtype_token(node.args[0]) if node.args else "?"
+            return FormVal(dt, recv.prov, "arr", int8raw=recv.int8raw)
+
+        for a in node.args:
+            self.eval(a)
+        for k in node.keywords:
+            self.eval(k.value)
+        if isinstance(node.func, ast.Attribute):
+            recv = self.eval(node.func.value)
+            if recv.prov == "committed" and recv.kind == "arr":
+                return FormVal("?", "committed", "arr")
+        return UNKNOWN_FV
+
+    def _eval_np_call(self, node: ast.Call, tail: Optional[str],
+                      is_jnp: bool) -> FormVal:
+        prov = "uncommitted"
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        if tail in _FP_DEFAULT_CTORS or tail == "full" or tail == "arange":
+            dt_node = kw.get("dtype")
+            if dt_node is None:
+                pos = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                       "arange": 3}.get(tail or "", 99)
+                if len(node.args) > pos:
+                    dt_node = node.args[pos]
+            dt = _dtype_token(dt_node)
+            if dt == "?" and dt_node is None:
+                if tail == "full" and len(node.args) > 1:
+                    fill = self.eval(node.args[1])
+                    dt = {"weak-i": "int32", "weak-f": "fp32",
+                          "bool": "bool"}.get(fill.dtype, "?")
+                elif tail == "arange":
+                    dt = "int32"
+                else:
+                    dt = "fp32"        # zeros/ones/empty default
+            for a in node.args:
+                self.eval(a)
+            return FormVal(dt, prov, "arr")
+        if tail in ("asarray", "array"):
+            src = self.eval(node.args[0]) if node.args else UNKNOWN_FV
+            dt_node = kw.get("dtype") or (node.args[1]
+                                          if len(node.args) > 1 else None)
+            dt = _dtype_token(dt_node)
+            if dt == "?" and dt_node is None:
+                dt = {"weak-i": "int32", "weak-f": "fp32"}.get(
+                    src.dtype, src.dtype)
+            if is_jnp and src.kind == "list":
+                self._note_listy(node, dt)
+            return FormVal(dt, prov, "arr", frozenset(),
+                           int8raw=src.int8raw)
+        if tail in _PROMOTING_OPS:
+            args = [self.eval(a) for a in node.args]
+            rel = args[1:] if tail in ("where", "clip") else args
+            for i in range(len(rel)):
+                for j in range(i + 1, len(rel)):
+                    self._check_promotion(node, rel[i], rel[j])
+            dt = "?"
+            if rel:
+                dt = rel[0].dtype
+                for r in rel[1:]:
+                    dt = _promote(dt, r.dtype)
+            return FormVal(dt, prov if not is_jnp else (
+                "committed" if any(a.prov == "committed" for a in args)
+                else prov), "arr")
+        for a in node.args:
+            self.eval(a)
+        for k in node.keywords:
+            self.eval(k.value)
+        return FormVal("?", prov, "arr")
+
+    def _note_listy(self, node: ast.Call, dt: str) -> None:
+        if not self.report or self._injit > 0 or not self._funcs:
+            return
+        self.listy.append(ListySite(
+            self.ms.path, getattr(node, "lineno", 0), dt,
+            self._funcs[0] == "warmup", self._qualname(),
+            ast.unparse(node)[:64]))
+
+    def _note_jit_call(self, node: ast.Call, name: str,
+                       info: Optional[JitInfo]) -> FormVal:
+        starred = any(isinstance(a, ast.Starred) for a in node.args)
+        kwstar = any(k.arg is None for k in node.keywords)
+        arg_keys: List[Tuple[str, str, str]] = []
+        for a in node.args:
+            fv = self.eval(a)
+            if not starred:
+                arg_keys.append((fv.dtype, fv.prov, fv.kind))
+            if self.report and fv.int8raw:
+                self._emit(node, "DL027",
+                           f"int8-tier page bytes flow into jitted "
+                           f"`{name}` without dequantize_pages — the "
+                           f"values are quantized codes, not KV rows")
+        kwargs: Dict[str, Tuple[str, str, str, FrozenSet[str]]] = {}
+        for k in node.keywords:
+            fv = self.eval(k.value)
+            if k.arg is not None:
+                kwargs[k.arg] = (fv.dtype, fv.prov, fv.kind, fv.vals)
+            if self.report and fv.int8raw:
+                self._emit(node, "DL027",
+                           f"int8-tier page bytes flow into jitted "
+                           f"`{name}` without dequantize_pages — the "
+                           f"values are quantized codes, not KV rows")
+        if self.report and self._injit == 0 and self._funcs:
+            self.sites.append(FormSite(
+                name, self.ms.path, getattr(node, "lineno", 0),
+                self._funcs[0] == "warmup", self._qualname(),
+                None if starred else len(node.args), tuple(arg_keys),
+                tuple(sorted(kwargs)), kwstar, kwargs))
+        return FormVal("?", "committed", "arr")
+
+    # ------------------------------------------------------------ visitors
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        fv = self.eval(node.value)
+        # q, s = quantize_pages(...): the scale must be used afterwards
+        if isinstance(node.value, ast.Call):
+            d = dotted(node.value.func)
+            tail = d.rsplit(".", 1)[-1] if d else None
+            if tail in _QUANT_FNS and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], (ast.Tuple, ast.List)) \
+                    and len(node.targets[0].elts) == 2 \
+                    and isinstance(node.targets[0].elts[1], ast.Name) \
+                    and self.report:
+                sname = node.targets[0].elts[1].id
+                self._dropped_scales.setdefault(
+                    sname, (getattr(node, "lineno", 0), node))
+        for t in node.targets:
+            self._bind_target(t, fv)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._bind_target(node.target, self.eval(node.value))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        val = self.eval(node.value)
+        if isinstance(node.target, ast.Name):
+            old = self._lookup(node.target.id)
+            if isinstance(node.op, _ARITH_BINOPS):
+                self._check_promotion(node, old, val)
+            self._bind(node.target.id,
+                       FormVal(_promote(old.dtype, val.dtype), old.prov,
+                               old.kind, frozenset(), old.elem,
+                               old.int8raw or val.int8raw))
+
+    def _bind_target(self, t: ast.AST, fv: FormVal) -> None:
+        if isinstance(t, ast.Name):
+            self._bind(t.id, fv)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                # unpacking a call result: provenance flows to every
+                # target; dtype does not
+                self._bind_target(e, FormVal("?", fv.prov, "?",
+                                             int8raw=fv.int8raw))
+        elif isinstance(t, ast.Starred):
+            self._bind_target(t.value, fv)
+        elif isinstance(t, (ast.Subscript, ast.Attribute)):
+            if isinstance(t, ast.Subscript):
+                self.eval(t.value)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.eval(node.iter)
+        self._bind_target(node.target, self._elem(node.iter)
+                          if isinstance(node.target, ast.Name)
+                          else UNKNOWN_FV)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    visit_AsyncFor = visit_For
+
+    def visit_If(self, node: ast.If) -> None:
+        self.eval(node.test)
+        if self._is_tier_test(node.test):
+            self._tier.append("int8")
+            for stmt in node.body:
+                self.visit(stmt)
+            self._tier.pop()
+            self._tier.append("fp16")
+            for stmt in node.orelse:
+                self.visit(stmt)
+            self._tier.pop()
+            return
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    @staticmethod
+    def _is_tier_test(test: ast.AST) -> bool:
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return False       # `if not int8:` inverts; stay out of it
+        for sub in ast.walk(test):
+            d = dotted(sub)
+            if d is not None and d.rsplit(".", 1)[-1] == _TIER_FLAG:
+                return True
+        return False
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        self.eval(node.value)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if node.value is not None:
+            self.eval(node.value)
+
+    def visit_While(self, node: ast.While) -> None:
+        self.eval(node.test)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def _visit_with(self, node) -> None:
+        for item in node.items:
+            self.eval(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for stmt in (node.body + node.orelse + node.finalbody
+                     + [s for h in node.handlers for s in h.body]):
+            self.visit(stmt)
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self.eval(node.value)
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        if node.exc is not None:
+            self.eval(node.exc)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        pass
+
+
+# ------------------------------------------------------- DL026 form matching
+
+def check_form_drift(sites: Sequence[FormSite],
+                     listy: Sequence[ListySite],
+                     sources: Sequence[ModuleSource]) -> List[Violation]:
+    """Match every serving call form against the warmup forms of the
+    same entry; any serving form with no match is a first-request
+    compile. Only meaningful when the scanned tree has a warmup() —
+    fixture trees without one would flag every entry."""
+    name, summary = RULES["DL026"]
+    by_path = {ms.path: ms for ms in sources}
+    warm: Dict[str, List[FormSite]] = {}
+    serve: Dict[str, List[FormSite]] = {}
+    seen: Set[Tuple[str, str, int]] = set()
+    for s in sites:
+        key = (s.entry, s.path, s.line)
+        if key in seen:
+            continue
+        seen.add(key)
+        (warm if s.warm else serve).setdefault(s.entry, []).append(s)
+    out: List[Violation] = []
+    if not warm:
+        return out
+
+    def _sup(path: str, line: int) -> bool:
+        ms = by_path.get(path)
+        return ms is not None and _suppressed(ms, line, "DL026")
+
+    for entry in sorted(serve):
+        ssites = sorted(serve[entry], key=lambda s: (s.path, s.line))
+        wsites = warm.get(entry)
+        if not wsites:
+            # folded-in DL015 coverage check: dispatched, never warmed
+            s0 = ssites[0]
+            if not _sup(s0.path, s0.line):
+                out.append(Violation(
+                    s0.path, s0.line, 0, "DL026", name,
+                    f"{summary}: jitted entry `{entry}` is dispatched at "
+                    f"serving time but never exercised by warmup() — its "
+                    f"first call compiles mid-serving", entry))
+            continue
+        for s in ssites:
+            best: Optional[List[str]] = None
+            matched = False
+            for w in wsites:
+                diffs = _form_mismatches(s, w)
+                if diffs is None:
+                    continue
+                if not diffs:
+                    matched = True
+                    break
+                if best is None or len(diffs) < len(best):
+                    best = diffs
+            if matched or _sup(s.path, s.line):
+                continue
+            if best is None:
+                why = (f"no warmup form has this arity/kwarg set "
+                       f"(warmed: "
+                       f"{'; '.join(w.render() for w in wsites[:2])})")
+            else:
+                why = "; ".join(best)
+            out.append(Violation(
+                s.path, s.line, 0, "DL026", name,
+                f"{summary}: serving form `{s.render()}` has no warmup "
+                f"match — {why} — the first serving call in this form "
+                f"compiles mid-flight", entry))
+
+    # the tiny-program sub-check: a serving-path jnp.asarray(<list>) with
+    # no warmup list-convert of a compatible dtype
+    warm_listy = [ls for ls in listy if ls.warm]
+    for ls in sorted((ls for ls in listy if not ls.warm),
+                     key=lambda s: (s.path, s.line)):
+        if any(_dt_compat(ls.dtype, w.dtype) for w in warm_listy):
+            continue
+        if _sup(ls.path, ls.line):
+            continue
+        out.append(Violation(
+            ls.path, ls.line, 0, "DL026", name,
+            f"{summary}: `{ls.text}` converts a python list on the "
+            f"serving path — one tiny convert program per distinct "
+            f"padded length — and warmup() never exercises the "
+            f"{ls.dtype} list-convert form", ls.scope))
+    return out
+
+
+# ------------------------------------------------------------------ driver
+
+def analyze_form(sources: Sequence[ModuleSource],
+                 graph: Optional[CallGraph] = None) -> List[Violation]:
+    """Run the dynaform passes (DL025/DL026/DL027) over already-loaded
+    modules, reusing a shared call graph when given."""
+    from .callgraph import module_name
+    from .dynahot import hot_regions
+
+    if graph is None:
+        graph = CallGraph.build(sources)
+    jits = collect_jits(sources)
+    hot_keys = set(hot_regions(graph, sources))
+    out: List[Violation] = []
+    sites: List[FormSite] = []
+    listy: List[ListySite] = []
+    for ms in sources:
+        norm = ms.path.replace("\\", "/")
+        if not any(m in norm for m in DEVICE_MODULE_MARKERS):
+            continue
+        scan = _FormScan(ms, module_name(ms.path), graph, jits, hot_keys)
+        scan.visit(ms.tree)
+        out.extend(scan.violations)
+        sites.extend(scan.sites)
+        listy.extend(scan.listy)
+    out.extend(check_form_drift(sites, listy, sources))
+    out.sort(key=lambda v: (v.path, v.line, v.code))
+    return out

@@ -32,10 +32,10 @@ Rules (tier-1-enforced with an EMPTY baseline):
   convention) taking an argument whose shape is RAW, a
   ``static_argnames``/``static_argnums`` value that is request-varying,
   or a device-pool gather (``self.kv_k[:, idx]``) whose index shape is
-  RAW — each distinct shape/value is one serve-time XLA compile. The
-  same rule owns the **warmup-coverage check**: every jitted entry point
-  dispatched from engine serving code must also be exercised by
-  ``warmup()``, or its first serve-time call compiles mid-flight.
+  RAW — each distinct shape/value is one serve-time XLA compile.
+  (Warmup coverage — entries dispatched at serving time that
+  ``warmup()`` never exercises — lives in dynaform's DL026 call-form
+  matching, which subsumes the per-entry check this rule used to own.)
 - **DL016 donation-discipline** — (a) a donated argument (the callee's
   ``donate_argnames``/``donate_argnums``, or the ``self.kv_k``/
   ``self.kv_v`` pool-donation convention of the step fns) that is
@@ -1028,36 +1028,15 @@ def check_transitive_transfer(graph: CallGraph,
     return out
 
 
-# ------------------------------------------------------- warmup coverage
-
-def check_warmup_coverage(
-        serving: Dict[str, Tuple[str, int]], warmed: Set[str],
-        sources: Sequence[ModuleSource]) -> List[Violation]:
-    """Every jitted entry dispatched from engine serving code must also
-    be exercised by ``warmup()`` — or its first serve-time call compiles
-    mid-flight, stalling every in-flight request."""
-    name, summary = RULES["DL015"]
-    by_path = {ms.path: ms for ms in sources}
-    out: List[Violation] = []
-    for entry in sorted(set(serving) - warmed):
-        path, line = serving[entry]
-        ms = by_path.get(path)
-        if ms is not None and _suppressed(ms, line, "DL015"):
-            continue
-        out.append(Violation(
-            path, line, 0, "DL015", name,
-            f"{summary}: jitted entry `{entry}` is dispatched at serving "
-            f"time but never exercised by warmup() — its first call "
-            f"compiles mid-serving", entry))
-    return out
-
-
 # ------------------------------------------------------------------ driver
 
 def analyze_jit(sources: Sequence[ModuleSource],
                 graph: Optional[CallGraph] = None) -> List[Violation]:
-    """Run the dynajit passes (DL015/DL016/DL017 + warmup coverage) over
-    already-loaded modules, reusing a shared call graph when given."""
+    """Run the dynajit passes (DL015/DL016/DL017) over already-loaded
+    modules, reusing a shared call graph when given. Warmup coverage —
+    which jitted entries serving dispatches that warmup() never
+    exercises — moved to dynaform's DL026, where it is subsumed by full
+    call-form matching (dtype/provenance/kwarg-set per site)."""
     from .callgraph import module_name
 
     if graph is None:
@@ -1066,25 +1045,14 @@ def analyze_jit(sources: Sequence[ModuleSource],
     out: List[Violation] = []
     out.extend(check_undonated_writes(sources, jits))
     scans: Dict[str, FuncJitScan] = {}
-    serving: Dict[str, Tuple[str, int]] = {}
-    warmed: Set[str] = set()
-    any_engine = False
     for ms in sources:
         norm = ms.path.replace("\\", "/")
         if not any(m in norm for m in DEVICE_MODULE_MARKERS):
             continue
-        any_engine = any_engine or ENGINE_MARKER in norm
         scan = _FlowScan(ms, module_name(ms.path), graph, jits)
         scan.visit(ms.tree)
         out.extend(scan.violations)
         scans.update(scan.func_scans)
-        for entry, site in scan.serving_entries.items():
-            serving.setdefault(entry, site)
-        warmed |= scan.warmed_entries
     out.extend(check_transitive_transfer(graph, scans))
-    if any_engine and warmed:
-        # only meaningful when a warmup() exists in the scanned tree
-        # (fixture trees without one would flag every entry)
-        out.extend(check_warmup_coverage(serving, warmed, sources))
     out.sort(key=lambda v: (v.path, v.line, v.code))
     return out

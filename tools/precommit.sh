@@ -8,9 +8,11 @@
 #   1. ruff (style/pyflakes), if installed — seconds, changed files only
 #   2. dynalint --changed — per-file rules on the diffed files; the
 #      whole-program passes (dynaflow/dynarace/dynajit/dynaproto/
-#      dynahot) still analyze the full tree off one shared parse,
-#      because a callgraph built from a diff misses the cross-file
-#      edges that make them sound.
+#      dynahot/dynaform) still analyze the full tree off one shared
+#      parse, because a callgraph built from a diff misses the
+#      cross-file edges that make them sound (dynaform in particular
+#      matches serving call forms in one file against warmup() sites
+#      in another).
 set -euo pipefail
 
 ROOT="$(git rev-parse --show-toplevel)"
